@@ -1,0 +1,14 @@
+"""flipcomplexityempirical_tpu — TPU-native flip-walk sampling framework.
+
+A from-scratch, JAX/XLA-first re-design of the capabilities of
+LorenzoNajt/FlipComplexityEmpirical (replication code for "Complexity of
+Sampling Connected Graph Partitions") plus the gerrychain engine surface it
+consumes: batched single-node-flip Markov chains over planar graph
+partitions, vectorized as jit+vmap kernels over an (n_chains, n_nodes)
+assignment tensor, sharded over TPU meshes, with the reference's experiment
+sweeps, metrics, and artifact pipeline reproduced on top.
+"""
+
+__version__ = "0.1.0"
+
+from . import graphs  # noqa: F401

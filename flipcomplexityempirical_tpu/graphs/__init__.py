@@ -1,0 +1,12 @@
+from .lattice import LatticeGraph, DeviceGraph, build_lattice, from_networkx
+from .builders import (
+    square_grid, grid_sec11, triangular_lattice, hex_lattice, frankengraph,
+    sec11_plan, frank_plan, stripes_plan, PARITY_LABELS,
+)
+
+__all__ = [
+    "LatticeGraph", "DeviceGraph", "build_lattice", "from_networkx",
+    "square_grid", "grid_sec11", "triangular_lattice", "hex_lattice",
+    "frankengraph", "sec11_plan", "frank_plan", "stripes_plan",
+    "PARITY_LABELS",
+]

@@ -1,0 +1,214 @@
+"""Lattice builders: the reference's graph zoo plus generalizations.
+
+Reproduces, against the array substrate of ``lattice.py``:
+
+- ``grid_sec11``: the sec11 40x40 grid with 4 corner-diagonal bypass edges
+  and the 4 corners removed — 1596 nodes / 3116 edges
+  (reference grid_chain_sec11.py:191,236,252).
+- ``frankengraph``: 20x20 square grid (relabeled to y in [-19, 0]) composed
+  with a triangular lattice (y in [0, 20]) sharing the y==0 row — 800 nodes /
+  1920 edges (reference Frankenstein_chain.py:186-195).
+- plain ``square_grid`` (any size, the 64x64 benchmark workload), and
+  ``triangular_lattice`` / ``hex_lattice`` for the non-grid planar adjacency
+  configs of BASELINE.json.
+
+networkx is used as a host-side generator for the triangular/hex node sets so
+label conventions match the reference exactly; everything it produces is
+converted immediately into frozen arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .lattice import LatticeGraph, build_lattice, from_networkx
+
+# The four corner-bypass diagonal edges the sec11 script adds
+# (grid_chain_sec11.py:236) and the corner nodes it removes (line 252).
+_SEC11_DIAGONALS = [((0, 1), (1, 0)), ((0, 38), (1, 39)),
+                    ((38, 0), (39, 1)), ((38, 39), (39, 38))]
+_SEC11_CORNERS = [(0, 0), (0, 39), (39, 0), (39, 39)]
+
+
+def square_grid(nx_: int, ny_: int | None = None, *, name: str | None = None,
+                extra_edges=(), remove_nodes=(), wall=None, frame=None,
+                center=None) -> LatticeGraph:
+    """Rook-adjacency nx_ x ny_ grid with optional edge/node surgery."""
+    ny_ = nx_ if ny_ is None else ny_
+    removed = set(remove_nodes)
+    nodes = [(x, y) for x in range(nx_) for y in range(ny_)
+             if (x, y) not in removed]
+    nodeset = set(nodes)
+    adjacency = {n: [] for n in nodes}
+    for (x, y) in nodes:
+        for (dx, dy) in ((1, 0), (0, 1)):
+            m = (x + dx, y + dy)
+            if m in nodeset:
+                adjacency[(x, y)].append(m)
+                adjacency[m].append((x, y))
+    for (u, v) in extra_edges:
+        if u in nodeset and v in nodeset:
+            adjacency[u].append(v)
+            adjacency[v].append(u)
+    if frame is None:
+        frame = lambda n: n[0] in (0, nx_ - 1) or n[1] in (0, ny_ - 1)
+    if center is None:
+        center = (nx_ / 2.0, ny_ / 2.0)
+    return build_lattice(
+        adjacency, name=name or f"grid{nx_}x{ny_}",
+        frame=frame, wall=wall, center=center)
+
+
+def grid_sec11() -> LatticeGraph:
+    """The sec11 experiment graph: 1596 nodes, 3116 edges.
+
+    Wall ids implement the reference ``boundary_slope`` classification
+    (grid_chain_sec11.py:63-75): 0: both x==0; 1: both y==0; 2: both x==39;
+    3: both y==39; 4: the four corner diagonal edges.
+    """
+    diag = {frozenset(e) for e in _SEC11_DIAGONALS}
+
+    def wall(u, v):
+        if u[0] == 0 and v[0] == 0:
+            return 0
+        if u[1] == 0 and v[1] == 0:
+            return 1
+        if u[0] == 39 and v[0] == 39:
+            return 2
+        if u[1] == 39 and v[1] == 39:
+            return 3
+        if frozenset((u, v)) in diag:
+            return 4
+        return -1
+
+    return square_grid(
+        40, 40, name="grid_sec11",
+        extra_edges=_SEC11_DIAGONALS, remove_nodes=_SEC11_CORNERS,
+        wall=wall, frame=lambda n: 0 in n or 39 in n, center=(20.0, 20.0))
+
+
+def _label_center(labels) -> tuple:
+    xs = [x for (x, _) in labels]
+    ys = [y for (_, y) in labels]
+    return ((min(xs) + max(xs)) / 2.0, (min(ys) + max(ys)) / 2.0)
+
+
+def triangular_lattice(m: int, n: int, *, name: str | None = None,
+                       frame=None, wall=None, center=None) -> LatticeGraph:
+    """Triangular lattice via the networkx generator (label parity with the
+    reference's ``nx.triangular_lattice_graph``, Frankenstein_chain.py:188)."""
+    import networkx as nx
+
+    g = nx.triangular_lattice_graph(m, n)
+    return from_networkx(g, name=name or f"tri{m}x{n}", frame=frame,
+                         wall=wall, center=center or _label_center(g.nodes()))
+
+
+def hex_lattice(m: int, n: int, *, name: str | None = None,
+                frame=None, wall=None, center=None) -> LatticeGraph:
+    """Hexagonal lattice (degree <= 3 planar adjacency)."""
+    import networkx as nx
+
+    g = nx.hexagonal_lattice_graph(m, n)
+    return from_networkx(g, name=name or f"hex{m}x{n}", frame=frame,
+                         wall=wall, center=center or _label_center(g.nodes()))
+
+
+def frankengraph(m: int = 20) -> LatticeGraph:
+    """Square-grid + triangular-lattice hybrid ("Frankengraph").
+
+    Matches Frankenstein_chain.py:186-195: an m x m grid relabeled so its
+    rows span y in [-(m-1), 0], composed with ``triangular_lattice_graph(m,
+    2m-2)`` spanning y in [0, m]; the m nodes of the y==0 row are shared.
+    For m=20: 800 nodes, 1920 edges. Wall ids per
+    Frankenstein_chain.py:64-71: 0: both x==0; 1: both y==-19; 2: both
+    x==19; 3: both y==20.
+    """
+    import networkx as nx
+
+    g = nx.grid_graph([m, m])
+    h = nx.triangular_lattice_graph(m, 2 * m - 2)
+    adjacency: dict = {}
+    for node in g.nodes():
+        lab = (node[0], node[1] - m + 1)
+        adjacency.setdefault(lab, set()).update(
+            (v[0], v[1] - m + 1) for v in g[node])
+    for node in h.nodes():
+        adjacency.setdefault(node, set()).update(h[node])
+    adjacency = {k: sorted(v) for k, v in adjacency.items()}
+
+    y_lo, y_hi = -(m - 1), m
+
+    def wall(u, v):
+        if u[0] == 0 and v[0] == 0:
+            return 0
+        if u[1] == y_lo and v[1] == y_lo:
+            return 1
+        if u[0] == m - 1 and v[0] == m - 1:
+            return 2
+        if u[1] == y_hi and v[1] == y_hi:
+            return 3
+        return -1
+
+    return build_lattice(
+        adjacency, name=f"frankengraph{m}",
+        frame=lambda nd: nd[0] in (0, m - 1) or nd[1] in (y_hi, y_lo),
+        wall=wall, center=(float(m), float(m)))
+
+
+# ---------------------------------------------------------------------------
+# Initial plans (the reference's alignment-indexed starting assignments).
+# Internally districts are 0..K-1; ``PARITY_LABELS`` maps district index to
+# the reference's +1/-1 labels (district 0 <-> +1).
+# ---------------------------------------------------------------------------
+
+PARITY_LABELS = np.array([1, -1], dtype=np.int32)
+
+
+def sec11_plan(graph: LatticeGraph, alignment: int) -> np.ndarray:
+    """grid_chain_sec11.py:197-214 — 0: vertical split at x>19; 1: horizontal
+    at y>19; 2: diagonal x>y with x==y tie broken at x>19. District 0 is the
+    reference's +1 side."""
+    out = np.empty(graph.n_nodes, dtype=np.int8)
+    for i, (x, y) in enumerate(graph.labels):
+        if alignment == 0:
+            plus = x > 19
+        elif alignment == 1:
+            plus = y > 19
+        elif alignment == 2:
+            plus = (x > y) or (x == y and x > 19)
+        else:
+            raise ValueError(f"alignment {alignment}")
+        out[i] = 0 if plus else 1
+    return out
+
+
+def frank_plan(graph: LatticeGraph, alignment: int, m: int = 20) -> np.ndarray:
+    """Frankenstein_chain.py:207-246 — start_plans = [diagonal, vertical,
+    horizontal][alignment]; membership gets the reference's +1 (district 0)."""
+    out = np.empty(graph.n_nodes, dtype=np.int8)
+    for i, (x, y) in enumerate(graph.labels):
+        if alignment == 0:
+            plus = 2 * x - y <= m - 3
+        elif alignment == 1:
+            plus = x < m / 2
+        elif alignment == 2:
+            plus = y < 0
+        else:
+            raise ValueError(f"alignment {alignment}")
+        out[i] = 0 if plus else 1
+    return out
+
+
+def stripes_plan(graph: LatticeGraph, k: int, axis: int = 0) -> np.ndarray:
+    """k vertical (axis=0) or horizontal (axis=1) bands of near-equal
+    population — the generic k-district starting plan for BASELINE config 2."""
+    coords = graph.coords[:, axis]
+    order = np.argsort(coords, kind="stable")
+    csum = np.cumsum(graph.pop[order])
+    total = csum[-1]
+    out = np.empty(graph.n_nodes, dtype=np.int8)
+    bounds = total * (np.arange(1, k + 1) / k)
+    dist = np.searchsorted(bounds, csum, side="left").clip(0, k - 1)
+    out[order] = dist.astype(np.int8)
+    return out

@@ -1,0 +1,318 @@
+"""Graph substrate: host-side lattice construction -> static device arrays.
+
+TPU-first re-design of the graph layer the reference consumes from networkx
+(reference: grid_chain_sec11.py:186-260, Frankenstein_chain.py:186-234).
+Instead of dict-of-dicts adjacency mutated per step, a graph here is a set of
+frozen padded arrays uploaded to device once:
+
+- ``edges``:    ``int32[E, 2]`` canonical (lexicographically sorted) edge list.
+- ``nbr``:      ``int32[N, D]`` padded neighbor table. Padding slots hold the
+                node's own index, so a gathered "neighbor assignment" equals
+                the node's own assignment and contributes nothing to cut
+                deltas by construction.
+- ``nbr_edge``: ``int32[N, D]`` edge index per neighbor slot (pad 0; always
+                used together with ``nbr_mask`` so pad slots scatter zeros).
+- patch tables (``patch_nodes``, ``patch_adj``, sizes): a per-node radius-2
+  ball encoded as <=32-node bitset adjacency, used by the O(P^2) local
+  contiguity check (kernel/contiguity.py). The local check is *sufficient*
+  (patch-connected => flip keeps the district connected) but not necessary:
+  a district connected only around a long detour fails it. It is exact for
+  simply-connected districts on these lattices; kernels expose
+  ``contiguity='patch'|'exact'`` and the exact masked-BFS mode matches
+  gerrychain's ``single_flip_contiguous`` semantics unconditionally.
+- ``wall_id``:  ``int8[E]`` wall classification per edge for the reference's
+                ``boundary_slope`` updater parity (grid_chain_sec11.py:55-78:
+                walls 0..3 are x==0 / y==0 / x==max / y==max; 4 marks the four
+                corner diagonal edges of the sec11 graph).
+- ``frame_mask``: ``bool[N]`` the reference's per-node ``boundary_node``
+                attribute (grid_chain_sec11.py:229-234).
+
+Everything dynamic (assignment, cut masks, populations per district) lives in
+``state.ChainState``; everything here is immutable for the lifetime of a run,
+which is what lets XLA treat it as loop-invariant and keep the hot flip
+kernel free of host traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from flax import struct
+
+import jax.numpy as jnp
+
+# Patch bitsets are uint32 words: a radius-2 ball larger than 32 nodes cannot
+# be encoded and the graph falls back to the exact (BFS) contiguity checker.
+MAX_PATCH = 32
+
+
+@struct.dataclass
+class DeviceGraph:
+    """The static, device-resident view of a lattice graph (a JAX pytree).
+
+    All kernels take this as an argument; XLA hoists it out of the step loop.
+    Shapes: N nodes, E edges, D max degree, P max patch size.
+    """
+
+    edges: jnp.ndarray        # int32[E, 2]
+    nbr: jnp.ndarray          # int32[N, D], pad = self
+    nbr_mask: jnp.ndarray     # bool[N, D]
+    nbr_edge: jnp.ndarray     # int32[N, D], pad = 0 (mask before scatter)
+    deg: jnp.ndarray          # int32[N]
+    pop: jnp.ndarray          # int32[N] node population weights
+    coords: jnp.ndarray       # float32[N, 2] planar positions (plot/slope)
+    frame_mask: jnp.ndarray   # bool[N]   reference "boundary_node" attr
+    wall_id: jnp.ndarray      # int8[E]   -1 none, 0..3 walls, 4 corner diag
+    patch_nodes: jnp.ndarray  # int32[N, P], pad = self
+    patch_adj: jnp.ndarray    # uint32[N, P] bitset adjacency within patch
+    patch_size: jnp.ndarray   # int32[N]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.nbr.shape[0]
+
+    @property
+    def n_edges(self) -> int:
+        return self.edges.shape[0]
+
+    @property
+    def max_deg(self) -> int:
+        return self.nbr.shape[1]
+
+    @property
+    def max_patch(self) -> int:
+        return self.patch_nodes.shape[1]
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class LatticeGraph:
+    """Host-side graph: numpy arrays + label metadata + a DeviceGraph view.
+
+    ``labels`` keeps the original (e.g. ``(x, y)``) node labels in index
+    order so experiment drivers can translate between the reference's
+    dict-keyed world and our dense arrays.
+    """
+
+    name: str
+    labels: tuple                 # tuple of hashable node labels, index order
+    edges: np.ndarray             # int32[E, 2]
+    nbr: np.ndarray               # int32[N, D]
+    nbr_mask: np.ndarray          # bool[N, D]
+    nbr_edge: np.ndarray          # int32[N, D]
+    deg: np.ndarray               # int32[N]
+    pop: np.ndarray               # int32[N]
+    coords: np.ndarray            # float64[N, 2]
+    frame_mask: np.ndarray        # bool[N]
+    wall_id: np.ndarray           # int8[E]
+    patch_nodes: np.ndarray       # int32[N, P]
+    patch_adj: np.ndarray         # uint32[N, P]
+    patch_size: np.ndarray        # int32[N]
+    patch_ok: bool                # False => local check unavailable
+    center: tuple = (20.0, 20.0)  # angle-metric center, ref *:391-394
+
+    @property
+    def n_nodes(self) -> int:
+        return int(self.nbr.shape[0])
+
+    @property
+    def n_edges(self) -> int:
+        return int(self.edges.shape[0])
+
+    @property
+    def max_deg(self) -> int:
+        return int(self.nbr.shape[1])
+
+    @property
+    def index(self) -> dict:
+        """label -> node index map (built lazily, cached on the instance)."""
+        idx = self.__dict__.get("_index")
+        if idx is None:
+            idx = {lab: i for i, lab in enumerate(self.labels)}
+            object.__setattr__(self, "_index", idx)
+        return idx
+
+    def device(self) -> DeviceGraph:
+        dg = self.__dict__.get("_device")
+        if dg is None:
+            dg = DeviceGraph(
+                edges=jnp.asarray(self.edges, jnp.int32),
+                nbr=jnp.asarray(self.nbr, jnp.int32),
+                nbr_mask=jnp.asarray(self.nbr_mask),
+                nbr_edge=jnp.asarray(self.nbr_edge, jnp.int32),
+                deg=jnp.asarray(self.deg, jnp.int32),
+                pop=jnp.asarray(self.pop, jnp.int32),
+                coords=jnp.asarray(self.coords, jnp.float32),
+                frame_mask=jnp.asarray(self.frame_mask),
+                wall_id=jnp.asarray(self.wall_id, jnp.int8),
+                patch_nodes=jnp.asarray(self.patch_nodes, jnp.int32),
+                patch_adj=jnp.asarray(self.patch_adj, jnp.uint32),
+                patch_size=jnp.asarray(self.patch_size, jnp.int32),
+            )
+            object.__setattr__(self, "_device", dg)
+        return dg
+
+    # -- conveniences used by experiments / tests ---------------------------
+
+    def assignment_from_dict(self, d: dict, dtype=np.int8) -> np.ndarray:
+        """Map a reference-style {label: district} dict to a dense array.
+
+        Every node must be covered; a partial dict raises instead of leaving
+        uninitialized entries.
+        """
+        sentinel = np.iinfo(dtype).min
+        out = np.full(self.n_nodes, sentinel, dtype=dtype)
+        for lab, v in d.items():
+            out[self.index[lab]] = v
+        if (out == sentinel).any():
+            missing = [self.labels[i] for i in
+                       np.nonzero(out == sentinel)[0][:5]]
+            raise ValueError(
+                f"assignment dict missing {int((out == sentinel).sum())} "
+                f"nodes, e.g. {missing}")
+        return out
+
+    def assignment_to_dict(self, arr: np.ndarray) -> dict:
+        return {lab: arr[i].item() for i, lab in enumerate(self.labels)}
+
+
+def build_lattice(
+    adjacency: dict,
+    *,
+    name: str = "graph",
+    coords: Optional[dict] = None,
+    pop: Optional[dict] = None,
+    frame: Optional[Callable[[Any], bool]] = None,
+    wall: Optional[Callable[[Any, Any], int]] = None,
+    center: tuple = (20.0, 20.0),
+    node_order: Optional[Sequence] = None,
+) -> LatticeGraph:
+    """Build a LatticeGraph from a plain adjacency dict {label: iterable}.
+
+    ``adjacency`` may come from networkx (``{n: set(G[n])}``) or be hand
+    rolled; this function owns canonicalization (sorted node order, sorted
+    edge list) so that edge indices — and therefore the deterministic
+    "first two wall edges" selection of the slope metric (see
+    kernel/metrics.py; reference grid_chain_sec11.py:371-374 relies on
+    arbitrary Python set order) — are reproducible across runs.
+    """
+    labels = list(node_order) if node_order is not None else sorted(adjacency)
+    n = len(labels)
+    index = {lab: i for i, lab in enumerate(labels)}
+
+    edge_set = set()
+    for u, nbrs in adjacency.items():
+        iu = index[u]
+        for v in nbrs:
+            iv = index[v]
+            if iu == iv:
+                continue
+            edge_set.add((min(iu, iv), max(iu, iv)))
+    edges = np.array(sorted(edge_set), dtype=np.int32).reshape(-1, 2)
+    e = edges.shape[0]
+
+    # adjacency lists in index space
+    adj_idx: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+    for ei in range(e):
+        a, b = int(edges[ei, 0]), int(edges[ei, 1])
+        adj_idx[a].append((b, ei))
+        adj_idx[b].append((a, ei))
+    deg = np.array([len(a) for a in adj_idx], dtype=np.int32)
+    d = int(deg.max()) if n else 0
+
+    nbr = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, d))
+    nbr_mask = np.zeros((n, d), dtype=bool)
+    nbr_edge = np.zeros((n, d), dtype=np.int32)
+    for i in range(n):
+        for s, (j, ei) in enumerate(adj_idx[i]):
+            nbr[i, s] = j
+            nbr_mask[i, s] = True
+            nbr_edge[i, s] = ei
+
+    # --- radius-2 patch bitsets for the local contiguity check ------------
+    # patch order: neighbors first (same order as nbr slots) so the "seed"
+    # bits of the check are simply bits [0, deg).
+    patch_lists: list[list[int]] = []
+    for i in range(n):
+        first = [j for (j, _) in adj_idx[i]]
+        seen = {i, *first}
+        second = []
+        for j in first:
+            for (k2, _) in adj_idx[j]:
+                if k2 not in seen:
+                    seen.add(k2)
+                    second.append(k2)
+        patch_lists.append(first + second)
+    p = max((len(pl) for pl in patch_lists), default=0)
+    patch_ok = p <= MAX_PATCH
+    if not patch_ok:
+        p = 1  # keep arrays tiny; kernel must use the exact checker
+    patch_nodes = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, p))
+    patch_adj = np.zeros((n, p), dtype=np.uint32)
+    patch_size = np.zeros(n, dtype=np.int32)
+    if patch_ok:
+        nbrsets = [set(j for (j, _) in a) for a in adj_idx]
+        for i in range(n):
+            pl = patch_lists[i]
+            patch_size[i] = len(pl)
+            pos = {j: s for s, j in enumerate(pl)}
+            for s, j in enumerate(pl):
+                patch_nodes[i, s] = j
+                word = 0
+                for k2 in nbrsets[j]:
+                    t = pos.get(k2)
+                    if t is not None:
+                        word |= 1 << t
+                patch_adj[i, s] = word
+
+    coords_arr = np.zeros((n, 2), dtype=np.float64)
+    if coords is not None:
+        for lab, xy in coords.items():
+            coords_arr[index[lab]] = xy
+    else:
+        for lab in labels:
+            if isinstance(lab, tuple) and len(lab) == 2:
+                coords_arr[index[lab]] = lab
+
+    pop_arr = np.ones(n, dtype=np.int32)
+    if pop is not None:
+        for lab, v in pop.items():
+            pop_arr[index[lab]] = v
+
+    frame_mask = np.zeros(n, dtype=bool)
+    if frame is not None:
+        for lab in labels:
+            frame_mask[index[lab]] = bool(frame(lab))
+
+    wall_arr = np.full(e, -1, dtype=np.int8)
+    if wall is not None:
+        for ei in range(e):
+            a, b = labels[edges[ei, 0]], labels[edges[ei, 1]]
+            wall_arr[ei] = wall(a, b)
+
+    return LatticeGraph(
+        name=name,
+        labels=tuple(labels),
+        edges=edges,
+        nbr=nbr,
+        nbr_mask=nbr_mask,
+        nbr_edge=nbr_edge,
+        deg=deg,
+        pop=pop_arr,
+        coords=coords_arr,
+        frame_mask=frame_mask,
+        wall_id=wall_arr,
+        patch_nodes=patch_nodes,
+        patch_adj=patch_adj,
+        patch_size=patch_size,
+        patch_ok=patch_ok,
+        center=center,
+    )
+
+
+def from_networkx(g, **kwargs) -> LatticeGraph:
+    """Build from a networkx graph (host-side convenience)."""
+    adjacency = {n: list(g[n]) for n in g.nodes()}
+    return build_lattice(adjacency, **kwargs)

@@ -1,0 +1,133 @@
+"""Graph substrate tests: counts, adjacency integrity, patch tables, plans.
+
+Node/edge counts and plan sizes are the verified reference facts from
+SURVEY.md section 2.2 rows 14-17 (grid_chain_sec11.py:186-260,
+Frankenstein_chain.py:186-246).
+"""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+from flipcomplexityempirical_tpu import graphs
+
+
+def nx_sec11():
+    g = nx.grid_2d_graph(40, 40)
+    g.add_edges_from([((0, 1), (1, 0)), ((0, 38), (1, 39)),
+                      ((38, 0), (39, 1)), ((38, 39), (39, 38))])
+    g.remove_nodes_from([(0, 0), (0, 39), (39, 0), (39, 39)])
+    return g
+
+
+def nx_frank(m=20):
+    g = nx.grid_graph([m, m])
+    h = nx.triangular_lattice_graph(m, 2 * m - 2)
+    g = nx.relabel_nodes(g, {x: (x[0], x[1] - m + 1) for x in g.nodes()})
+    return nx.compose(g, h)
+
+
+def check_adjacency(lat, g):
+    assert lat.n_nodes == g.number_of_nodes()
+    assert lat.n_edges == g.number_of_edges()
+    idx = lat.index
+    for u, v in g.edges():
+        iu, iv = idx[u], idx[v]
+        assert iv in set(lat.nbr[iu][lat.nbr_mask[iu]])
+        assert iu in set(lat.nbr[iv][lat.nbr_mask[iv]])
+    # degree and padding conventions
+    assert (lat.deg == np.array([g.degree[lab] for lab in lat.labels])).all()
+    pad = ~lat.nbr_mask
+    rows = np.tile(np.arange(lat.n_nodes)[:, None], (1, lat.max_deg))
+    assert (lat.nbr[pad] == rows[pad]).all()
+    # every edge appears exactly twice in nbr_edge (once per endpoint)
+    counts = np.bincount(lat.nbr_edge[lat.nbr_mask], minlength=lat.n_edges)
+    assert (counts == 2).all()
+
+
+def test_grid_sec11_counts():
+    lat = graphs.grid_sec11()
+    assert lat.n_nodes == 1596
+    assert lat.n_edges == 3116
+    check_adjacency(lat, nx_sec11())
+    # frame mask parity: 0 in n or 39 in n (minus removed corners) -> 152
+    assert int(lat.frame_mask.sum()) == 152
+    # wall ids: 4 corner diagonals
+    assert int((lat.wall_id == 4).sum()) == 4
+    # each wall has 37 edges along it (39 gridline edges minus 2 at corners)
+    for w in range(4):
+        assert int((lat.wall_id == w).sum()) == 37
+
+
+def test_frankengraph_counts():
+    lat = graphs.frankengraph()
+    assert lat.n_nodes == 800
+    assert lat.n_edges == 1920
+    check_adjacency(lat, nx_frank())
+    assert int(lat.frame_mask.sum()) == 116
+
+
+def test_patch_tables_grid():
+    lat = graphs.square_grid(6, 6)
+    assert lat.patch_ok
+    n = lat.n_nodes
+    for i in range(0, n, 7):
+        size = int(lat.patch_size[i])
+        pl = list(lat.patch_nodes[i][:size])
+        # neighbors come first, in nbr-slot order
+        deg = int(lat.deg[i])
+        assert pl[:deg] == list(lat.nbr[i][:deg])
+        # patch = radius-2 ball minus self
+        g = nx.grid_2d_graph(6, 6)
+        lab = lat.labels[i]
+        ball = set(nx.single_source_shortest_path_length(g, lab, 2)) - {lab}
+        assert {lat.labels[j] for j in pl} == ball
+        # bitset adjacency matches induced subgraph
+        for s in range(size):
+            for t in range(size):
+                bit = (int(lat.patch_adj[i][s]) >> t) & 1
+                expect = g.has_edge(lat.labels[pl[s]], lat.labels[pl[t]])
+                assert bit == int(expect)
+
+
+def test_plans_sec11():
+    lat = graphs.grid_sec11()
+    for al in (0, 1, 2):
+        plan = graphs.sec11_plan(lat, al)
+        c0, c1 = int((plan == 0).sum()), int((plan == 1).sum())
+        assert (c0, c1) == (798, 798)
+
+
+def test_plans_frank():
+    lat = graphs.frankengraph()
+    sizes = {}
+    for al in (0, 1, 2):
+        plan = graphs.frank_plan(lat, al)
+        sizes[al] = int((plan == 0).sum())
+    # diagonal=380, vertical=400, horizontal=380 (Frankenstein_chain.py:207-230)
+    assert sizes == {0: 380, 1: 400, 2: 380}
+
+
+def test_triangular_and_hex_build():
+    tri = graphs.triangular_lattice(6, 10)
+    assert tri.patch_ok and tri.n_nodes > 0
+    hexg = graphs.hex_lattice(4, 4)
+    assert hexg.patch_ok
+    assert int(hexg.deg.max()) <= 3
+
+
+def test_stripes_plan_balanced():
+    lat = graphs.square_grid(12, 12)
+    for k in (2, 4, 8):
+        plan = graphs.stripes_plan(lat, k)
+        counts = np.bincount(plan, minlength=k)
+        assert counts.min() >= 144 // k - k and counts.max() <= 144 // k + k
+        assert len(np.unique(plan)) == k
+
+
+def test_assignment_roundtrip():
+    lat = graphs.square_grid(5, 5)
+    plan = graphs.stripes_plan(lat, 2)
+    d = lat.assignment_to_dict(plan)
+    back = lat.assignment_from_dict(d)
+    assert (back == plan).all()
