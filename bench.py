@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Headline benchmark: aggregate flip throughput on the BASELINE workload.
+
+Workload (BASELINE.json north star): 2-district single-node-flip chains on a
+64x64 grid, full reference semantics (boundary proposal, re-propose-on-
+invalid, patch contiguity, population bounds, Metropolis accept, geometric
+waits, parity metric bookkeeping). Target: >=1e4 chains at >=1e7 aggregate
+flips/sec on a v5e-8 — i.e. >=1.25e6 flips/sec/chip, which is the
+vs_baseline denominator here (this box exposes one chip).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "flips/s", "vs_baseline": N}
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grid", type=int, default=64)
+    ap.add_argument("--chains", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=3000)
+    ap.add_argument("--warmup", type=int, default=500)
+    ap.add_argument("--base", type=float, default=2.63815853)
+    ap.add_argument("--pop-tol", type=float, default=0.1)
+    ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import flipcomplexityempirical_tpu as fce
+
+    g = fce.graphs.square_grid(args.grid, args.grid)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                    invalid="repropose", accept="cut",
+                    parity_metrics=True, geom_waits=True,
+                    record_interface=False)
+    dg, states, params = fce.init_batch(
+        g, plan, n_chains=args.chains, seed=0, spec=spec,
+        base=args.base, pop_tol=args.pop_tol)
+
+    # compile + mix in (reach steady-state boundary sizes)
+    res = fce.run_chains(dg, spec, params, states, n_steps=args.warmup,
+                         record_history=False, chunk=args.warmup)
+    states = res.state
+    jax.block_until_ready(states.assignment)
+
+    t0 = time.perf_counter()
+    res = fce.run_chains(dg, spec, params, states, n_steps=args.steps,
+                         record_history=False, chunk=args.steps)
+    jax.block_until_ready(res.state.assignment)
+    dt = time.perf_counter() - t0
+
+    flips = args.chains * (args.steps - 1)  # yields minus the initial record
+    fps = flips / dt
+    s = res.host_state()
+    meta = {
+        "device": str(jax.devices()[0]),
+        "chains": args.chains,
+        "steps": args.steps,
+        "grid": args.grid,
+        "seconds": round(dt, 3),
+        "mean_tries_per_step": float(np.asarray(s.tries_sum).mean()
+                                     / (args.steps - 1)),
+        "accept_rate": float(np.asarray(s.accept_count).mean()
+                             / (args.steps - 1)),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(json.dumps({
+        "metric": "flips_per_sec_per_chip_64x64",
+        "value": round(fps, 1),
+        "unit": "flips/s",
+        "vs_baseline": round(fps / 1.25e6, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

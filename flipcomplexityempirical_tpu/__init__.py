@@ -12,3 +12,9 @@ sweeps, metrics, and artifact pipeline reproduced on top.
 __version__ = "0.1.0"
 
 from . import graphs  # noqa: F401
+from . import compat  # noqa: F401
+from . import state  # noqa: F401
+from . import kernel  # noqa: F401
+from . import sampling  # noqa: F401
+from .kernel import Spec  # noqa: F401
+from .sampling import run_chains, init_batch  # noqa: F401

@@ -1,0 +1,29 @@
+"""Pure-Python compatibility layer: the gerrychain surface the reference
+consumes (SURVEY.md section 2.3), re-implemented over the array substrate.
+
+Serves three roles: (a) the oracle the vectorized JAX kernel is validated
+against, (b) the ``backend="python"`` path of the experiment driver, and
+(c) a migration surface for reference users whose code speaks
+Partition/MarkovChain."""
+
+from .partition import (
+    Partition, Tally, cut_edges, b_nodes_bi, b_nodes_pairs,
+    make_geom_wait, make_boundary_slope, step_num,
+)
+from .chain import (
+    MarkovChain, Validator, within_percent_of_ideal_population,
+    single_flip_contiguous, contiguous,
+    make_reversible_propose_bi, make_reversible_propose_pairs,
+    make_random_flip, go_nowhere, always_accept,
+    make_cut_accept, make_corrected_cut_accept,
+)
+
+__all__ = [
+    "Partition", "Tally", "cut_edges", "b_nodes_bi", "b_nodes_pairs",
+    "make_geom_wait", "make_boundary_slope", "step_num",
+    "MarkovChain", "Validator", "within_percent_of_ideal_population",
+    "single_flip_contiguous", "contiguous",
+    "make_reversible_propose_bi", "make_reversible_propose_pairs",
+    "make_random_flip", "go_nowhere", "always_accept",
+    "make_cut_accept", "make_corrected_cut_accept",
+]

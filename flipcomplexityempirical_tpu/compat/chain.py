@@ -1,0 +1,242 @@
+"""gerrychain-surface MarkovChain, constraints, proposals, acceptance.
+
+Semantics pinned per SURVEY.md section 2.3 (consumed at
+grid_chain_sec11.py:340-342,366):
+
+- The chain yields ``total_steps`` states, the initial state first.
+- An INVALID proposal is retried without consuming a step (the effective
+  proposal distribution is uniform over *valid* moves; no Hastings
+  correction is applied, faithfully to the reference).
+- A VALID but rejected proposal consumes a step and yields the unchanged
+  parent object — so memoized updater values (notably the geometric wait
+  sample) are re-read, not recomputed.
+- Before proposing, the current state's parent pointer is dropped
+  (gerrychain's memory-leak truncation): acceptance functions may read one
+  generation back, not two.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from .partition import Partition
+
+
+class Validator:
+    """Conjunction of constraints, short-circuit in listed order
+    (grid_chain_sec11.py:340)."""
+
+    def __init__(self, constraints: Iterable[Callable]):
+        self.constraints = list(constraints)
+
+    def __call__(self, partition: Partition) -> bool:
+        return all(c(partition) for c in self.constraints)
+
+
+def within_percent_of_ideal_population(initial_partition: Partition,
+                                       percent: float = 0.01) -> Callable:
+    """Bounds constraint built from the *initial* partition's tallies
+    (grid_chain_sec11.py:319): every district population within
+    [(1-p)*ideal, (1+p)*ideal], inclusive."""
+    tallies = initial_partition["population"]
+    ideal = sum(tallies.values()) / len(tallies)
+    lo, hi = (1 - percent) * ideal, (1 + percent) * ideal
+
+    def bounds(partition: Partition) -> bool:
+        vals = partition["population"].values()
+        return lo <= min(vals) and max(vals) <= hi
+
+    return bounds
+
+
+def single_flip_contiguous(partition: Partition) -> bool:
+    """Exact single-flip contiguity: for each flipped node, its origin
+    district (parent assignment) must remain connected after the flip.
+
+    Correctness: the parent district was connected, so post-flip
+    connectivity is equivalent to all of the flipped node's origin-district
+    neighbors being mutually reachable within the shrunken district. A
+    flipped node with no origin-district neighbors means the district was a
+    singleton and is now empty — vacuously True here (population bounds are
+    the reference's guard against vanishing districts)."""
+    if not partition.flips or partition.parent is None:
+        return contiguous(partition)
+    g = partition.graph
+    a = partition.assignment_array
+    for lab in partition.flips:
+        v = g.index[lab]
+        old = int(partition.parent.assignment_array[v])
+        d = int(g.deg[v])
+        targets = [int(j) for j in g.nbr[v, :d] if a[j] == old]
+        if len(targets) <= 1:
+            continue
+        # BFS within the origin district from one target to the rest
+        seen = {targets[0]}
+        frontier = [targets[0]]
+        remaining = set(targets[1:])
+        while frontier and remaining:
+            nxt = []
+            for i in frontier:
+                di = int(g.deg[i])
+                for j in g.nbr[i, :di]:
+                    j = int(j)
+                    if j not in seen and a[j] == old:
+                        seen.add(j)
+                        nxt.append(j)
+                        remaining.discard(j)
+            frontier = nxt
+        if remaining:
+            return False
+    return True
+
+
+def contiguous(partition: Partition) -> bool:
+    """Full contiguity of every district (BFS per district)."""
+    g = partition.graph
+    a = partition.assignment_array
+    for dist in set(int(x) for x in a):
+        members = np.nonzero(a == dist)[0]
+        seen = {int(members[0])}
+        frontier = [int(members[0])]
+        while frontier:
+            nxt = []
+            for i in frontier:
+                di = int(g.deg[i])
+                for j in g.nbr[i, :di]:
+                    j = int(j)
+                    if j not in seen and a[j] == dist:
+                        seen.add(j)
+                        nxt.append(j)
+            frontier = nxt
+        if len(seen) != len(members):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Proposals (grid_chain_sec11.py:117-145; gerrychain.proposals surface)
+# ---------------------------------------------------------------------------
+
+def make_reversible_propose_bi(rng: np.random.Generator) -> Callable:
+    """Uniform over the boundary-node set; flip the +1/-1 label
+    (grid_chain_sec11.py:132-145). Requires the 'b_nodes' updater to return
+    node labels (b_nodes_bi)."""
+
+    def propose(partition: Partition) -> Partition:
+        bn = sorted(partition["b_nodes"])
+        fnode = bn[rng.integers(len(bn))]
+        return partition.flip({fnode: -1 * partition.assignment[fnode]})
+
+    return propose
+
+
+def make_reversible_propose_pairs(rng: np.random.Generator) -> Callable:
+    """k-district variant: uniform over (node, neighboring-part) pairs
+    (grid_chain_sec11.py:117-130). Requires 'b_nodes' = b_nodes_pairs."""
+
+    def propose(partition: Partition) -> Partition:
+        bn = sorted(partition["b_nodes"])
+        node, part = bn[rng.integers(len(bn))]
+        return partition.flip({node: part})
+
+    return propose
+
+
+def make_random_flip(rng: np.random.Generator) -> Callable:
+    """gerrychain.proposals.propose_random_flip (imported at
+    grid_chain_sec11.py:24, unused there): pick a random cut edge, flip one
+    endpoint to the other's district."""
+
+    def propose(partition: Partition) -> Partition:
+        ce = sorted(partition["cut_edges"])
+        u, v = ce[rng.integers(len(ce))]
+        if rng.integers(2):
+            u, v = v, u
+        return partition.flip({u: partition.assignment[v]})
+
+    return propose
+
+
+def go_nowhere(partition: Partition) -> Partition:
+    return partition.flip({})
+
+
+def always_accept(partition: Partition) -> bool:
+    return True
+
+
+def make_cut_accept(rng: np.random.Generator, base_key: str = "base") -> Callable:
+    """The reference's literal acceptance (grid_chain_sec11.py:171-179):
+    accept iff U < base**(-|cut(child)| + |cut(parent)|). Deliberately omits
+    the |b_nodes| proposal-asymmetry correction, exactly as the reference
+    does — see make_corrected_cut_accept for the reversible version."""
+
+    def accept(partition: Partition) -> bool:
+        bound = 1.0
+        if partition.parent is not None:
+            delta = (-len(partition["cut_edges"])
+                     + len(partition.parent["cut_edges"]))
+            bound = partition[base_key] ** delta
+        return rng.random() < bound
+
+    return accept
+
+
+def make_corrected_cut_accept(rng: np.random.Generator,
+                              base_key: str = "base") -> Callable:
+    """Reversibility-corrected acceptance: multiplies the Metropolis bound by
+    |b_nodes(parent)| / |b_nodes(child)| — the correction the reference's
+    dead annealing_cut_accept_backwards carries (grid_chain_sec11.py:99) and
+    cut_accept lacks. With it the chain is reversible w.r.t.
+    pi ∝ base^(-|cut|) restricted to valid states (up to the invalid-move
+    conditioning)."""
+
+    def accept(partition: Partition) -> bool:
+        bound = 1.0
+        if partition.parent is not None:
+            delta = (-len(partition["cut_edges"])
+                     + len(partition.parent["cut_edges"]))
+            ratio = (len(partition.parent["b_nodes"])
+                     / len(partition["b_nodes"]))
+            bound = partition[base_key] ** delta * ratio
+        return rng.random() < bound
+
+    return accept
+
+
+class MarkovChain:
+    def __init__(self, proposal: Callable, constraints: Callable,
+                 accept: Callable, initial_state: Partition,
+                 total_steps: int):
+        self.proposal = proposal
+        self.is_valid = constraints
+        self.accept = accept
+        self.initial_state = initial_state
+        self.total_steps = total_steps
+        self.state: Optional[Partition] = None
+        self.counter = 0
+
+    def __len__(self):
+        return self.total_steps
+
+    def __iter__(self):
+        self.counter = 0
+        self.state = self.initial_state
+        return self
+
+    def __next__(self) -> Partition:
+        if self.counter == 0:
+            self.counter += 1
+            return self.state
+        while self.counter < self.total_steps:
+            # memory-leak truncation: acceptance may read one generation back
+            self.state.parent = None
+            proposed = self.proposal(self.state)
+            if self.is_valid(proposed):
+                if self.accept(proposed):
+                    self.state = proposed
+                self.counter += 1
+                return self.state
+        raise StopIteration

@@ -1,0 +1,237 @@
+"""gerrychain-surface Partition: lazy memoized updaters over array substrate.
+
+Re-implements (from call-site evidence only, SURVEY.md section 2.3; the
+reference consumes it at grid_chain_sec11.py:316,366-400) the partition
+protocol the reference scripts drive:
+
+- ``Partition(graph, assignment, updaters)`` — graph may be a LatticeGraph
+  or a networkx graph (converted on entry).
+- ``part["key"]`` — lazy, memoized updater evaluation.
+- ``part.flip(delta)`` — child partition sharing the graph; updaters with
+  incremental paths (cut_edges, Tally) use parent + flips.
+- ``part.parent`` / ``part.flips`` / ``part.assignment`` / ``part.parts`` /
+  ``len(part)``.
+
+This is the oracle backend: plain Python + numpy, no JAX. The vectorized
+kernel (kernel/step.py) must match its semantics distributionally; tests
+compare the two.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Mapping, Optional
+
+import numpy as np
+
+from ..graphs.lattice import LatticeGraph, from_networkx
+
+
+class _AssignmentView(Mapping):
+    """Dict-like view of the dense assignment array, keyed by node label."""
+
+    def __init__(self, graph: LatticeGraph, arr: np.ndarray):
+        self._graph = graph
+        self._arr = arr
+
+    def __getitem__(self, label):
+        return int(self._arr[self._graph.index[label]])
+
+    def __iter__(self):
+        return iter(self._graph.labels)
+
+    def __len__(self):
+        return len(self._graph.labels)
+
+    def to_dict(self):
+        return {lab: int(self._arr[i])
+                for i, lab in enumerate(self._graph.labels)}
+
+
+class Partition:
+    def __init__(self, graph, assignment, updaters: Optional[Dict[str, Callable]] = None,
+                 parent: Optional["Partition"] = None, flips: Optional[dict] = None):
+        if parent is None:
+            if not isinstance(graph, LatticeGraph):
+                graph = from_networkx(graph)
+            self.graph = graph
+            if isinstance(assignment, dict):
+                arr = graph.assignment_from_dict(assignment, dtype=np.int32)
+            else:
+                arr = np.asarray(assignment, dtype=np.int32).copy()
+            self.assignment_array = arr
+            self.updaters = dict(updaters or {})
+        else:
+            self.graph = parent.graph
+            self.updaters = parent.updaters
+            arr = parent.assignment_array.copy()
+            for lab, v in flips.items():
+                arr[self.graph.index[lab]] = int(v)
+            self.assignment_array = arr
+        self.parent = parent
+        self.flips = flips  # None for an initial partition
+        self.assignment = _AssignmentView(self.graph, self.assignment_array)
+        self._cache: dict = {}
+
+    # -- protocol -----------------------------------------------------------
+
+    def flip(self, flips: dict) -> "Partition":
+        return Partition(None, None, parent=self, flips=dict(flips))
+
+    def __getitem__(self, key: str):
+        if key not in self._cache:
+            self._cache[key] = self.updaters[key](self)
+        return self._cache[key]
+
+    @property
+    def parts(self) -> dict:
+        if "_parts" not in self._cache:
+            out: dict = {}
+            for i, lab in enumerate(self.graph.labels):
+                out.setdefault(int(self.assignment_array[i]), set()).add(lab)
+            self._cache["_parts"] = out
+        return self._cache["_parts"]
+
+    def __len__(self):
+        return len(self.parts)
+
+    # -- array-level helpers used by updaters/constraints -------------------
+
+    def cut_edge_mask(self) -> np.ndarray:
+        """bool[E]: incremental when a parent mask exists (single flips touch
+        only edges incident to flipped nodes)."""
+        if "_cut_mask" in self._cache:
+            return self._cache["_cut_mask"]
+        g, a = self.graph, self.assignment_array
+        if self.parent is not None and self.flips:
+            mask = self.parent.cut_edge_mask().copy()
+            for lab in self.flips:
+                i = g.index[lab]
+                d = int(g.deg[i])
+                eids = g.nbr_edge[i, :d]
+                mask[eids] = a[g.edges[eids, 0]] != a[g.edges[eids, 1]]
+        else:
+            mask = a[g.edges[:, 0]] != a[g.edges[:, 1]]
+        self._cache["_cut_mask"] = mask
+        return mask
+
+
+# ---------------------------------------------------------------------------
+# Updaters (gerrychain.updaters surface consumed at grid_chain_sec11.py:26,
+# 299-308, plus the script-defined updaters of lines 147-156)
+# ---------------------------------------------------------------------------
+
+def cut_edges(partition: Partition):
+    """Set of cut edges as (label_a, label_b) tuples in canonical edge-array
+    order (gerrychain returns arbitrary-ordered tuples; consumers treat them
+    as opaque pairs)."""
+    g = partition.graph
+    mask = partition.cut_edge_mask()
+    return {(g.labels[g.edges[e, 0]], g.labels[g.edges[e, 1]])
+            for e in np.nonzero(mask)[0]}
+
+
+class Tally:
+    """gerrychain.updaters.Tally('population'): district -> sum of node attr.
+
+    Node attributes live on the LatticeGraph ``pop`` array when col ==
+    'population'; other columns can be registered via ``columns``.
+    """
+
+    def __init__(self, col: str, alias: Optional[str] = None,
+                 columns: Optional[Dict[str, np.ndarray]] = None):
+        self.col = col
+        self.alias = alias or col
+        self.columns = columns or {}
+
+    def _values(self, g: LatticeGraph) -> np.ndarray:
+        if self.col in self.columns:
+            return np.asarray(self.columns[self.col])
+        if self.col == "population":
+            return g.pop
+        raise KeyError(f"Tally column {self.col!r} not registered")
+
+    def __call__(self, partition: Partition) -> dict:
+        vals = self._values(partition.graph)
+        key = "_tally_" + self.alias
+        if partition.parent is not None and partition.flips and \
+                key in partition.parent._cache:
+            out = dict(partition.parent._cache[key])
+            for lab in partition.flips:
+                i = partition.graph.index[lab]
+                old = int(partition.parent.assignment_array[i])
+                new = int(partition.assignment_array[i])
+                if old != new:
+                    out[old] = out.get(old, 0) - int(vals[i])
+                    out[new] = out.get(new, 0) + int(vals[i])
+        else:
+            out = {}
+            for i in range(partition.graph.n_nodes):
+                d = int(partition.assignment_array[i])
+                out[d] = out.get(d, 0) + int(vals[i])
+        partition._cache[key] = out
+        return out
+
+
+def b_nodes_bi(partition: Partition):
+    """Boundary-node set: all endpoints of cut edges
+    (grid_chain_sec11.py:155-156)."""
+    out = set()
+    for (u, v) in partition["cut_edges"]:
+        out.add(u)
+        out.add(v)
+    return out
+
+
+def b_nodes_pairs(partition: Partition):
+    """k-district boundary move set: {(node, other-side district)} pairs
+    (grid_chain_sec11.py:151-153)."""
+    out = set()
+    for (u, v) in partition["cut_edges"]:
+        out.add((u, partition.assignment[v]))
+        out.add((v, partition.assignment[u]))
+    return out
+
+
+def make_geom_wait(rng: np.random.Generator):
+    """The reference's geometric waiting-time updater
+    (grid_chain_sec11.py:147-148): Geometric(p) - 1 with
+    p = |b_nodes| / (n_nodes ** n_parts - 1). Memoized per partition by the
+    updater protocol — a rejected (self-loop) step re-reads the same sample,
+    which is load-bearing for wait-sum statistics parity."""
+
+    def geom(partition: Partition):
+        nb = len(partition["b_nodes"])
+        denom = partition.graph.n_nodes ** len(partition.parts) - 1
+        p = nb / denom
+        return int(rng.geometric(p)) - 1
+
+    return geom
+
+
+def make_boundary_slope(wall_of_edge):
+    """Wall-cut-edge collector (grid_chain_sec11.py:55-78): returns the cut
+    edges lying along the outer walls (and, for sec11, the four corner
+    diagonals). ``wall_of_edge(u_label, v_label) -> int`` classifies; -1 is
+    'not on a wall'. Returned deterministically ordered by canonical edge
+    index (the reference returns ``list(set(...))`` — arbitrary order — and
+    then consumes elements [0] and [1]; see kernel/metrics.py for how the
+    vectorized path mirrors this deterministic choice)."""
+
+    def slope(partition: Partition):
+        g = partition.graph
+        mask = partition.cut_edge_mask()
+        out = []
+        for e in np.nonzero(mask)[0]:
+            u, v = g.labels[g.edges[e, 0]], g.labels[g.edges[e, 1]]
+            if wall_of_edge(u, v) >= 0:
+                out.append((u, v))
+        return out
+
+    return slope
+
+
+def step_num(partition: Partition) -> int:
+    parent = partition.parent
+    if not parent:
+        return 0
+    return parent["step_num"] + 1

@@ -1,0 +1,60 @@
+"""Device mesh helpers: the chains axis is the framework's primary (and
+embarrassingly parallel) sharding dimension; replica-exchange ladders ride
+the same axis via collectives (SURVEY.md section 2.4).
+
+Multi-host: `initialize_distributed` wraps jax.distributed for DCN-connected
+pods; single-process multi-device (one host, n chips, or
+--xla_force_host_platform_device_count virtual CPUs) needs no setup.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CHAINS_AXIS = "chains"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = CHAINS_AXIS) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def chain_sharding(mesh: Mesh, axis: str = CHAINS_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_chain_batch(mesh: Mesh, tree, axis: str = CHAINS_AXIS):
+    """Place every leaf with a leading chains axis on the mesh (leading-axis
+    sharding); scalars/replicated leaves are broadcast."""
+    cs = chain_sharding(mesh, axis)
+    rep = replicated(mesh)
+
+    def place(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % mesh.devices.size == 0:
+            return jax.device_put(x, cs)
+        return jax.device_put(x, rep)
+
+    return jax.tree.map(place, tree)
+
+
+def initialize_distributed(coordinator: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host bring-up over DCN (no-op single-host)."""
+    if coordinator is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)

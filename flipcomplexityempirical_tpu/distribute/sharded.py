@@ -1,0 +1,119 @@
+"""shard_map'd training step: data-parallel chains + cross-device replica
+exchange over ICI.
+
+The full "training step" of this framework (the analogue of a model's
+fwd+bwd+optimizer): advance every chain ``inner_steps`` flips locally
+(zero communication), then run an even-odd replica-exchange round where the
+temperature ladder runs ALONG THE DEVICE AXIS — local chain i on device d is
+rung d of ladder i — so a swap is one `lax.ppermute` neighbor exchange of
+(cut_count, beta) vectors plus a select, riding ICI. Telemetry (aggregate
+accepts) reduces with `lax.psum`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from ..graphs.lattice import DeviceGraph
+from ..kernel import step as kstep
+from ..kernel.step import Spec, StepParams
+from ..state.chain_state import ChainState
+from .mesh import CHAINS_AXIS
+
+
+def _params_spec(sharded: bool):
+    p = P(CHAINS_AXIS) if sharded else P()
+    return StepParams(log_base=p, beta=p, pop_lo=p, pop_hi=p,
+                      label_values=P())
+
+
+def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
+                    exchange: bool = True):
+    """Build a jitted sharded train step:
+    (key, params, states) -> (params, states, info).
+
+    ``key`` is a replicated PRNG key for the swap rounds (chain-local
+    randomness lives inside ChainState.key). Swap decisions are computed
+    identically on both partners from the shared key.
+    """
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    paxes = StepParams.vmap_axes()
+    perms = []
+    for parity in (0, 1):
+        perm = []
+        for i in range(n_dev):
+            j = i + 1 if i % 2 == parity else i - 1
+            if 0 <= j < n_dev:
+                perm.append((i, j))
+        perms.append(tuple(perm))
+
+    def local_advance(params, states):
+        def body(states, _):
+            states = jax.vmap(
+                lambda p, s: kstep.transition(dg, spec, p, s),
+                in_axes=(paxes, 0))(params, states)
+            states, _ = jax.vmap(
+                lambda p, s: kstep.record(dg, spec, p, s),
+                in_axes=(paxes, 0))(params, states)
+            return states, ()
+        states, _ = jax.lax.scan(body, states, None, length=inner_steps)
+        return states
+
+    def swap_round(key, params, states, parity):
+        """Exchange betas with the neighbor device (ladder = device axis)."""
+        idx = jax.lax.axis_index(CHAINS_AXIS)
+        partner_exists = jnp.where(
+            idx % 2 == parity, idx + 1 < n_dev, idx - 1 >= 0)
+        cut = states.cut_count.astype(jnp.float32)
+        beta = params.beta
+        cut_p = jax.lax.ppermute(cut, CHAINS_AXIS, perms[parity])
+        beta_p = jax.lax.ppermute(beta, CHAINS_AXIS, perms[parity])
+        log_a = params.log_base * (beta - beta_p) * (cut - cut_p)
+        # shared uniform per unordered pair (pair id = lower device index),
+        # computed identically on both partners from the replicated key
+        pair_id = jnp.where(idx % 2 == parity, idx, idx - 1)
+        k = jax.random.fold_in(key, parity)
+        u = jax.vmap(lambda i: jax.random.uniform(
+            jax.random.fold_in(k, pair_id * beta.shape[0] + i)))(
+            jnp.arange(beta.shape[0]))
+        accept = partner_exists & (jnp.log(jnp.maximum(u, 1e-12)) < log_a)
+        new_beta = jnp.where(accept, beta_p, beta)
+        return params.replace(beta=new_beta), accept.sum()
+
+    pspec = _params_spec(sharded=True)
+    state_spec = jax.tree.map(lambda _: P(CHAINS_AXIS), states_struct())
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), pspec, state_spec),
+        out_specs=(pspec, state_spec, P()),
+        check_vma=False)
+    def train_step(key, params, states):
+        states = local_advance(params, states)
+        swaps = jnp.int32(0)
+        if exchange and n_dev > 1:
+            params, s0 = swap_round(key, params, states, 0)
+            params, s1 = swap_round(key, params, states, 1)
+            swaps = s0 + s1
+        info = {
+            "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),
+            "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
+        }
+        return params, states, info
+
+    return jax.jit(train_step)
+
+
+def states_struct():
+    """A ChainState of leaf placeholders for building PartitionSpec trees."""
+    return ChainState(
+        key=0, assignment=0, cut=0, cut_deg=0, dist_pop=0, cut_count=0,
+        b_count=0, cur_wait=0, cur_flip_node=0, t_yield=0, part_sum=0,
+        last_flipped=0, num_flips=0, cut_times=0, waits_sum=0,
+        accept_count=0, tries_sum=0, exhausted_count=0)

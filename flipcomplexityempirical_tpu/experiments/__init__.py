@@ -1,0 +1,16 @@
+from .config import (
+    ExperimentConfig, sec11_sweep, frank_sweep, MU,
+    SEC11_BASES, SEC11_POPS, FRANK_BASES, FRANK_POPS,
+)
+from .driver import (
+    run_config, run_sweep, is_done, build_graph_and_plan,
+    save_checkpoint, load_checkpoint,
+)
+from .artifacts import ARTIFACT_KINDS
+
+__all__ = [
+    "ExperimentConfig", "sec11_sweep", "frank_sweep", "MU",
+    "SEC11_BASES", "SEC11_POPS", "FRANK_BASES", "FRANK_POPS",
+    "run_config", "run_sweep", "is_done", "build_graph_and_plan",
+    "save_checkpoint", "load_checkpoint", "ARTIFACT_KINDS",
+]

@@ -1,0 +1,40 @@
+"""CLI: python -m flipcomplexityempirical_tpu.experiments
+         --family sec11 --out plots/sec11 [--steps N] [--backend jax]
+         [--only 2B30P10 ...]
+
+Runs the reference sweep grids with skip-if-done resume, emitting the
+13-artifact set per config with reference-compatible filenames.
+"""
+
+import argparse
+
+from .config import sec11_sweep, frank_sweep
+from .driver import run_sweep
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--family", choices=["sec11", "frank"], required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--steps", type=int, default=100_000)
+    ap.add_argument("--chains", type=int, default=8)
+    ap.add_argument("--backend", choices=["jax", "python"], default="jax")
+    ap.add_argument("--contiguity", choices=["patch", "exact"],
+                    default="patch")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--only", nargs="*", default=None,
+                    help="config tags to run, e.g. 2B30P10")
+    args = ap.parse_args()
+
+    sweep = sec11_sweep if args.family == "sec11" else frank_sweep
+    configs = list(sweep(total_steps=args.steps, n_chains=args.chains,
+                         backend=args.backend, contiguity=args.contiguity,
+                         seed=args.seed))
+    if args.only:
+        configs = [c for c in configs if c.tag in set(args.only)]
+    run_sweep(configs, args.out, checkpoint_dir=args.checkpoint_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""The 13-artifact-per-config pipeline, byte-compatible filenames.
+
+Reproduces grid_chain_sec11.py:321-324,410-411,427-528 /
+Frankenstein_chain.py:349-352,438-439,455-556: per config
+{tag}start/edges/end/end2/wca/wca2/slope/angle/flip/flip2/logflip/logflip2
+.png + {tag}wait.txt, with the reference's exact visual conventions
+(node shapes, cmaps, node sizes, ylim, imshow index layout).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from ..graphs.lattice import LatticeGraph
+
+
+def _nx_graph(graph: LatticeGraph):
+    import networkx as nx
+    g = nx.Graph()
+    g.add_nodes_from(graph.labels)
+    for (a, b) in graph.edges:
+        g.add_edge(graph.labels[a], graph.labels[b])
+    return g
+
+
+def _draw_nodes(graph, values, path, node_size, cmap="tab20"):
+    import networkx as nx
+    g = _nx_graph(graph)
+    plt.figure()
+    nx.draw(g, pos={x: x for x in graph.labels},
+            node_color=[values[graph.index[x]] for x in graph.labels],
+            node_size=node_size, node_shape="s", cmap=cmap)
+    plt.savefig(path)
+    plt.close()
+
+
+def _draw_edges(graph, edge_values, path):
+    import networkx as nx
+    g = _nx_graph(graph)
+    colors = {}
+    for e in range(graph.n_edges):
+        u = graph.labels[graph.edges[e, 0]]
+        v = graph.labels[graph.edges[e, 1]]
+        colors[frozenset((u, v))] = edge_values[e]
+    plt.figure()
+    nx.draw(g, pos={x: x for x in graph.labels},
+            node_color=[0 for _ in graph.labels], node_size=10,
+            edge_color=[colors[frozenset(e)] for e in g.edges()],
+            node_shape="s", cmap="jet", width=5)
+    plt.savefig(path)
+    plt.close()
+
+
+def _imshow(graph, family, values, path):
+    # sec11: A2[40,40], A2[x,y] (grid_chain_sec11.py:440-443)
+    # frank: A2[20,40], A2[x,y+19] (Frankenstein_chain.py:468-471)
+    if family == "frank":
+        a2 = np.zeros([20, 40])
+        off = 19
+    else:
+        a2 = np.zeros([40, 40])
+        off = 0
+    for i, (x, y) in enumerate(graph.labels):
+        a2[x, y + off] = values[i]
+    plt.figure()
+    plt.imshow(a2, cmap="jet")
+    plt.colorbar()
+    plt.savefig(path)
+    plt.close()
+
+
+def _lineplot(series, path, title, ylim=None):
+    plt.figure()
+    plt.title(title)
+    plt.plot(series)
+    if ylim is not None:
+        plt.ylim(ylim)
+    plt.savefig(path)
+    plt.close()
+
+
+def render_start(graph, family, outdir, tag, start_signed, node_size):
+    _draw_nodes(graph, start_signed,
+                os.path.join(outdir, tag + "start.png"), node_size)
+
+
+def render_all(graph: LatticeGraph, family: str, outdir: str, tag: str, *,
+               end_signed, cut_times, part_sum, num_flips, slopes, angles,
+               waits_sum, node_size):
+    """Render the 12 post-run artifacts + wait.txt (start.png is rendered
+    before the run, as the reference does at grid_chain_sec11.py:321-324)."""
+    os.makedirs(outdir, exist_ok=True)
+    j = lambda kind: os.path.join(outdir, tag + kind)
+
+    with open(j("wait.txt"), "w") as f:
+        f.write(str(int(round(waits_sum))))
+
+    lognum = np.array([math.log(n + 1) for n in num_flips])
+
+    _draw_edges(graph, cut_times, j("edges.png"))
+    _draw_nodes(graph, end_signed, j("end.png"), node_size)
+    _imshow(graph, family, end_signed, j("end2.png"))
+    _draw_nodes(graph, part_sum, j("wca.png"), node_size, cmap="jet")
+    _imshow(graph, family, part_sum, j("wca2.png"))
+    _lineplot(slopes, j("slope.png"), "Slopes")
+    _lineplot(angles, j("angle.png"), "Angle", ylim=[0, 6.3])
+    _draw_nodes(graph, num_flips, j("flip.png"), node_size, cmap="jet")
+    _imshow(graph, family, num_flips, j("flip2.png"))
+    _draw_nodes(graph, lognum, j("logflip.png"), node_size, cmap="jet")
+    _imshow(graph, family, lognum, j("logflip2.png"))
+
+
+ARTIFACT_KINDS = ["start.png", "edges.png", "end.png", "end2.png",
+                  "wca.png", "wca2.png", "slope.png", "angle.png",
+                  "flip.png", "flip2.png", "logflip.png", "logflip2.png",
+                  "wait.txt"]

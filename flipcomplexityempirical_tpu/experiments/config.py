@@ -1,0 +1,63 @@
+"""Experiment configuration: the reference's sweep grids as data.
+
+The reference encodes configuration in module constants + nested loops +
+output filenames (SURVEY.md section 5 'Config / flag system';
+grid_chain_sec11.py:33-36,182-184). Here a config is a dataclass; the
+filename tag is byte-compatible: ``{alignment}B{int(100*base)}P{int(100*pop)}``
+(grid_chain_sec11.py:323) — note int() truncation, e.g. 1/0.3 -> "333",
+mu -> "263".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Iterator
+
+MU = 2.63815853  # Z^2 SAW connective constant (grid_chain_sec11.py:33)
+
+SEC11_BASES = [.1, 1 / MU ** 2, .2, 1 / MU, .8, 1, MU, 4, MU ** 2, 10]
+SEC11_POPS = [.01, .05, .1, .5, .9]
+FRANK_BASES = [.3, 1 / .3]
+FRANK_POPS = [.05, .1, .5, .9]
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    family: str               # 'sec11' | 'frank'
+    alignment: int            # 0 | 1 | 2
+    base: float
+    pop_tol: float
+    total_steps: int = 100_000
+    n_chains: int = 8         # reference runs 1; chain 0 renders artifacts
+    seed: int = 0
+    backend: str = "jax"      # 'jax' | 'python'
+    contiguity: str = "patch"  # 'patch' | 'exact'
+    accept: str = "cut"       # 'cut' | 'corrected'
+
+    @property
+    def tag(self) -> str:
+        return (f"{self.alignment}B{int(100 * self.base)}"
+                f"P{int(100 * self.pop_tol)}")
+
+    @property
+    def plot_node_size(self) -> int:
+        # grid_chain_sec11.py:188 ns=120; Frankenstein_chain.py:37 ns=500
+        return 120 if self.family == "sec11" else 500
+
+
+def sec11_sweep(**overrides) -> Iterator[ExperimentConfig]:
+    """The 150-config sec11 grid (grid_chain_sec11.py:182-184; alignment
+    iterates [2,1,0])."""
+    for pop, base, al in itertools.product(SEC11_POPS, SEC11_BASES,
+                                           [2, 1, 0]):
+        yield ExperimentConfig(family="sec11", alignment=al, base=base,
+                               pop_tol=pop, **overrides)
+
+
+def frank_sweep(**overrides) -> Iterator[ExperimentConfig]:
+    """The 24-config Frankengraph grid (Frankenstein_chain.py:182-184)."""
+    for pop, base, al in itertools.product(FRANK_POPS, FRANK_BASES,
+                                           [2, 1, 0]):
+        yield ExperimentConfig(family="frank", alignment=al, base=base,
+                               pop_tol=pop, **overrides)

@@ -1,0 +1,226 @@
+"""Per-config experiment driver: the reference's measurement loop + artifact
+emission on either backend.
+
+- backend='jax': batched kernel chains (chain 0 renders the reference
+  artifact set; the full batch feeds stats/ diagnostics).
+- backend='python': the compat oracle running the literal reference loop
+  (grid_chain_sec11.py:360-411) — the 'existing pure-Python runner' of the
+  BASELINE.json north star.
+
+Completion manifest: a config is done when all 13 artifacts exist
+(ARTIFACT_KINDS); ``run_sweep`` skips completed configs, which upgrades the
+reference's crash story (SURVEY.md section 5 'Failure detection': artifacts
+on disk were the de-facto resume state, but the scripts always redid
+everything).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import compat
+from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
+                      PARITY_LABELS)
+from ..kernel.step import Spec, finalize_host
+from ..sampling import init_batch, run_chains
+from .artifacts import ARTIFACT_KINDS, render_all, render_start
+from .config import ExperimentConfig
+
+
+def build_graph_and_plan(cfg: ExperimentConfig):
+    if cfg.family == "sec11":
+        g = grid_sec11()
+        plan = sec11_plan(g, cfg.alignment)
+    elif cfg.family == "frank":
+        g = frankengraph()
+        plan = frank_plan(g, cfg.alignment)
+    else:
+        raise ValueError(f"family {cfg.family!r}")
+    return g, plan
+
+
+def is_done(cfg: ExperimentConfig, outdir: str) -> bool:
+    return all(os.path.exists(os.path.join(outdir, cfg.tag + k))
+               for k in ARTIFACT_KINDS)
+
+
+def run_config(cfg: ExperimentConfig, outdir: str,
+               checkpoint_dir: Optional[str] = None) -> dict:
+    os.makedirs(outdir, exist_ok=True)
+    g, plan = build_graph_and_plan(cfg)
+    signed = PARITY_LABELS[plan]
+    render_start(g, cfg.family, outdir, cfg.tag, signed, cfg.plot_node_size)
+    t0 = time.time()
+    if cfg.backend == "jax":
+        data = _run_jax(cfg, g, plan, checkpoint_dir)
+    elif cfg.backend == "python":
+        data = _run_python(cfg, g, plan)
+    else:
+        raise ValueError(f"backend {cfg.backend!r}")
+    data["seconds"] = time.time() - t0
+    render_all(g, cfg.family, outdir, cfg.tag,
+               end_signed=data["end_signed"], cut_times=data["cut_times"],
+               part_sum=data["part_sum"], num_flips=data["num_flips"],
+               slopes=data["slopes"], angles=data["angles"],
+               waits_sum=data["waits_sum"], node_size=cfg.plot_node_size)
+    return data
+
+
+def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None) -> dict:
+    spec = Spec(n_districts=2, proposal="bi", contiguity=cfg.contiguity,
+                invalid="repropose", accept=cfg.accept,
+                record_interface=True, parity_metrics=True, geom_waits=True)
+    dg, states, params = init_batch(
+        g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
+        base=cfg.base, pop_tol=cfg.pop_tol)
+    res = run_chains(dg, spec, params, states, n_steps=cfg.total_steps)
+    s = res.host_state()
+    t_final = cfg.total_steps  # reference t after the loop (line 402)
+    c0 = type(s)(**{f: np.asarray(getattr(s, f))[0]
+                    for f in s.__dataclass_fields__})
+    part_sum, _ = finalize_host(c0, np.asarray(PARITY_LABELS), t_final)
+    if checkpoint_dir:
+        save_checkpoint(checkpoint_dir, cfg, s)
+    return {
+        "end_signed": np.asarray(PARITY_LABELS)[
+            np.asarray(c0.assignment, dtype=np.int64)],
+        "cut_times": np.asarray(c0.cut_times),
+        "part_sum": part_sum,
+        "num_flips": np.asarray(c0.num_flips),
+        "slopes": res.history["slope"][0],
+        "angles": res.history["angle"][0],
+        "waits_sum": float(res.waits_total[0]),
+        "history": res.history,
+        "waits_all": res.waits_total,
+        "state": s,
+    }
+
+
+def make_wall_lookup(g):
+    table = {}
+    for e in range(g.n_edges):
+        u = g.labels[g.edges[e, 0]]
+        v = g.labels[g.edges[e, 1]]
+        table[frozenset((u, v))] = int(g.wall_id[e])
+    return lambda u, v: table.get(frozenset((u, v)), -1)
+
+
+def _run_python(cfg: ExperimentConfig, g, plan) -> dict:
+    """The literal reference loop on the compat oracle."""
+    rng = np.random.default_rng(cfg.seed)
+    signed = {lab: int(PARITY_LABELS[plan[i]])
+              for i, lab in enumerate(g.labels)}
+    wall = make_wall_lookup(g)
+    updaters = {
+        "population": compat.Tally("population"),
+        "cut_edges": compat.cut_edges,
+        "b_nodes": compat.b_nodes_bi,
+        "base": lambda p: cfg.base,
+        "geom": compat.make_geom_wait(rng),
+        "slope": compat.make_boundary_slope(wall),
+        "step_num": compat.step_num,
+    }
+    part = compat.Partition(g, signed, updaters)
+    popbound = compat.within_percent_of_ideal_population(part, cfg.pop_tol)
+    accept = (compat.make_cut_accept(rng) if cfg.accept == "cut"
+              else compat.make_corrected_cut_accept(rng))
+    chain = compat.MarkovChain(
+        compat.make_reversible_propose_bi(rng),
+        compat.Validator([compat.single_flip_contiguous, popbound]),
+        accept, part, cfg.total_steps)
+
+    n = g.n_nodes
+    cut_times = np.zeros(g.n_edges, np.int64)
+    part_sum = np.array([signed[lab] for lab in g.labels], np.int64)
+    last_flipped = np.zeros(n, np.int64)
+    num_flips = np.zeros(n, np.int64)
+    waits = []
+    slopes, angles = [], []
+    cut_hist, b_hist = [], []
+    center = np.asarray(g.center)
+
+    t = 0
+    for p in chain:
+        cut_hist.append(len(p["cut_edges"]))
+        waits.append(p["geom"])
+        b_hist.append(len(p["b_nodes"]))
+        temp = p["slope"]
+        if len(temp) >= 2:
+            enda = ((temp[0][0][0] + temp[0][1][0]) / 2,
+                    (temp[0][0][1] + temp[0][1][1]) / 2)
+            endb = ((temp[1][0][0] + temp[1][1][0]) / 2,
+                    (temp[1][0][1] + temp[1][1][1]) / 2)
+            slopes.append((endb[1] - enda[1]) / (endb[0] - enda[0])
+                          if endb[0] != enda[0] else np.inf)
+            va = np.asarray(enda) - center
+            vb = np.asarray(endb) - center
+            angles.append(float(np.arccos(np.clip(
+                np.dot(va / np.linalg.norm(va), vb / np.linalg.norm(vb)),
+                -1, 1))))
+        else:  # reference would IndexError here; we record NaN and survive
+            slopes.append(np.nan)
+            angles.append(np.nan)
+        mask = p.cut_edge_mask()
+        cut_times += mask
+        if p.flips is not None:
+            lab = next(iter(p.flips))
+            f = g.index[lab]
+            part_sum[f] -= p.assignment[lab] * (t - last_flipped[f])
+            last_flipped[f] = t
+            num_flips[f] += 1
+        t += 1
+
+    a = p.assignment_array
+    never = last_flipped == 0
+    part_sum[never] = t * a[never]
+    return {
+        "end_signed": a.copy(),
+        "cut_times": cut_times,
+        "part_sum": part_sum,
+        "num_flips": num_flips,
+        "slopes": np.asarray(slopes),
+        "angles": np.asarray(angles),
+        "waits_sum": float(sum(waits)),
+        "history": {"cut_count": np.asarray(cut_hist)[None, :],
+                    "b_count": np.asarray(b_hist)[None, :],
+                    "wait": np.asarray(waits, dtype=float)[None, :]},
+        "waits_all": np.asarray([float(sum(waits))]),
+        "state": None,
+    }
+
+
+def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state):
+    os.makedirs(ckpt_dir, exist_ok=True)
+    arrays = {f: np.asarray(getattr(host_state, f))
+              for f in host_state.__dataclass_fields__}
+    np.savez_compressed(os.path.join(ckpt_dir, cfg.tag + ".npz"), **arrays)
+
+
+def load_checkpoint(ckpt_dir: str, cfg: ExperimentConfig):
+    path = os.path.join(ckpt_dir, cfg.tag + ".npz")
+    if not os.path.exists(path):
+        return None
+    return dict(np.load(path))
+
+
+def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
+              verbose: bool = True) -> list:
+    """Sweep with skip-if-done resume (per-config completion manifest)."""
+    results = []
+    for cfg in configs:
+        if is_done(cfg, outdir):
+            if verbose:
+                print(f"[skip] {cfg.family} {cfg.tag} (artifacts complete)")
+            continue
+        t0 = time.time()
+        data = run_config(cfg, outdir, checkpoint_dir)
+        if verbose:
+            print(f"[done] {cfg.family} {cfg.tag} "
+                  f"waits={data['waits_sum']:.4g} "
+                  f"({time.time() - t0:.1f}s)")
+        results.append((cfg, data))
+    return results

@@ -106,12 +106,16 @@ def triangular_lattice(m: int, n: int, *, name: str | None = None,
 
 def hex_lattice(m: int, n: int, *, name: str | None = None,
                 frame=None, wall=None, center=None) -> LatticeGraph:
-    """Hexagonal lattice (degree <= 3 planar adjacency)."""
+    """Hexagonal lattice (degree <= 3 planar adjacency). Patch radius 3:
+    neighbors of a flipped node reconnect around a hexagonal face through
+    distance-3 nodes, so the radius-2 default would falsely reject valid
+    flips."""
     import networkx as nx
 
     g = nx.hexagonal_lattice_graph(m, n)
     return from_networkx(g, name=name or f"hex{m}x{n}", frame=frame,
-                         wall=wall, center=center or _label_center(g.nodes()))
+                         wall=wall, center=center or _label_center(g.nodes()),
+                         patch_radius=3)
 
 
 def frankengraph(m: int = 20) -> LatticeGraph:

@@ -12,8 +12,9 @@ frozen padded arrays uploaded to device once:
                 deltas by construction.
 - ``nbr_edge``: ``int32[N, D]`` edge index per neighbor slot (pad 0; always
                 used together with ``nbr_mask`` so pad slots scatter zeros).
-- patch tables (``patch_nodes``, ``patch_adj``, sizes): a per-node radius-2
-  ball encoded as <=32-node bitset adjacency, used by the O(P^2) local
+- patch tables (``patch_nodes``, ``patch_adj``, sizes): a per-node radius-r
+  ball (r=2 default; 3 for hex lattices, see builders.hex_lattice) encoded
+  as <=32-node bitset adjacency, used by the O(P^2) local
   contiguity check (kernel/contiguity.py). The local check is *sufficient*
   (patch-connected => flip keeps the district connected) but not necessary:
   a district connected only around a long detour fails it. It is exact for
@@ -44,7 +45,7 @@ from flax import struct
 
 import jax.numpy as jnp
 
-# Patch bitsets are uint32 words: a radius-2 ball larger than 32 nodes cannot
+# Patch bitsets are uint32 words: a patch ball larger than 32 nodes cannot
 # be encoded and the graph falls back to the exact (BFS) contiguity checker.
 MAX_PATCH = 32
 
@@ -69,6 +70,7 @@ class DeviceGraph:
     patch_nodes: jnp.ndarray  # int32[N, P], pad = self
     patch_adj: jnp.ndarray    # uint32[N, P] bitset adjacency within patch
     patch_size: jnp.ndarray   # int32[N]
+    center: jnp.ndarray       # float32[2] angle-metric center
 
     @property
     def n_nodes(self) -> int:
@@ -150,6 +152,7 @@ class LatticeGraph:
                 patch_nodes=jnp.asarray(self.patch_nodes, jnp.int32),
                 patch_adj=jnp.asarray(self.patch_adj, jnp.uint32),
                 patch_size=jnp.asarray(self.patch_size, jnp.int32),
+                center=jnp.asarray(self.center, jnp.float32),
             )
             object.__setattr__(self, "_device", dg)
         return dg
@@ -188,6 +191,7 @@ def build_lattice(
     wall: Optional[Callable[[Any, Any], int]] = None,
     center: tuple = (20.0, 20.0),
     node_order: Optional[Sequence] = None,
+    patch_radius: int = 2,
 ) -> LatticeGraph:
     """Build a LatticeGraph from a plain adjacency dict {label: iterable}.
 
@@ -231,20 +235,28 @@ def build_lattice(
             nbr_mask[i, s] = True
             nbr_edge[i, s] = ei
 
-    # --- radius-2 patch bitsets for the local contiguity check ------------
+    # --- radius-r patch bitsets for the local contiguity check ------------
     # patch order: neighbors first (same order as nbr slots) so the "seed"
-    # bits of the check are simply bits [0, deg).
+    # bits of the check are simply bits [0, deg). The radius must cover half
+    # of the largest face so that same-district neighbors of a flipped node
+    # can reconnect around a face inside the patch: 2 for square/triangular
+    # faces, 3 for hexagonal faces.
     patch_lists: list[list[int]] = []
     for i in range(n):
         first = [j for (j, _) in adj_idx[i]]
         seen = {i, *first}
-        second = []
-        for j in first:
-            for (k2, _) in adj_idx[j]:
-                if k2 not in seen:
-                    seen.add(k2)
-                    second.append(k2)
-        patch_lists.append(first + second)
+        ordered = list(first)
+        frontier = first
+        for _ in range(patch_radius - 1):
+            nxt = []
+            for j in frontier:
+                for (k2, _) in adj_idx[j]:
+                    if k2 not in seen:
+                        seen.add(k2)
+                        nxt.append(k2)
+            ordered.extend(nxt)
+            frontier = nxt
+        patch_lists.append(ordered)
     p = max((len(pl) for pl in patch_lists), default=0)
     patch_ok = p <= MAX_PATCH
     if not patch_ok:

@@ -1,0 +1,10 @@
+from .step import (
+    Spec, StepParams, make_params, transition, record, propose,
+    sample_geom_minus1, interface_metrics, finalize_host,
+)
+from . import contiguity
+
+__all__ = [
+    "Spec", "StepParams", "make_params", "transition", "record", "propose",
+    "sample_geom_minus1", "interface_metrics", "finalize_host", "contiguity",
+]

@@ -1,0 +1,108 @@
+"""Vectorized single-flip contiguity checks.
+
+The data-dependent graph search of gerrychain's ``single_flip_contiguous``
+(the dominant per-step cost of the reference chain, SURVEY.md section 3.2)
+becomes one of two TPU-friendly forms:
+
+- ``patch_connected``: O(P) bitset label propagation inside the flipped
+  node's precomputed radius-r ball (r=2, or 3 for hex faces; P <= 32,
+  uint32 words; see
+  graphs/lattice.py). Sufficient always; exact iff the origin district is
+  simply connected — the common case on these lattices, validated
+  empirically against the exact check in tests.
+- ``exact_connected``: masked frontier expansion over the whole graph
+  (lax.while_loop), gerrychain-equivalent on any graph, used as the oracle
+  and for parity-grade runs.
+
+Both return True when the flipped node has <= 1 same-district neighbor,
+matching the oracle's vacuous-singleton semantics
+(compat/chain.py::single_flip_contiguous).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graphs.lattice import DeviceGraph
+
+
+def _or_reduce_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Bitwise-or reduction of a 1-D uint32 vector."""
+    return jax.lax.reduce(x, jnp.uint32(0), jax.lax.bitwise_or, (0,))
+
+
+def patch_connected(dg: DeviceGraph, assignment: jnp.ndarray,
+                    v: jnp.ndarray, d_origin: jnp.ndarray) -> jnp.ndarray:
+    """True iff v's d_origin neighbors stay mutually connected within the
+    precomputed patch after removing v (=> the flip cannot disconnect the
+    origin district)."""
+    p = dg.max_patch
+    pn = dg.patch_nodes[v]                      # i32[P], pad = v
+    padj = dg.patch_adj[v]                      # u32[P]
+    slots = jnp.arange(p, dtype=jnp.int32)
+    member = (assignment[pn].astype(jnp.int32) == d_origin) & (pn != v)
+    member_word = jnp.sum(
+        jnp.where(member, jnp.uint32(1) << slots.astype(jnp.uint32), 0),
+        dtype=jnp.uint32)
+    # neighbors occupy the first deg slots of the patch (builder invariant)
+    seed_mask = member & (slots < dg.deg[v])
+    seed_word = jnp.sum(
+        jnp.where(seed_mask, jnp.uint32(1) << slots.astype(jnp.uint32), 0),
+        dtype=jnp.uint32)
+    n_seeds = seed_mask.sum()
+
+    start = seed_word & (~seed_word + jnp.uint32(1))  # lowest set bit
+
+    def body(_, reach):
+        sel = (reach >> slots.astype(jnp.uint32)) & jnp.uint32(1)
+        contrib = jnp.where(sel.astype(bool), padj, jnp.uint32(0))
+        return reach | (_or_reduce_u32(contrib) & member_word)
+
+    reach = jax.lax.fori_loop(0, p, body, start)
+    all_reached = (seed_word & ~reach) == 0
+    return jnp.where(n_seeds <= 1, True, all_reached)
+
+
+def exact_connected(dg: DeviceGraph, assignment: jnp.ndarray,
+                    v: jnp.ndarray, d_origin: jnp.ndarray) -> jnp.ndarray:
+    """gerrychain-exact check: BFS within the origin district minus v, from
+    one of v's origin-district neighbors, until all of them are reached or
+    the frontier dies."""
+    n = dg.n_nodes
+    a = assignment.astype(jnp.int32)
+    nb = dg.nbr[v]                               # i32[D], pad = v
+    seed_slots = (a[nb] == d_origin) & dg.nbr_mask[v]
+    n_seeds = seed_slots.sum()
+
+    targets = jnp.zeros(n, bool).at[nb].max(seed_slots)
+    targets = targets.at[v].set(False)  # pad slots wrote to v
+    district = (a == d_origin) & (jnp.arange(n) != v)
+
+    start = nb[jnp.argmax(seed_slots)]
+    visited0 = jnp.zeros(n, bool).at[start].set(True)
+
+    def cond(carry):
+        visited, changed = carry
+        return changed & jnp.any(targets & ~visited)
+
+    def body(carry):
+        visited, _ = carry
+        nbr_hit = (visited[dg.nbr] & dg.nbr_mask).any(axis=1)
+        new = visited | (nbr_hit & district)
+        return new, jnp.any(new != visited)
+
+    visited, _ = jax.lax.while_loop(cond, body, (visited0, jnp.bool_(True)))
+    all_reached = ~jnp.any(targets & ~visited)
+    return jnp.where(n_seeds <= 1, True, all_reached)
+
+
+def check(dg: DeviceGraph, assignment: jnp.ndarray, v: jnp.ndarray,
+          d_origin: jnp.ndarray, mode: str) -> jnp.ndarray:
+    if mode == "patch":
+        return patch_connected(dg, assignment, v, d_origin)
+    if mode == "exact":
+        return exact_connected(dg, assignment, v, d_origin)
+    if mode == "none":
+        return jnp.bool_(True)
+    raise ValueError(f"contiguity mode {mode!r}")

@@ -1,0 +1,330 @@
+"""The jit-compiled flip-chain transition: one yield of the reference chain.
+
+This is the TPU replacement for the whole gerrychain hot loop (SURVEY.md
+section 3.2: propose uniform boundary flip -> validate contiguity+population
+-> Metropolis accept -> incremental updater refresh). Everything is O(N) or
+O(max_deg) per chain with no host interaction; the runner vmaps it over a
+chains axis and scans it over steps.
+
+Semantics parity notes (each is load-bearing for replication targets):
+- invalid proposals re-propose WITHOUT consuming a step (gerrychain
+  MarkovChain semantics; bounded here by ``max_tries`` with telemetry).
+- the literal acceptance ``base**(-dcut)`` omits the |b_nodes| reversibility
+  correction exactly as grid_chain_sec11.py:171-179 does; spec.accept =
+  'corrected' enables the dead-code correction of line 99.
+- the geometric wait is memoized per state: rejected steps re-record the
+  same sample (gerrychain updater memoization, grid_chain_sec11.py:147-148).
+- on every yield the last-accepted flip node's bookkeeping is re-applied
+  (num_flips/part_sum/last_flipped, grid_chain_sec11.py:396-400 — the
+  reference re-increments on self-loop yields because part.flips points at
+  the move that created the current state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..graphs.lattice import DeviceGraph
+from ..state.chain_state import ChainState
+from . import contiguity
+
+
+@dataclasses.dataclass(frozen=True)
+class Spec:
+    """Static kernel configuration (hashable; part of the jit cache key)."""
+
+    n_districts: int = 2
+    proposal: str = "bi"          # 'bi' (2-district sign flip) | 'pair'
+    contiguity: str = "patch"     # 'patch' | 'exact' | 'none'
+    invalid: str = "repropose"    # 'repropose' | 'selfloop'
+    accept: str = "cut"           # 'cut' | 'corrected' | 'always'
+    max_tries: int = 256          # re-propose cap per step
+    record_interface: bool = False  # slope/angle wall metrics
+    parity_metrics: bool = True   # reference-exact accumulator quirks
+    geom_waits: bool = True       # sample geometric waiting times
+    record_assignment_bits: bool = False  # pack 2-district state to uint32
+                                          # per yield (graphs with N<=32;
+                                          # exact-distribution tests)
+
+
+@struct.dataclass
+class StepParams:
+    """Per-chain runtime parameters (a pytree; leading chains axis under
+    vmap for everything except label_values)."""
+
+    log_base: jnp.ndarray   # f32 scalar: log of the Metropolis base
+    beta: jnp.ndarray       # f32 scalar: inverse-temperature multiplier
+    pop_lo: jnp.ndarray     # f32 scalar: district population lower bound
+    pop_hi: jnp.ndarray     # f32 scalar: upper bound
+    label_values: jnp.ndarray  # i32[K]: district -> reference +1/-1 label
+
+    @classmethod
+    def vmap_axes(cls):
+        return cls(log_base=0, beta=0, pop_lo=0, pop_hi=0, label_values=None)
+
+
+def make_params(base, pop_lo, pop_hi, label_values, beta=1.0, n_chains=None):
+    """Broadcast scalars to per-chain arrays when n_chains is given."""
+    def rep(x):
+        x = jnp.asarray(x, jnp.float32)
+        if n_chains is not None and x.ndim == 0:
+            x = jnp.broadcast_to(x, (n_chains,))
+        return x
+    return StepParams(
+        log_base=rep(jnp.log(jnp.asarray(base, jnp.float32))),
+        beta=rep(beta), pop_lo=rep(pop_lo), pop_hi=rep(pop_hi),
+        label_values=jnp.asarray(label_values, jnp.int32))
+
+
+def sample_geom_minus1(key, b_count, n_nodes: int, k: int):
+    """The reference waiting-time sample (grid_chain_sec11.py:147-148):
+    Geometric(p) - 1 with p = |b_nodes| / (n_nodes**k - 1), via inverse CDF.
+    """
+    denom = jnp.float32(float(n_nodes) ** k - 1.0)
+    p = b_count.astype(jnp.float32) / denom
+    u = jnp.maximum(jax.random.uniform(key), jnp.float32(1e-12))
+    w = jnp.floor(jnp.log(u) / jnp.log1p(-p))
+    return jnp.maximum(w, 0.0).astype(jnp.float32)
+
+
+def _sample_bi(key, state: ChainState):
+    """Uniform over boundary nodes (masked-argmax of iid uniforms), flip to
+    the other district (grid_chain_sec11.py:132-145)."""
+    b_mask = state.cut_deg > 0
+    u = jax.random.uniform(key, b_mask.shape)
+    v = jnp.argmax(jnp.where(b_mask, u, -1.0)).astype(jnp.int32)
+    d_from = state.assignment[v].astype(jnp.int32)
+    return v, 1 - d_from, b_mask[v]
+
+
+def _sample_pair(key, dg: DeviceGraph, state: ChainState, k: int):
+    """Uniform over distinct (boundary node, neighboring district) pairs
+    (grid_chain_sec11.py:117-130, the k-district move set)."""
+    a = state.assignment.astype(jnp.int32)
+    nbr_a = a[dg.nbr]                                        # (N, D)
+    onehot = jax.nn.one_hot(nbr_a, k, dtype=jnp.bool_)       # (N, D, K)
+    onehot = onehot & dg.nbr_mask[:, :, None]
+    has_part = onehot.any(axis=1)                            # (N, K)
+    pair_mask = has_part & (jnp.arange(k)[None, :] != a[:, None])
+    u = jax.random.uniform(key, pair_mask.shape)
+    idx = jnp.argmax(jnp.where(pair_mask, u, -1.0))
+    v = (idx // k).astype(jnp.int32)
+    d_to = (idx % k).astype(jnp.int32)
+    return v, d_to, pair_mask.reshape(-1)[idx]
+
+
+def _validate(dg: DeviceGraph, spec: Spec, params: StepParams,
+              state: ChainState, v, d_to, sampled_ok):
+    """Population bounds + contiguity for a tentative flip of v to d_to."""
+    d_from = state.assignment[v].astype(jnp.int32)
+    popv = dg.pop[v]
+    pop_from_new = (state.dist_pop[d_from] - popv).astype(jnp.float32)
+    pop_to_new = (state.dist_pop[d_to] + popv).astype(jnp.float32)
+    ok = sampled_ok & (d_to != d_from)
+    ok &= pop_from_new >= params.pop_lo
+    ok &= pop_to_new <= params.pop_hi
+    conn = contiguity.check(dg, state.assignment, v, d_from, spec.contiguity)
+    return ok & conn
+
+
+def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
+            state: ChainState, key):
+    """Draw a proposal per the invalid-move policy. Returns
+    (v, d_to, valid, tries)."""
+    k = spec.n_districts
+
+    def draw(key):
+        if spec.proposal == "bi":
+            if k != 2:
+                raise ValueError("proposal 'bi' requires n_districts == 2")
+            v, d_to, ok = _sample_bi(key, state)
+        elif spec.proposal == "pair":
+            v, d_to, ok = _sample_pair(key, dg, state, k)
+        else:
+            raise ValueError(f"proposal {spec.proposal!r}")
+        return v, d_to, _validate(dg, spec, params, state, v, d_to, ok)
+
+    if spec.invalid == "selfloop":
+        v, d_to, valid = draw(key)
+        return v, d_to, valid, jnp.int32(1)
+
+    def cond(carry):
+        _, _, _, valid, tries = carry
+        return (~valid) & (tries < spec.max_tries)
+
+    def body(carry):
+        key, _, _, _, tries = carry
+        key, kd = jax.random.split(key)
+        v, d_to, valid = draw(kd)
+        return key, v, d_to, valid, tries + 1
+
+    init = (key, jnp.int32(0), jnp.int32(0), jnp.bool_(False), jnp.int32(0))
+    _, v, d_to, valid, tries = jax.lax.while_loop(cond, body, init)
+    return v, d_to, valid, tries
+
+
+def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
+               state: ChainState) -> ChainState:
+    """One chain step: propose(+retries), Metropolis-accept, commit."""
+    k = spec.n_districts
+    key, kprop, kacc, kwait = jax.random.split(state.key, 4)
+    v, d_to, valid, tries = propose(dg, spec, params, state, kprop)
+
+    d_from = state.assignment[v].astype(jnp.int32)
+    nb = dg.nbr[v]                       # (D,), pad = v
+    nbm = dg.nbr_mask[v]
+    eids = dg.nbr_edge[v]
+    na = state.assignment[nb].astype(jnp.int32)
+    old_cut = (na != d_from) & nbm
+    new_cut = (na != d_to) & nbm
+    delta = new_cut.astype(jnp.int32) - old_cut.astype(jnp.int32)
+    dcut = delta.sum()
+
+    # Metropolis in log space: u < base**(beta * -dcut) [* b ratio]
+    log_bound = -params.beta * dcut.astype(jnp.float32) * params.log_base
+    if spec.accept == "corrected":
+        cut_deg_new = state.cut_deg.astype(jnp.int32)
+        cut_deg_new = cut_deg_new.at[nb].add(jnp.where(nbm, delta, 0))
+        cut_deg_new = cut_deg_new.at[v].set(new_cut.sum())
+        b_new = (cut_deg_new > 0).sum()
+        log_bound += (jnp.log(state.b_count.astype(jnp.float32))
+                      - jnp.log(b_new.astype(jnp.float32)))
+    if spec.accept == "always":
+        accept = valid
+    else:
+        logu = jnp.log(jnp.maximum(jax.random.uniform(kacc),
+                                   jnp.float32(1e-12)))
+        accept = valid & (logu < log_bound)
+
+    # commit (masked): assignment, cut mask, incident counts, tallies
+    a_new = state.assignment.at[v].set(
+        jnp.where(accept, d_to, d_from).astype(state.assignment.dtype))
+    upd = jnp.where(accept & nbm, delta, 0)
+    cut = state.cut.at[eids].add(upd.astype(state.cut.dtype))
+    cut_deg = state.cut_deg.at[nb].add(upd.astype(state.cut_deg.dtype))
+    cut_deg = cut_deg.at[v].set(
+        jnp.where(accept, new_cut.sum(), state.cut_deg[v].astype(jnp.int32))
+        .astype(state.cut_deg.dtype))
+    popv = dg.pop[v] * accept.astype(jnp.int32)
+    dist_pop = state.dist_pop.at[d_from].add(-popv).at[d_to].add(popv)
+    cut_count = state.cut_count + jnp.where(accept, dcut, 0)
+    b_count = (cut_deg > 0).sum().astype(jnp.int32)
+
+    if spec.geom_waits:
+        wait_new = sample_geom_minus1(kwait, b_count, dg.n_nodes, k)
+        cur_wait = jnp.where(accept, wait_new, state.cur_wait)
+    else:
+        cur_wait = state.cur_wait
+    cur_flip_node = jnp.where(accept, v, state.cur_flip_node)
+
+    return state.replace(
+        key=key, assignment=a_new, cut=cut, cut_deg=cut_deg,
+        dist_pop=dist_pop, cut_count=cut_count, b_count=b_count,
+        cur_wait=cur_wait, cur_flip_node=cur_flip_node,
+        accept_count=state.accept_count + accept.astype(jnp.int32),
+        tries_sum=state.tries_sum + tries,
+        exhausted_count=state.exhausted_count + (~valid).astype(jnp.int32),
+    )
+
+
+def record(dg: DeviceGraph, spec: Spec, params: StepParams,
+           state: ChainState):
+    """One yield of the measurement loop (grid_chain_sec11.py:366-402):
+    returns (state-with-updated-accumulators, per-step outputs dict)."""
+    t = state.t_yield
+    out = {
+        "cut_count": state.cut_count,
+        "b_count": state.b_count,
+        "wait": state.cur_wait,
+        "accepts": state.accept_count,
+    }
+
+    cut_times = state.cut_times + state.cut.astype(jnp.int32)
+    waits_sum = state.waits_sum + state.cur_wait
+
+    f = state.cur_flip_node
+    has_flip = f >= 0
+    fi = jnp.maximum(f, 0)
+    if spec.parity_metrics:
+        sign = params.label_values[state.assignment[fi].astype(jnp.int32)]
+        dt = t - state.last_flipped[fi]
+        part_sum = state.part_sum.at[fi].add(
+            jnp.where(has_flip, -sign * dt, 0))
+        last_flipped = state.last_flipped.at[fi].set(
+            jnp.where(has_flip, t, state.last_flipped[fi]))
+        num_flips = state.num_flips.at[fi].add(
+            jnp.where(has_flip, 1, 0))
+    else:
+        part_sum, last_flipped, num_flips = (
+            state.part_sum, state.last_flipped, state.num_flips)
+
+    if spec.record_interface:
+        slope, angle = interface_metrics(dg, state.cut)
+        out["slope"] = slope
+        out["angle"] = angle
+
+    if spec.record_assignment_bits:
+        if dg.n_nodes > 32:
+            raise ValueError("record_assignment_bits needs n_nodes <= 32")
+        shifts = jnp.arange(dg.n_nodes, dtype=jnp.uint32)
+        out["abits"] = jnp.sum(
+            state.assignment.astype(jnp.uint32) << shifts, dtype=jnp.uint32)
+
+    state = state.replace(
+        cut_times=cut_times, waits_sum=waits_sum, part_sum=part_sum,
+        last_flipped=last_flipped, num_flips=num_flips,
+        t_yield=t + 1)
+    return state, out
+
+
+def interface_metrics(dg: DeviceGraph, cut):
+    """Slope and angle of the interface endpoints, from the two wall-cut
+    edges of smallest canonical index (the reference takes elements [0] and
+    [1] of an arbitrarily-ordered set, grid_chain_sec11.py:371-394; the
+    deterministic choice here is documented implementation-defined
+    behavior). NaN when fewer than two wall-cut edges exist (the reference
+    raises IndexError and dies — we keep the chain alive)."""
+    e_ids = jnp.arange(dg.n_edges)
+    wc = (cut > 0) & (dg.wall_id >= 0)
+    first = jnp.argmax(wc)
+    wc2 = wc & (e_ids != first)
+    second = jnp.argmax(wc2)
+    ok = wc.any() & wc2.any()
+
+    def midpoint(e):
+        pts = dg.coords[dg.edges[e]]
+        return (pts[0] + pts[1]) / 2.0
+
+    enda, endb = midpoint(first), midpoint(second)
+    dxy = endb - enda
+    slope = jnp.where(dxy[0] != 0, dxy[1] / jnp.where(dxy[0] != 0, dxy[0], 1.0),
+                      jnp.inf)
+    anga = enda - dg.center
+    angb = endb - dg.center
+    norm = (jnp.linalg.norm(anga) * jnp.linalg.norm(angb))
+    cosang = jnp.clip(jnp.dot(anga, angb) / jnp.maximum(norm, 1e-12),
+                      -1.0, 1.0)
+    angle = jnp.arccos(cosang)
+    nan = jnp.float32(jnp.nan)
+    return (jnp.where(ok, slope, nan).astype(jnp.float32),
+            jnp.where(ok, angle, nan).astype(jnp.float32))
+
+
+def finalize_host(state_np, label_values, t_final):
+    """Reference post-run finalization (grid_chain_sec11.py:416-419),
+    host-side numpy: never-flipped nodes get part_sum = t * final_sign;
+    lognum_flips = log(num_flips + 1). Note the reference does NOT add the
+    tail segment for flipped nodes — preserved verbatim."""
+    import numpy as np
+
+    sign = np.asarray(label_values)[np.asarray(state_np.assignment,
+                                               dtype=np.int64)]
+    part_sum = np.array(state_np.part_sum)
+    never = np.array(state_np.last_flipped) == 0
+    part_sum[never] = t_final * sign[never]
+    lognum = np.log(np.array(state_np.num_flips) + 1.0)
+    return part_sum, lognum

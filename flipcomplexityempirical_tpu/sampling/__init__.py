@@ -1,0 +1,3 @@
+from .runner import RunResult, run_chains, init_batch, pop_bounds
+
+__all__ = ["RunResult", "run_chains", "init_batch", "pop_bounds"]

@@ -1,0 +1,80 @@
+"""Replica exchange over a beta (inverse-temperature) ladder.
+
+The reference carries an annealing schedule in dead code
+(grid_chain_sec11.py:88-95) and BASELINE.json lists "beta-tempered flip
+chains with replica-exchange swaps across a temperature ladder" as a target
+config. TPU-native design: the ladder lives along the chains axis — chain c
+is rung ``c % n_rungs`` of ladder ``c // n_rungs`` — so a swap round is a
+pure permutation-and-select over the batch (no gather/scatter), and a
+cross-device ladder rides `lax.ppermute` over ICI (distribute/sharded.py).
+
+Swaps exchange TEMPERATURES (the beta entries of StepParams), not states:
+exchanging the cheap scalar keeps assignment tensors in place, which is the
+bandwidth-optimal formulation on TPU.
+
+Acceptance: with per-rung target pi_r(x) ∝ exp(-beta_r * log(base) * |cut(x)|),
+the swap of rungs (i, j) accepts with probability
+min(1, exp(log(base) * (beta_i - beta_j) * (cut_i - cut_j))).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..kernel.step import StepParams
+from ..state.chain_state import ChainState
+
+
+def make_ladder_params(params: StepParams, betas, n_ladders: int) -> StepParams:
+    """Tile a base StepParams into (n_ladders * n_rungs) chains whose beta
+    varies along the rung axis (rung fastest)."""
+    betas = jnp.asarray(betas, jnp.float32)
+    r = betas.shape[0]
+    c = n_ladders * r
+    def tile(x):
+        x = jnp.asarray(x, jnp.float32)
+        if x.ndim == 0:
+            return jnp.broadcast_to(x, (c,))
+        return jnp.broadcast_to(x[:1], (c,))
+    return StepParams(
+        log_base=tile(params.log_base),
+        beta=jnp.tile(betas, n_ladders),
+        pop_lo=tile(params.pop_lo),
+        pop_hi=tile(params.pop_hi),
+        label_values=params.label_values,
+    )
+
+
+def swap_within_batch(key, states: ChainState, params: StepParams,
+                      n_rungs: int, parity: int):
+    """One even-odd swap round inside a batch laid out (ladders, rungs).
+
+    ``parity`` 0 pairs rungs (0,1),(2,3),...; parity 1 pairs (1,2),(3,4),...
+    Returns (params with exchanged betas, swap-accept mask) — states are
+    untouched by design.
+    """
+    c = states.assignment.shape[0]
+    rung = jnp.arange(c) % n_rungs
+    # partner of each chain within its ladder (identity at ladder edges)
+    lo = (rung % 2) == (parity % 2)
+    partner = jnp.where(lo, jnp.arange(c) + 1, jnp.arange(c) - 1)
+    valid_pair = jnp.where(
+        lo, rung + 1 < n_rungs, (rung >= 1) & (rung % 2 == (1 - parity % 2)))
+    # guard ladder boundaries and batch edges
+    partner = jnp.clip(partner, 0, c - 1)
+    same_ladder = (jnp.arange(c) // n_rungs) == (partner // n_rungs)
+    valid_pair = valid_pair & same_ladder
+
+    cut = states.cut_count.astype(jnp.float32)
+    beta = params.beta
+    lb = params.log_base
+    log_a = lb * (beta - beta[partner]) * (cut - cut[partner])
+    # one shared uniform per unordered pair: draw at the lower index
+    pair_id = jnp.minimum(jnp.arange(c), partner)
+    u = jax.random.uniform(key, (c,))
+    u_pair = u[pair_id]
+    accept = valid_pair & (jnp.log(jnp.maximum(u_pair, 1e-12)) < log_a)
+
+    new_beta = jnp.where(accept, beta[partner], beta)
+    return params.replace(beta=new_beta), accept

@@ -1,0 +1,3 @@
+from .chain_state import ChainState, derive, init_state
+
+__all__ = ["ChainState", "derive", "init_state"]

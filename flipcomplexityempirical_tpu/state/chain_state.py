@@ -1,0 +1,102 @@
+"""ChainState: the complete per-chain state of a flip walk as a JAX pytree.
+
+Replaces gerrychain's object graph (Partition + lazy updater dicts,
+SURVEY.md section 3.3) with dense arrays whose derived fields (cut mask,
+per-node incident-cut counts, district tallies) are maintained incrementally
+by the kernel and are, invariantly, pure functions of ``assignment`` —
+``derive()`` recomputes them from scratch and tests assert the kernel never
+lets them drift.
+
+All fields are single-chain; the runner vmaps over a leading chains axis.
+Accumulator fields mirror the reference's graph-attribute metric store
+(grid_chain_sec11.py:383-400: cut_times per edge, num_flips/last_flipped/
+part_sum per node) and its in-memory lists (waits).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..graphs.lattice import DeviceGraph
+
+
+@struct.dataclass
+class ChainState:
+    key: jnp.ndarray           # PRNG key data, uint32[2]
+    assignment: jnp.ndarray    # int8[N] district index 0..K-1
+    cut: jnp.ndarray           # int8[E] 0/1 cut-edge indicator
+    cut_deg: jnp.ndarray       # int8[N] number of incident cut edges
+    dist_pop: jnp.ndarray      # int32[K]
+    cut_count: jnp.ndarray     # int32 scalar
+    b_count: jnp.ndarray       # int32 scalar |b_nodes|
+    cur_wait: jnp.ndarray      # float32 scalar, memoized geometric wait
+    cur_flip_node: jnp.ndarray  # int32 scalar, -1 until first acceptance
+    t_yield: jnp.ndarray       # int32 scalar, number of yields recorded
+    # accumulators (reference metric store)
+    part_sum: jnp.ndarray      # int32[N] time-integral of signed membership
+    last_flipped: jnp.ndarray  # int32[N]
+    num_flips: jnp.ndarray     # int32[N]
+    cut_times: jnp.ndarray     # int32[E]
+    waits_sum: jnp.ndarray     # float32 scalar (chunk-local; host sums f64)
+    # telemetry
+    accept_count: jnp.ndarray  # int32
+    tries_sum: jnp.ndarray     # int32 proposals drawn (incl. invalid retries)
+    exhausted_count: jnp.ndarray  # int32 re-propose loops that hit the cap
+
+    @property
+    def n_districts(self) -> int:
+        return self.dist_pop.shape[-1]
+
+
+def derive(dg: DeviceGraph, assignment: jnp.ndarray, k: int):
+    """Recompute all derived fields from the assignment (the invariant
+    checker, and the initializer)."""
+    a = assignment.astype(jnp.int32)
+    cut = (a[dg.edges[:, 0]] != a[dg.edges[:, 1]]).astype(jnp.int8)
+    # incident-cut counts: each edge contributes to both endpoints
+    cut_deg = jnp.zeros(dg.n_nodes, jnp.int32)
+    cut_deg = cut_deg.at[dg.edges[:, 0]].add(cut.astype(jnp.int32))
+    cut_deg = cut_deg.at[dg.edges[:, 1]].add(cut.astype(jnp.int32))
+    dist_pop = jnp.zeros(k, jnp.int32).at[a].add(dg.pop)
+    cut_count = cut.astype(jnp.int32).sum()
+    b_count = (cut_deg > 0).astype(jnp.int32).sum()
+    return cut, cut_deg.astype(jnp.int8), dist_pop, cut_count, b_count
+
+
+def init_state(dg: DeviceGraph, assignment: jnp.ndarray, k: int,
+               key: jnp.ndarray, label_values: jnp.ndarray,
+               sample_initial_wait=None) -> ChainState:
+    """Build the initial ChainState. ``label_values[district]`` is the
+    reference's +1/-1 labeling used to seed part_sum
+    (grid_chain_sec11.py:219: part_sum starts at the signed label).
+    ``sample_initial_wait(key, b_count) -> float32`` seeds the memoized
+    geometric wait of the initial state; None leaves it 0 (metrics off)."""
+    assignment = assignment.astype(jnp.int8)
+    cut, cut_deg, dist_pop, cut_count, b_count = derive(dg, assignment, k)
+    key, kw = jax.random.split(key)
+    if sample_initial_wait is not None:
+        wait = sample_initial_wait(kw, b_count)
+    else:
+        wait = jnp.float32(0.0)
+    return ChainState(
+        key=key,
+        assignment=assignment,
+        cut=cut,
+        cut_deg=cut_deg,
+        dist_pop=dist_pop,
+        cut_count=cut_count,
+        b_count=b_count,
+        cur_wait=wait,
+        cur_flip_node=jnp.int32(-1),
+        t_yield=jnp.int32(0),
+        part_sum=label_values[assignment.astype(jnp.int32)].astype(jnp.int32),
+        last_flipped=jnp.zeros(dg.n_nodes, jnp.int32),
+        num_flips=jnp.zeros(dg.n_nodes, jnp.int32),
+        cut_times=jnp.zeros(dg.n_edges, jnp.int32),
+        waits_sum=jnp.float32(0.0),
+        accept_count=jnp.int32(0),
+        tries_sum=jnp.int32(0),
+        exhausted_count=jnp.int32(0),
+    )

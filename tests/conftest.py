@@ -1,12 +1,19 @@
-# Force CPU with 8 virtual devices BEFORE jax initializes: sharding tests
+# Force CPU with 8 virtual devices BEFORE any computation: sharding tests
 # exercise multi-chip code paths without TPU hardware (SURVEY.md section 4.5).
+#
+# Note: this environment's sitecustomize registers the TPU PJRT plugin at
+# interpreter startup and pins JAX_PLATFORMS, so plain env vars are not
+# enough — the jax config must be updated before backend initialization.
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

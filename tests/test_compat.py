@@ -1,0 +1,135 @@
+"""Oracle backend tests: chain invariants, contiguity vs networkx, updater
+incrementality. The oracle must be trustworthy before it can validate the
+vectorized kernel."""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+from flipcomplexityempirical_tpu import graphs
+from flipcomplexityempirical_tpu import compat
+
+
+def small_chain(n=6, base=1.0, eps=0.5, steps=500, seed=0, accept="literal"):
+    rng = np.random.default_rng(seed)
+    lat = graphs.square_grid(n, n)
+    plan = graphs.stripes_plan(lat, 2)
+    # reference labels are +1/-1 (grid_chain_sec11.py:195): map 0->+1, 1->-1
+    signed = {lab: 1 - 2 * int(plan[i]) for i, lab in enumerate(lat.labels)}
+    updaters = {
+        "population": compat.Tally("population"),
+        "cut_edges": compat.cut_edges,
+        "b_nodes": compat.b_nodes_bi,
+        "base": lambda p: base,
+        "geom": compat.make_geom_wait(rng),
+        "step_num": compat.step_num,
+    }
+    part = compat.Partition(lat, signed, updaters)
+    popbound = compat.within_percent_of_ideal_population(part, eps)
+    make = (compat.make_cut_accept if accept == "literal"
+            else compat.make_corrected_cut_accept)
+    chain = compat.MarkovChain(
+        compat.make_reversible_propose_bi(rng),
+        compat.Validator([compat.single_flip_contiguous, popbound]),
+        make(rng), part, steps)
+    return lat, chain, popbound
+
+
+def test_chain_yield_semantics():
+    lat, chain, _ = small_chain(steps=50)
+    states = list(chain)
+    assert len(states) == 50
+    assert states[0].flips is None  # initial state yielded first
+    # each subsequent yielded state is either the same object (self-loop) or
+    # a child created by a single flip
+    for prev, cur in zip(states, states[1:]):
+        assert cur is prev or (cur.flips is not None and len(cur.flips) == 1)
+
+
+def test_chain_invariants_maintained():
+    lat, chain, popbound = small_chain(steps=400, base=0.7, eps=0.1, seed=3)
+    g = nx.Graph(list(map(tuple, lat.edges)))
+    ideal = lat.n_nodes / 2
+    for t, part in enumerate(chain):
+        pops = part["population"]
+        assert min(pops.values()) >= (1 - 0.1) * ideal - 1e-9
+        assert max(pops.values()) <= (1 + 0.1) * ideal + 1e-9
+        if t % 50 == 0:  # full connectivity check is slow; sample it
+            a = part.assignment_array
+            for dist in (1, -1):
+                sub = g.subgraph(np.nonzero(a == dist)[0].tolist())
+                assert sub.number_of_nodes() > 0
+                assert nx.is_connected(sub)
+
+
+def test_cut_edges_incremental_matches_bruteforce():
+    lat, chain, _ = small_chain(steps=200, base=1.3, seed=5)
+    for t, part in enumerate(chain):
+        if t % 25 == 0:
+            a = part.assignment_array
+            brute = {(lat.labels[e[0]], lat.labels[e[1]])
+                     for e in lat.edges if a[e[0]] != a[e[1]]}
+            assert part["cut_edges"] == brute
+            tal = part["population"]
+            for d in tal:
+                assert tal[d] == int((a == d).sum())
+
+
+def test_single_flip_contiguous_vs_networkx():
+    rng = np.random.default_rng(7)
+    lat = graphs.square_grid(5, 5)
+    g = nx.grid_2d_graph(5, 5)
+    plan = graphs.stripes_plan(lat, 2)
+    signed = {lab: 1 - 2 * int(plan[i]) for i, lab in enumerate(lat.labels)}
+    part = compat.Partition(lat, signed, {"cut_edges": compat.cut_edges})
+    agree = 0
+    for _ in range(300):
+        # random boundary flip (may or may not disconnect)
+        bn = sorted({u for e in part["cut_edges"] for u in e})
+        lab = bn[rng.integers(len(bn))]
+        child = part.flip({lab: -part.assignment[lab]})
+        got = compat.single_flip_contiguous(child)
+        # networkx oracle: all districts of the child connected
+        a = child.assignment_array
+        want = all(
+            nx.is_connected(g.subgraph(
+                [lat.labels[i] for i in np.nonzero(a == d)[0]]))
+            for d in (1, -1) if (a == d).any())
+        assert got == want
+        agree += 1
+        if got:
+            part = child  # walk only through valid states
+    assert agree == 300
+
+
+def test_corrected_accept_runs():
+    lat, chain, _ = small_chain(steps=100, base=2.0, accept="corrected")
+    states = list(chain)
+    assert len(states) == 100
+
+
+def test_pairs_proposal_k_districts():
+    rng = np.random.default_rng(11)
+    lat = graphs.square_grid(8, 8)
+    plan = graphs.stripes_plan(lat, 4)
+    d = {lab: int(plan[i]) for i, lab in enumerate(lat.labels)}
+    updaters = {
+        "population": compat.Tally("population"),
+        "cut_edges": compat.cut_edges,
+        "b_nodes": compat.b_nodes_pairs,
+    }
+    part = compat.Partition(lat, d, updaters)
+    popbound = compat.within_percent_of_ideal_population(part, 0.5)
+    chain = compat.MarkovChain(
+        compat.make_reversible_propose_pairs(rng),
+        compat.Validator([compat.single_flip_contiguous, popbound]),
+        compat.always_accept, part, 300)
+    last = None
+    for p in chain:
+        last = p
+    assert len(last.parts) == 4  # no district vanished
+    g = nx.Graph(list(map(tuple, lat.edges)))
+    a = last.assignment_array
+    for dist in range(4):
+        sub = g.subgraph(np.nonzero(a == dist)[0].tolist())
+        assert nx.is_connected(sub)

@@ -1,0 +1,127 @@
+"""Contiguity checker tests against the networkx ground truth.
+
+- exact_connected must EQUAL the oracle on every tested flip.
+- patch_connected must be SOUND (True => flip keeps district connected) and
+  must agree with exact on simply-connected districts (measured on real
+  chain trajectories; the reference lattices stay simply connected in
+  practice).
+"""
+
+import numpy as np
+import networkx as nx
+import jax
+import jax.numpy as jnp
+import pytest
+
+from flipcomplexityempirical_tpu import graphs, compat
+from flipcomplexityempirical_tpu.kernel import contiguity
+
+
+def nx_connected_after_flip(lat, a, v, d_origin):
+    """Oracle: is the origin district still connected after removing v?"""
+    members = [i for i in range(lat.n_nodes)
+               if a[i] == d_origin and i != v]
+    if len(members) <= 1:
+        return True
+    g = nx.Graph()
+    g.add_nodes_from(members)
+    ms = set(members)
+    for (x, y) in lat.edges:
+        if x in ms and y in ms:
+            g.add_edge(int(x), int(y))
+    return nx.is_connected(g)
+
+
+def trajectory_states(lat, steps=300, seed=0, eps=0.5, base=1.0):
+    """Valid partition states visited by the oracle chain."""
+    rng = np.random.default_rng(seed)
+    plan = graphs.stripes_plan(lat, 2)
+    signed = {lab: 1 - 2 * int(plan[i]) for i, lab in enumerate(lat.labels)}
+    updaters = {"population": compat.Tally("population"),
+                "cut_edges": compat.cut_edges,
+                "b_nodes": compat.b_nodes_bi,
+                "base": lambda p: base}
+    part = compat.Partition(lat, signed, updaters)
+    popbound = compat.within_percent_of_ideal_population(part, eps)
+    chain = compat.MarkovChain(
+        compat.make_reversible_propose_bi(rng),
+        compat.Validator([compat.single_flip_contiguous, popbound]),
+        compat.make_cut_accept(rng), part, steps)
+    seen = []
+    for t, p in enumerate(chain):
+        if t % 10 == 0:
+            # map +1/-1 to internal 0/1
+            seen.append((np.asarray(p.assignment_array) == -1).astype(np.int8))
+    return seen
+
+
+@pytest.mark.parametrize("make", [
+    lambda: graphs.square_grid(7, 7),
+    lambda: graphs.grid_sec11(),
+    lambda: graphs.frankengraph(),
+    lambda: graphs.triangular_lattice(5, 8),
+    lambda: graphs.hex_lattice(3, 3),
+])
+def test_checkers_on_trajectories(make):
+    lat = make()
+    dg = lat.device()
+    steps = 120 if lat.n_nodes > 500 else 300
+    states = trajectory_states(lat, steps=steps)
+    exact_f = jax.jit(lambda a, v, d: contiguity.exact_connected(dg, a, v, d))
+    patch_f = jax.jit(lambda a, v, d: contiguity.patch_connected(dg, a, v, d))
+    rng = np.random.default_rng(1)
+    patch_disagree = 0
+    checked = 0
+    for a in states:
+        aj = jnp.asarray(a)
+        # candidate flips: boundary nodes (where the chain actually proposes)
+        cut = a[lat.edges[:, 0]] != a[lat.edges[:, 1]]
+        bnodes = np.unique(lat.edges[cut].ravel())
+        for v in rng.choice(bnodes, size=min(8, len(bnodes)), replace=False):
+            d = int(a[v])
+            want = nx_connected_after_flip(lat, a, int(v), d)
+            got_exact = bool(exact_f(aj, jnp.int32(v), jnp.int32(d)))
+            got_patch = bool(patch_f(aj, jnp.int32(v), jnp.int32(d)))
+            assert got_exact == want, "exact checker diverged from networkx"
+            if got_patch:
+                assert want, "patch checker unsound (said safe, was not)"
+            elif want:
+                patch_disagree += 1
+            checked += 1
+    # patch must agree almost always on these simply-connected trajectories
+    assert checked > 50
+    assert patch_disagree / checked < 0.02, (
+        f"patch check too conservative: {patch_disagree}/{checked}")
+
+
+def test_singleton_district_vacuous_true():
+    lat = graphs.square_grid(4, 4)
+    dg = lat.device()
+    a = np.zeros(16, np.int8)
+    a[0] = 1  # corner singleton district
+    v = 0
+    got_e = bool(contiguity.exact_connected(dg, jnp.asarray(a),
+                                            jnp.int32(v), jnp.int32(1)))
+    got_p = bool(contiguity.patch_connected(dg, jnp.asarray(a),
+                                            jnp.int32(v), jnp.int32(1)))
+    # matches oracle semantics (compat.single_flip_contiguous: <=1 neighbor)
+    assert got_e and got_p
+
+
+def test_known_disconnection():
+    # path graph, district 0 = {0,1,2}: flipping the middle node must be
+    # detected as a disconnection by both checkers.
+    from flipcomplexityempirical_tpu.graphs import build_lattice
+    lat = build_lattice({0: [1], 1: [0, 2], 2: [1, 3], 3: [2, 4], 4: [3]})
+    dg = lat.device()
+    a = np.array([0, 0, 0, 1, 1], np.int8)
+    # flipping node 1 leaves {0, 2}: 0-2 not adjacent -> disconnected
+    assert not bool(contiguity.exact_connected(
+        dg, jnp.asarray(a), jnp.int32(1), jnp.int32(0)))
+    assert not bool(contiguity.patch_connected(
+        dg, jnp.asarray(a), jnp.int32(1), jnp.int32(0)))
+    # flipping node 2 leaves {0,1}: connected
+    assert bool(contiguity.exact_connected(
+        dg, jnp.asarray(a), jnp.int32(2), jnp.int32(0)))
+    assert bool(contiguity.patch_connected(
+        dg, jnp.asarray(a), jnp.int32(2), jnp.int32(0)))

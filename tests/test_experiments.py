@@ -1,0 +1,66 @@
+"""Experiment driver tests: artifact set, filename scheme, resume manifest,
+both backends (golden-artifact strategy of SURVEY.md section 4.6)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flipcomplexityempirical_tpu import experiments as ex
+
+
+def test_config_tags_match_reference_vocabulary():
+    tags = {c.tag for c in ex.sec11_sweep()}
+    # B vocabulary from the shipped artifact dirs (SURVEY.md section 5):
+    for b in (10, 14, 20, 37, 80, 100, 263, 400, 695, 1000):
+        assert any(f"B{b}P" in t for t in tags), b
+    for p in (1, 5, 10, 50, 90):
+        assert any(t.endswith(f"P{p}") for t in tags), p
+    assert len(tags) == 150
+    ftags = {c.tag for c in ex.frank_sweep()}
+    assert len(ftags) == 24
+    assert "2B333P90" in ftags  # int(100/0.3) == 333 truncation
+
+
+def test_run_config_artifacts_and_resume(tmp_path):
+    out = str(tmp_path / "plots")
+    cfg = ex.ExperimentConfig(family="frank", alignment=2, base=1 / .3,
+                              pop_tol=0.5, total_steps=300, n_chains=2,
+                              backend="jax")
+    data = ex.run_config(cfg, out)
+    for kind in ex.ARTIFACT_KINDS:
+        assert os.path.exists(os.path.join(out, cfg.tag + kind)), kind
+    wait = int(open(os.path.join(out, cfg.tag + "wait.txt")).read())
+    assert wait >= 0
+    assert ex.is_done(cfg, out)
+    # resume: sweep over the same config is a no-op
+    results = ex.run_sweep([cfg], out, verbose=False)
+    assert results == []
+    # histories have the full yield count
+    assert data["history"]["cut_count"].shape == (2, 300)
+    assert len(data["slopes"]) == 300
+
+
+def test_python_backend_runs(tmp_path):
+    out = str(tmp_path / "plots")
+    cfg = ex.ExperimentConfig(family="frank", alignment=0, base=0.3,
+                              pop_tol=0.5, total_steps=200,
+                              backend="python")
+    data = ex.run_config(cfg, out)
+    assert ex.is_done(cfg, out)
+    assert data["history"]["cut_count"].shape == (1, 200)
+    # num_flips bounded by yields; part_sum finalized for never-flipped
+    assert data["num_flips"].sum() <= 200
+    assert np.abs(data["part_sum"]).max() <= 200
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    out = str(tmp_path / "plots")
+    ck = str(tmp_path / "ckpt")
+    cfg = ex.ExperimentConfig(family="frank", alignment=1, base=0.3,
+                              pop_tol=0.5, total_steps=150, n_chains=2)
+    data = ex.run_config(cfg, out, checkpoint_dir=ck)
+    loaded = ex.load_checkpoint(ck, cfg)
+    assert loaded is not None
+    assert (loaded["assignment"] ==
+            np.asarray(data["state"].assignment)).all()

@@ -1,0 +1,171 @@
+"""Kernel step tests: derived-field invariants, connectivity preservation,
+acceptance math, parity bookkeeping quirks, geometric waits."""
+
+import numpy as np
+import networkx as nx
+import jax
+import jax.numpy as jnp
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.state import derive
+from flipcomplexityempirical_tpu.kernel import step as kstep
+
+
+def run_small(spec, n=8, k=2, steps=400, chains=8, base=0.8, tol=0.3, seed=0):
+    g = fce.graphs.square_grid(n, n)
+    plan = fce.graphs.stripes_plan(g, k)
+    dg, states, params = fce.init_batch(
+        g, plan, n_chains=chains, seed=seed, spec=spec, base=base,
+        pop_tol=tol)
+    res = fce.run_chains(dg, spec, params, states, n_steps=steps)
+    return g, dg, res
+
+
+def check_invariants(dg, s, k):
+    c = s.assignment.shape[0]
+    cut, cdeg, dpop, cc, bc = jax.vmap(lambda a: derive(dg, a, k))(
+        jnp.asarray(s.assignment))
+    assert (np.asarray(cut) == np.asarray(s.cut)).all()
+    assert (np.asarray(cdeg) == np.asarray(s.cut_deg)).all()
+    assert (np.asarray(dpop) == np.asarray(s.dist_pop)).all()
+    assert (np.asarray(cc) == np.asarray(s.cut_count)).all()
+    assert (np.asarray(bc) == np.asarray(s.b_count)).all()
+
+
+@pytest.mark.parametrize("contig", ["patch", "exact"])
+def test_invariants_bi(contig):
+    spec = fce.Spec(contiguity=contig)
+    g, dg, res = run_small(spec, steps=300)
+    check_invariants(dg, res.host_state(), 2)
+
+
+def test_invariants_pair_k4():
+    spec = fce.Spec(n_districts=4, proposal="pair", contiguity="patch")
+    g, dg, res = run_small(spec, n=10, k=4, steps=300, tol=0.5)
+    s = res.host_state()
+    check_invariants(dg, s, 4)
+    # all 4 districts alive and connected in every chain
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    for c in range(s.assignment.shape[0]):
+        a = np.asarray(s.assignment[c])
+        for d in range(4):
+            nodes = np.nonzero(a == d)[0].tolist()
+            assert nodes, f"district {d} vanished in chain {c}"
+            assert nx.is_connected(gx.subgraph(nodes))
+
+
+def test_districts_stay_connected_and_balanced():
+    spec = fce.Spec(contiguity="patch")
+    tol = 0.1
+    g, dg, res = run_small(spec, n=8, steps=600, tol=tol, base=1.0)
+    s = res.host_state()
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    ideal = g.n_nodes / 2
+    for c in range(s.assignment.shape[0]):
+        a = np.asarray(s.assignment[c])
+        for d in (0, 1):
+            nodes = np.nonzero(a == d)[0].tolist()
+            assert nx.is_connected(gx.subgraph(nodes))
+            assert (1 - tol) * ideal <= len(nodes) <= (1 + tol) * ideal
+
+
+def test_accept_always_moves_every_step():
+    spec = fce.Spec(accept="always", geom_waits=False)
+    g, dg, res = run_small(spec, steps=200, base=1.0, tol=0.5)
+    s = res.host_state()
+    # with accept='always' and repropose, every non-initial yield moves
+    assert (np.asarray(s.accept_count) == 199).all()
+
+
+def test_base_extremes_control_acceptance():
+    # base >> 1 rewards compactness: cut count must drop or stay near the
+    # minimum; base << 1 grows the interface.
+    spec = fce.Spec()
+    _, _, res_hi = run_small(spec, steps=800, base=8.0, tol=0.5, seed=1)
+    _, _, res_lo = run_small(spec, steps=800, base=0.12, tol=0.5, seed=1)
+    hi = res_hi.history["cut_count"][:, -100:].mean()
+    lo = res_lo.history["cut_count"][:, -100:].mean()
+    assert hi < lo, (hi, lo)
+
+
+def test_record_parity_bookkeeping_quirk():
+    """Reference lines 396-400: on EVERY yield the last-flipped node is
+    re-booked — including self-loop yields. Drive record() directly."""
+    g = fce.graphs.square_grid(4, 4)
+    dg = g.device()
+    spec = fce.Spec(parity_metrics=True, geom_waits=False)
+    params = kstep.make_params(1.0, 0.0, 100.0, [1, -1])
+    from flipcomplexityempirical_tpu.state import init_state
+    st = init_state(dg, jnp.asarray(fce.graphs.stripes_plan(g, 2)), 2,
+                    jax.random.PRNGKey(0), jnp.asarray([1, -1], jnp.int32))
+    # pretend node 5 just flipped to district 1 (label -1) at yield t=3
+    st = st.replace(cur_flip_node=jnp.int32(5), t_yield=jnp.int32(3),
+                    assignment=st.assignment.at[5].set(1))
+    rec = jax.jit(lambda s: kstep.record(dg, spec, params, s))
+    st1, _ = rec(st)
+    # part_sum[5] -= sign * (t - last_flipped) = -(-1) * (3 - 0) = +3 on top
+    # of the init value, which was seeded from the PRE-flip district label
+    # (district 0 -> +1), because init_state ran before the manual flip
+    base_ps = 1
+    assert int(st1.part_sum[5]) == base_ps + 3
+    assert int(st1.last_flipped[5]) == 3
+    assert int(st1.num_flips[5]) == 1
+    # a self-loop yield at t=4 re-books the same node (the reference quirk)
+    st2, _ = rec(st1)
+    assert int(st2.num_flips[5]) == 2
+    assert int(st2.last_flipped[5]) == 4
+    assert int(st2.part_sum[5]) == base_ps + 3 + 1
+    # initial state (cur_flip_node=-1) books nothing
+    st0 = st.replace(cur_flip_node=jnp.int32(-1))
+    st0b, _ = rec(st0)
+    assert (np.asarray(st0b.num_flips) == 0).all()
+
+
+def test_geom_wait_distribution():
+    # mean of Geometric(p)-1 is (1-p)/p
+    key = jax.random.PRNGKey(0)
+    n_nodes, k, b = 100, 2, 37
+    p = b / (n_nodes ** k - 1)
+    keys = jax.random.split(key, 20000)
+    w = jax.vmap(lambda kk: kstep.sample_geom_minus1(
+        kk, jnp.int32(b), n_nodes, k))(keys)
+    w = np.asarray(w)
+    expect = (1 - p) / p
+    assert abs(w.mean() - expect) / expect < 0.05
+    assert (w >= 0).all()
+
+
+def test_interface_metrics_vertical_split():
+    g = fce.graphs.grid_sec11()
+    dg = g.device()
+    plan = fce.graphs.sec11_plan(g, 0)  # vertical split at x>19
+    cut, *_ = derive(dg, jnp.asarray(plan), 2)
+    slope, angle = jax.jit(
+        lambda c: kstep.interface_metrics(dg, c))(cut)
+    # interface crosses walls y==0 and y==39 at x=19.5: dx=0 -> slope inf
+    assert np.isinf(float(slope))
+    # angle between (19.5,0)-(20,20) and (19.5,39)-(20,20), ref formula
+    enda, endb = np.array([19.5, 0.0]), np.array([19.5, 39.0])
+    c = np.array([20.0, 20.0])
+    va, vb = enda - c, endb - c
+    want = np.arccos(np.clip(np.dot(va / np.linalg.norm(va),
+                                    vb / np.linalg.norm(vb)), -1, 1))
+    assert abs(float(angle) - want) < 1e-5
+
+
+def test_selfloop_policy_runs():
+    spec = fce.Spec(invalid="selfloop")
+    g, dg, res = run_small(spec, steps=300)
+    s = res.host_state()
+    check_invariants(dg, s, 2)
+    # selfloop mode: exactly one try per step
+    assert (np.asarray(s.tries_sum) == 299).all()
+
+
+def test_waits_match_history_sum():
+    spec = fce.Spec()
+    _, _, res = run_small(spec, steps=500, seed=4)
+    np.testing.assert_allclose(
+        res.waits_total, res.history["wait"].sum(axis=1, dtype=np.float64),
+        rtol=1e-6)

@@ -1,0 +1,78 @@
+"""Cross-backend distributional parity (SURVEY.md section 4.3): the
+vectorized kernel and the pure-Python oracle implement the same chain, so
+their trajectory statistics must agree — compared via subsampled KS
+statistics and moment ratios (RNG parity is impossible; SURVEY section 7.3
+item 4)."""
+
+import numpy as np
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import compat
+
+
+BASE, EPS, STEPS = 0.7, 0.3, 6000
+BURN = 1000
+
+
+def oracle_trajectory(lat, seed):
+    rng = np.random.default_rng(seed)
+    plan = fce.graphs.stripes_plan(lat, 2)
+    signed = {lab: 1 - 2 * int(plan[i]) for i, lab in enumerate(lat.labels)}
+    updaters = {"population": compat.Tally("population"),
+                "cut_edges": compat.cut_edges,
+                "b_nodes": compat.b_nodes_bi,
+                "base": lambda p: BASE,
+                "geom": compat.make_geom_wait(rng)}
+    part = compat.Partition(lat, signed, updaters)
+    popbound = compat.within_percent_of_ideal_population(part, EPS)
+    chain = compat.MarkovChain(
+        compat.make_reversible_propose_bi(rng),
+        compat.Validator([compat.single_flip_contiguous, popbound]),
+        compat.make_cut_accept(rng), part, STEPS)
+    cuts, bs, waits = [], [], []
+    for p in chain:
+        cuts.append(len(p["cut_edges"]))
+        bs.append(len(p["b_nodes"]))
+        waits.append(p["geom"])
+    return (np.array(cuts[BURN:]), np.array(bs[BURN:]),
+            np.array(waits[BURN:], dtype=float))
+
+
+def kernel_trajectories(lat, seed, chains=8):
+    plan = fce.graphs.stripes_plan(lat, 2)
+    spec = fce.Spec(contiguity="exact")  # gerrychain-exact semantics
+    dg, st, params = fce.init_batch(lat, plan, n_chains=chains, seed=seed,
+                                    spec=spec, base=BASE, pop_tol=EPS)
+    res = fce.run_chains(dg, spec, params, st, n_steps=STEPS)
+    return (res.history["cut_count"][:, BURN:],
+            res.history["b_count"][:, BURN:],
+            res.history["wait"][:, BURN:])
+
+
+def ks_stat(x, y):
+    xs = np.sort(x)
+    ys = np.sort(y)
+    grid = np.concatenate([xs, ys])
+    fx = np.searchsorted(xs, grid, side="right") / len(xs)
+    fy = np.searchsorted(ys, grid, side="right") / len(ys)
+    return np.abs(fx - fy).max()
+
+
+def test_kernel_matches_oracle_distributions():
+    lat = fce.graphs.square_grid(6, 6)
+    o_cut, o_b, o_w = oracle_trajectory(lat, seed=1)
+    k_cut, k_b, k_w = kernel_trajectories(lat, seed=2)
+
+    # subsample to decorrelate before a KS comparison
+    sub = slice(None, None, 40)
+    ks_cut = ks_stat(o_cut[sub], k_cut[:, ::40].ravel())
+    ks_b = ks_stat(o_b[sub], k_b[:, ::40].ravel())
+    assert ks_cut < 0.12, f"cut-count KS {ks_cut:.3f}"
+    assert ks_b < 0.12, f"b-count KS {ks_b:.3f}"
+
+    # means within 3% (tighter than KS on autocorrelated series)
+    assert abs(o_cut.mean() - k_cut.mean()) / o_cut.mean() < 0.03
+    assert abs(o_b.mean() - k_b.mean()) / o_b.mean() < 0.03
+    # waits: mean ratio within 10% (heavy-tailed)
+    assert abs(o_w.mean() - k_w.mean()) / o_w.mean() < 0.10
