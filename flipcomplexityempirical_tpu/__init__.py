@@ -16,5 +16,6 @@ from . import compat  # noqa: F401
 from . import state  # noqa: F401
 from . import kernel  # noqa: F401
 from . import sampling  # noqa: F401
+from . import stats  # noqa: F401
 from .kernel import Spec  # noqa: F401
 from .sampling import run_chains, init_batch  # noqa: F401

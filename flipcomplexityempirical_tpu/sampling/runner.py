@@ -109,6 +109,14 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     n_chains = states.assignment.shape[0]
     if chunk is None:
         chunk = max(1, min(n_steps - 1, 4096))
+        # snap to a divisor of the transition count when one is nearby, so
+        # long runs compile a single scan length instead of paying a second
+        # full compile for the remainder chunk
+        total = n_steps - 1
+        for d in range(chunk, max(chunk // 2, 1) - 1, -1):
+            if total % d == 0:
+                chunk = d
+                break
 
     states, out0 = _record_initial(dg, spec, params, states)
     hist_parts = {k: [np.asarray(v)[:, None]] for k, v in out0.items()} \

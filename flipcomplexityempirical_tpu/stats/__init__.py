@@ -1,0 +1,29 @@
+"""Batched chain diagnostics: the "same stats interface" of the north star.
+
+The reference records trajectories (cut counts, boundary sizes, waits) but
+ships no analysis code — its diagnostics were visual (SURVEY.md section 4).
+This package supplies the quantitative layer the BASELINE.json north star
+names: mixing-time / autocorrelation / ESS and bottleneck-ratio estimators
+that consume batched ``(n_chains, T)`` histories exactly as ``run_chains``
+returns them, plus the partisan metrics the reference imports but never
+calls (mean_median / efficiency_gap, grid_chain_sec11.py:20-30) and
+district compactness scores for real-geometry dual graphs.
+"""
+
+from .diagnostics import (
+    autocorrelation, integrated_autocorr_time, ess, gelman_rubin,
+    autocorr_mixing_time,
+)
+from .bottleneck import conductance_profile, bottleneck_ratio
+from .partisan import (
+    district_vote_tallies, mean_median, efficiency_gap, seats_won,
+)
+from .compactness import polsby_popper, cut_edge_count, perimeter_area
+
+__all__ = [
+    "autocorrelation", "integrated_autocorr_time", "ess", "gelman_rubin",
+    "autocorr_mixing_time",
+    "conductance_profile", "bottleneck_ratio",
+    "district_vote_tallies", "mean_median", "efficiency_gap", "seats_won",
+    "polsby_popper", "cut_edge_count", "perimeter_area",
+]

@@ -1,0 +1,63 @@
+"""Bottleneck-ratio (conductance) estimation from chain trajectories.
+
+The paper's central objects are bottleneck ratios: for a set S of states,
+Phi(S) = Q(S, S^c) / pi(S) where Q is the edge measure of the chain. A small
+Phi(S) certifies slow mixing (Cheeger: t_mix >= 1/(4 Phi)). Exact state-space
+enumeration is exponential, but along a scalar observable f (cut count,
+signed imbalance, ...) the level sets S_r = {x : f(x) <= r} have empirically
+estimable conductance: pi(S_r) from occupation frequencies and Q(S_r, S_r^c)
+from observed boundary crossings. The minimum over r is the trajectory
+bottleneck ratio — the "CPU bottleneck-ratio estimates" the BASELINE.json
+north star says must be reproduced, now fed by (C, T) batched histories.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def conductance_profile(x, thresholds=None):
+    """Estimate Phi(S_r) for level sets S_r = {f <= r} of observable ``x``
+    shaped (C, T) (or (T,)).
+
+    Pools transitions across chains (each chain contributes T-1 transitions).
+    Returns ``(thresholds, phi)`` with ``phi[i] = (crossings out of S_r /
+    n_transitions) / min(occupancy, 1 - occupancy)`` — the symmetric form
+    Phi(S) = Q(S, S^c) / min(pi(S), pi(S^c)), NaN where the level set (or
+    its complement) is never visited.
+    """
+    x = np.asarray(x, dtype=np.float64)
+    if x.ndim == 1:
+        x = x[None, :]
+    c, t = x.shape
+    if t < 2:
+        raise ValueError("need T >= 2 transitions")
+    if thresholds is None:
+        lo, hi = np.min(x), np.max(x)
+        thresholds = np.unique(x) if hi - lo <= 256 else \
+            np.linspace(lo, hi, 257)
+    thresholds = np.asarray(thresholds, dtype=np.float64)
+
+    cur, nxt = x[:, :-1], x[:, 1:]
+    n_trans = cur.size
+    phi = np.full(len(thresholds), np.nan)
+    for i, r in enumerate(thresholds):
+        in_s = cur <= r
+        pi_s = in_s.mean()
+        if pi_s == 0.0 or pi_s == 1.0:
+            continue
+        crossings = np.count_nonzero(in_s & (nxt > r))
+        q = crossings / n_trans
+        phi[i] = q / min(pi_s, 1.0 - pi_s)
+    return thresholds, phi
+
+
+def bottleneck_ratio(x, thresholds=None) -> tuple[float, float]:
+    """The trajectory bottleneck ratio: ``min_r Phi(S_r)`` over the observed
+    level sets, with the minimizing threshold. Returns ``(phi_star, r_star)``;
+    ``(nan, nan)`` when no level set is two-sided (frozen observable)."""
+    thresholds, phi = conductance_profile(x, thresholds)
+    if np.all(np.isnan(phi)):
+        return float("nan"), float("nan")
+    i = int(np.nanargmin(phi))
+    return float(phi[i]), float(thresholds[i])

@@ -1,0 +1,134 @@
+"""stats/ diagnostics: calibrated against processes with known answers
+(AR(1) autocorrelation time, two-state metastable conductance, hand-computed
+partisan tallies, exact square-district geometry), plus an integration pass
+over real kernel histories."""
+
+import numpy as np
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import stats
+
+
+def ar1(rng, c, t, rho):
+    x = np.zeros((c, t))
+    eps = rng.standard_normal((c, t))
+    for i in range(1, t):
+        x[:, i] = rho * x[:, i - 1] + np.sqrt(1 - rho ** 2) * eps[:, i]
+    return x
+
+
+def test_autocorrelation_ar1(rng):
+    rho = 0.8
+    x = ar1(rng, 4, 20000, rho)
+    acf = stats.autocorrelation(x, max_lag=20).mean(axis=0)
+    lags = np.arange(21)
+    assert np.allclose(acf, rho ** lags, atol=0.05)
+    assert acf[0] == 1.0
+
+
+def test_tau_and_ess_ar1(rng):
+    rho = 0.9  # tau = (1+rho)/(1-rho) = 19
+    x = ar1(rng, 8, 50000, rho)
+    tau = stats.integrated_autocorr_time(x)
+    assert np.allclose(tau.mean(), 19.0, rtol=0.2)
+    per, total = stats.ess(x)
+    assert np.allclose(per.mean(), 50000 / 19.0, rtol=0.25)
+    assert np.allclose(total, 8 * 50000 / tau.mean(), rtol=1e-6)
+
+
+def test_iid_is_white(rng):
+    x = rng.standard_normal((4, 8000))
+    assert stats.integrated_autocorr_time(x).mean() < 1.5
+    assert abs(stats.gelman_rubin(x) - 1.0) < 0.02
+    assert stats.autocorr_mixing_time(x) == 1.0
+
+
+def test_gelman_rubin_flags_divergence(rng):
+    x = rng.standard_normal((4, 1000))
+    x += np.arange(4)[:, None] * 5.0  # chains stuck in different modes
+    assert stats.gelman_rubin(x) > 1.5
+
+
+def test_frozen_observable_degenerate():
+    x = np.ones((3, 100))
+    assert np.all(stats.integrated_autocorr_time(x) >= 1.0)
+    assert stats.gelman_rubin(x) == 1.0
+    phi, r = stats.bottleneck_ratio(x)
+    assert np.isnan(phi)
+
+
+def test_bottleneck_two_state_metastable(rng):
+    # Two wells {0, 1} with P(switch) = p: the only nontrivial level set has
+    # Q(S, S^c) = pi(S) * p, so Phi = p exactly.
+    p = 0.02
+    t, c = 40000, 4
+    switches = rng.random((c, t)) < p
+    x = (np.cumsum(switches, axis=1) % 2).astype(float)
+    phi, r = stats.bottleneck_ratio(x)
+    assert np.isclose(phi, p, rtol=0.3)
+    assert r == 0.0
+
+
+def test_conductance_profile_shape(rng):
+    x = rng.integers(0, 5, size=(2, 5000)).astype(float)
+    thr, phi = stats.conductance_profile(x)
+    assert thr.shape == phi.shape
+    assert np.isnan(phi[-1])  # full-space level set has no complement
+
+
+def test_partisan_hand_example():
+    # 2 districts: d0 = 60/40, d1 = 30/70 => shares (.6, .3)
+    tallies = np.array([[[60.0, 40.0], [30.0, 70.0]]])
+    assert np.allclose(stats.mean_median(tallies), 0.45 - 0.45)  # K=2: 0
+    assert stats.seats_won(tallies)[0] == 1
+    # wasted: d0 w0=60-50=10, w1=40; d1 w0=30, w1=70-50=20
+    # eg = ((40+20) - (10+30)) / 200 = 0.1
+    assert np.allclose(stats.efficiency_gap(tallies), 0.1)
+
+
+def test_partisan_tallies_batched(rng):
+    n, c, k = 50, 3, 2
+    votes = rng.random((n, 2))
+    a = rng.integers(0, k, size=(c, n))
+    tal = stats.district_vote_tallies(a, votes, k)
+    for ci in range(c):
+        for d in range(k):
+            assert np.allclose(tal[ci, d], votes[a[ci] == d].sum(axis=0))
+
+
+def test_compactness_square_district():
+    # 4x4 grid split into two 2x4 halves: with unit cells each district is a
+    # 2x4 rectangle (area 8, perimeter 12) => PP = 4*pi*8/144
+    g = fce.graphs.square_grid(4, 4)
+    a = np.array([0 if x < 2 else 1 for (x, y) in g.labels], np.int8)
+    sp = np.ones(g.n_edges)  # unit shared edge lengths
+    area = np.ones(g.n_nodes)
+    # exterior perimeter: each node's sides not shared with any neighbor
+    deg = np.zeros(g.n_nodes)
+    for e in g.edges:
+        deg[e[0]] += 1
+        deg[e[1]] += 1
+    ext = 4.0 - deg
+    pp = stats.polsby_popper(a, 2, edges=g.edges, shared_perim=sp,
+                             node_area=area, node_exterior_perim=ext)
+    assert pp.shape == (1, 2)
+    assert np.allclose(pp, 4 * np.pi * 8 / 144)
+    assert stats.cut_edge_count(a, g.edges)[0] == 4
+
+
+def test_kernel_history_integration():
+    g = fce.graphs.square_grid(8, 8)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec()
+    dg, st, params = fce.init_batch(g, plan, n_chains=8, seed=3, spec=spec,
+                                    base=1.0, pop_tol=0.5)
+    res = fce.run_chains(dg, spec, params, st, n_steps=2000)
+    cuts = res.history["cut_count"].astype(float)
+    tau = stats.integrated_autocorr_time(cuts)
+    assert np.all(tau >= 1.0) and np.all(np.isfinite(tau))
+    per, total = stats.ess(cuts)
+    assert total > 8  # mixes at least somewhat
+    phi, r = stats.bottleneck_ratio(cuts)
+    assert 0 < phi <= 1.0
+    assert stats.gelman_rubin(cuts) < 1.5
