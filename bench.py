@@ -33,6 +33,12 @@ def main():
     ap.add_argument("--pop-tol", type=float, default=0.1)
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     args = ap.parse_args()
+    if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
+            or args.warmup - 1 < args.chunk):
+        ap.error(f"--chunk {args.chunk} must divide steps-1 "
+                 f"({args.steps - 1}) and warmup-1 ({args.warmup - 1}), and "
+                 f"warmup-1 must be >= chunk, so the warmup actually "
+                 "compiles the chunk-length kernel the timed region reuses")
 
     import jax
     if args.cpu:

@@ -8,7 +8,7 @@ Partition/MarkovChain."""
 
 from .partition import (
     Partition, Tally, cut_edges, b_nodes_bi, b_nodes_pairs,
-    make_geom_wait, make_boundary_slope, step_num,
+    make_geom_wait, make_boundary_slope, step_num, bnodes_p,
 )
 from .chain import (
     MarkovChain, Validator, within_percent_of_ideal_population,
@@ -16,14 +16,18 @@ from .chain import (
     make_reversible_propose_bi, make_reversible_propose_pairs,
     make_random_flip, go_nowhere, always_accept,
     make_cut_accept, make_corrected_cut_accept,
+    make_fixed_endpoints, boundary_condition, make_uniform_accept,
+    linear_beta_schedule, make_annealing_cut_accept_backwards,
 )
 
 __all__ = [
     "Partition", "Tally", "cut_edges", "b_nodes_bi", "b_nodes_pairs",
-    "make_geom_wait", "make_boundary_slope", "step_num",
+    "make_geom_wait", "make_boundary_slope", "step_num", "bnodes_p",
     "MarkovChain", "Validator", "within_percent_of_ideal_population",
     "single_flip_contiguous", "contiguous",
     "make_reversible_propose_bi", "make_reversible_propose_pairs",
     "make_random_flip", "go_nowhere", "always_accept",
     "make_cut_accept", "make_corrected_cut_accept",
+    "make_fixed_endpoints", "boundary_condition", "make_uniform_accept",
+    "linear_beta_schedule", "make_annealing_cut_accept_backwards",
 ]

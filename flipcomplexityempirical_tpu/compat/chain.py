@@ -206,6 +206,86 @@ def make_corrected_cut_accept(rng: np.random.Generator,
     return accept
 
 
+def make_fixed_endpoints(pairs=(((19, 0), (20, 0)), ((19, 39), (20, 39)))):
+    """The reference's fixed_endpoints predicate (grid_chain_sec11.py:39-40):
+    the interface endpoints stay pinned — each listed label pair must
+    straddle the district boundary."""
+
+    def fixed_endpoints(partition: Partition) -> bool:
+        return all(partition.assignment[a] != partition.assignment[b]
+                   for (a, b) in pairs)
+
+    return fixed_endpoints
+
+
+def boundary_condition(partition: Partition) -> bool:
+    """grid_chain_sec11.py:43-52: True iff the outer-frame nodes (the
+    'boundary' updater list) do not all lie in one district — i.e. the
+    interface touches the frame."""
+    blist = partition["boundary"]
+    o_part = partition.assignment[blist[0]]
+    return any(partition.assignment[x] != o_part for x in blist)
+
+
+def make_uniform_accept(rng: np.random.Generator, popbound: Callable):
+    """grid_chain_sec11.py:159-165: accept iff popbound ∧
+    single_flip_contiguous ∧ boundary_condition (target: uniform over that
+    constrained set). Note the reference re-checks validity here even though
+    the Validator already did — preserved for parity."""
+
+    def accept(partition: Partition) -> bool:
+        bound = 0.0
+        if (popbound(partition) and single_flip_contiguous(partition)
+                and boundary_condition(partition)):
+            bound = 1.0
+        return rng.random() < bound
+
+    return accept
+
+
+def linear_beta_schedule(t0: float = 100000.0, ramp: float = 100000.0,
+                         beta_max: float = 3.0) -> Callable:
+    """The commented-out annealing schedule of grid_chain_sec11.py:88-95:
+    beta = 0 for t < t0, then (t - t0)/ramp, capped at beta_max."""
+
+    def beta(t: int) -> float:
+        return float(np.clip((t - t0) / ramp, 0.0, beta_max))
+
+    return beta
+
+
+def make_annealing_cut_accept_backwards(
+        rng: np.random.Generator, popbound: Callable, base: float = 0.1,
+        beta=5.0) -> Callable:
+    """grid_chain_sec11.py:81-110 (dead code there; an option here): the
+    boundary-ratio-corrected Metropolis acceptance
+    base**(beta * -dcut) * |b(child)| / |b(parent)| with inline population
+    and contiguity re-checks. ``beta`` is a constant or a callable of
+    partition["step_num"] (see linear_beta_schedule). Note the correction
+    direction is the reference's literal len(boundaries1)/len(boundaries2) =
+    child/parent — the INVERSE of the reversibility correction in
+    make_corrected_cut_accept — preserved verbatim."""
+
+    def accept(partition: Partition) -> bool:
+        bound = 1.0
+        if partition.parent is not None:
+            b = beta(partition["step_num"]) if callable(beta) else beta
+            boundaries1 = {x for e in partition["cut_edges"] for x in e}
+            boundaries2 = {x for e in partition.parent["cut_edges"]
+                           for x in e}
+            delta = (-len(partition["cut_edges"])
+                     + len(partition.parent["cut_edges"]))
+            bound = (base ** (b * delta)) * (len(boundaries1)
+                                             / len(boundaries2))
+            if not popbound(partition):
+                bound = 0.0
+            if not single_flip_contiguous(partition):
+                bound = 0.0
+        return rng.random() < bound
+
+    return accept
+
+
 class MarkovChain:
     def __init__(self, proposal: Callable, constraints: Callable,
                  accept: Callable, initial_state: Partition,

@@ -230,6 +230,14 @@ def make_boundary_slope(wall_of_edge):
     return slope
 
 
+def bnodes_p(partition: Partition) -> list:
+    """The reference's 'boundary' updater (grid_chain_sec11.py:294-297):
+    the frame-flagged node labels, recomputed every step there (an O(n)
+    scan of a constant — here read off the graph's frame mask)."""
+    g = partition.graph
+    return [g.labels[i] for i in np.nonzero(g.frame_mask)[0]]
+
+
 def step_num(partition: Partition) -> int:
     parent = partition.parent
     if not parent:

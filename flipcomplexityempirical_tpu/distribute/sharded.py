@@ -30,7 +30,8 @@ from .mesh import CHAINS_AXIS
 def _params_spec(sharded: bool):
     p = P(CHAINS_AXIS) if sharded else P()
     return StepParams(log_base=p, beta=p, pop_lo=p, pop_hi=p,
-                      label_values=P())
+                      label_values=P(), anneal_t0=P(), anneal_ramp=P(),
+                      anneal_beta_max=P())
 
 
 def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
@@ -42,6 +43,12 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
     randomness lives inside ChainState.key). Swap decisions are computed
     identically on both partners from the shared key.
     """
+    if exchange and spec.anneal != "none":
+        # annealed chains ignore params.beta (kernel effective_beta), so a
+        # beta-exchanging ladder would swap values with no dynamical effect
+        raise ValueError("replica exchange is incompatible with "
+                         "Spec.anneal != 'none': swaps exchange StepParams."
+                         "beta, which the annealed kernel ignores")
     n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     paxes = StepParams.vmap_axes()
     perms = []

@@ -66,6 +66,7 @@ class DeviceGraph:
     pop: jnp.ndarray          # int32[N] node population weights
     coords: jnp.ndarray       # float32[N, 2] planar positions (plot/slope)
     frame_mask: jnp.ndarray   # bool[N]   reference "boundary_node" attr
+    frame_idx: jnp.ndarray    # int32[F]  indices of frame nodes (static)
     wall_id: jnp.ndarray      # int8[E]   -1 none, 0..3 walls, 4 corner diag
     patch_nodes: jnp.ndarray  # int32[N, P], pad = self
     patch_adj: jnp.ndarray    # uint32[N, P] bitset adjacency within patch
@@ -148,6 +149,8 @@ class LatticeGraph:
                 pop=jnp.asarray(self.pop, jnp.int32),
                 coords=jnp.asarray(self.coords, jnp.float32),
                 frame_mask=jnp.asarray(self.frame_mask),
+                frame_idx=jnp.asarray(
+                    np.nonzero(self.frame_mask)[0], jnp.int32),
                 wall_id=jnp.asarray(self.wall_id, jnp.int8),
                 patch_nodes=jnp.asarray(self.patch_nodes, jnp.int32),
                 patch_adj=jnp.asarray(self.patch_adj, jnp.uint32),

@@ -42,6 +42,14 @@ class Spec:
     contiguity: str = "patch"     # 'patch' | 'exact' | 'none'
     invalid: str = "repropose"    # 'repropose' | 'selfloop'
     accept: str = "cut"           # 'cut' | 'corrected' | 'always'
+    anneal: str = "none"          # 'none' | 'linear': beta follows the
+                                  # reference's piecewise schedule (the
+                                  # commented-out code of
+                                  # grid_chain_sec11.py:88-95) instead of
+                                  # the constant StepParams.beta
+    frame_interface: bool = False  # boundary_condition constraint
+                                   # (grid_chain_sec11.py:43-52): the outer
+                                   # frame must touch >= 2 districts
     max_tries: int = 256          # re-propose cap per step
     record_interface: bool = False  # slope/angle wall metrics
     parity_metrics: bool = True   # reference-exact accumulator quirks
@@ -61,13 +69,25 @@ class StepParams:
     pop_lo: jnp.ndarray     # f32 scalar: district population lower bound
     pop_hi: jnp.ndarray     # f32 scalar: upper bound
     label_values: jnp.ndarray  # i32[K]: district -> reference +1/-1 label
+    # Spec.anneal == 'linear' schedule constants (grid_chain_sec11.py:88-95:
+    # beta = 0 until t0, then (t - t0)/ramp, capped at beta_max). Replicated
+    # across chains; ignored unless annealing is on.
+    anneal_t0: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.float32(100000.0))
+    anneal_ramp: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.float32(100000.0))
+    anneal_beta_max: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.float32(3.0))
 
     @classmethod
     def vmap_axes(cls):
-        return cls(log_base=0, beta=0, pop_lo=0, pop_hi=0, label_values=None)
+        return cls(log_base=0, beta=0, pop_lo=0, pop_hi=0, label_values=None,
+                   anneal_t0=None, anneal_ramp=None, anneal_beta_max=None)
 
 
-def make_params(base, pop_lo, pop_hi, label_values, beta=1.0, n_chains=None):
+def make_params(base, pop_lo, pop_hi, label_values, beta=1.0, n_chains=None,
+                anneal_t0=100000.0, anneal_ramp=100000.0,
+                anneal_beta_max=3.0):
     """Broadcast scalars to per-chain arrays when n_chains is given."""
     def rep(x):
         x = jnp.asarray(x, jnp.float32)
@@ -77,7 +97,29 @@ def make_params(base, pop_lo, pop_hi, label_values, beta=1.0, n_chains=None):
     return StepParams(
         log_base=rep(jnp.log(jnp.asarray(base, jnp.float32))),
         beta=rep(beta), pop_lo=rep(pop_lo), pop_hi=rep(pop_hi),
-        label_values=jnp.asarray(label_values, jnp.int32))
+        label_values=jnp.asarray(label_values, jnp.int32),
+        anneal_t0=jnp.float32(anneal_t0),
+        anneal_ramp=jnp.float32(anneal_ramp),
+        anneal_beta_max=jnp.float32(anneal_beta_max))
+
+
+def effective_beta(spec: Spec, params: StepParams, state: ChainState):
+    """Inverse temperature for the current proposal: constant, or the
+    reference's piecewise-linear annealing schedule
+    (grid_chain_sec11.py:88-95: 0 until t0, (t-t0)/ramp, capped).
+
+    The schedule clock is the reference's ``step_num`` updater, which
+    advances only on ACCEPTED moves (a rejected step re-yields the parent,
+    grid_chain_sec11.py:282-289); the proposed child's step_num is one past
+    the accepts so far — NOT the yield counter, which also counts
+    rejections."""
+    if spec.anneal == "none":
+        return params.beta
+    if spec.anneal == "linear":
+        t = (state.accept_count + 1).astype(jnp.float32)
+        return jnp.clip((t - params.anneal_t0) / params.anneal_ramp,
+                        0.0, params.anneal_beta_max)
+    raise ValueError(f"anneal mode {spec.anneal!r}")
 
 
 def sample_geom_minus1(key, b_count, n_nodes: int, k: int):
@@ -117,8 +159,16 @@ def _sample_pair(key, dg: DeviceGraph, state: ChainState, k: int):
     return v, d_to, pair_mask.reshape(-1)[idx]
 
 
+def _frame_counts(dg: DeviceGraph, spec: Spec, state: ChainState):
+    """Per-district counts of outer-frame nodes for the current assignment
+    (loop-invariant across re-propose tries; computed once per step, over
+    the O(sqrt N) static frame index set only)."""
+    a_f = state.assignment[dg.frame_idx].astype(jnp.int32)
+    return jnp.zeros(spec.n_districts, jnp.int32).at[a_f].add(1)
+
+
 def _validate(dg: DeviceGraph, spec: Spec, params: StepParams,
-              state: ChainState, v, d_to, sampled_ok):
+              state: ChainState, v, d_to, sampled_ok, frame_counts=None):
     """Population bounds + contiguity for a tentative flip of v to d_to."""
     d_from = state.assignment[v].astype(jnp.int32)
     popv = dg.pop[v]
@@ -128,7 +178,15 @@ def _validate(dg: DeviceGraph, spec: Spec, params: StepParams,
     ok &= pop_from_new >= params.pop_lo
     ok &= pop_to_new <= params.pop_hi
     conn = contiguity.check(dg, state.assignment, v, d_from, spec.contiguity)
-    return ok & conn
+    ok &= conn
+    if spec.frame_interface:
+        # boundary_condition (grid_chain_sec11.py:43-52): after the flip,
+        # the outer-frame nodes must not all lie in one district. Post-flip
+        # per-district frame counts = current counts adjusted for v.
+        vf = dg.frame_mask[v].astype(jnp.int32)
+        counts = frame_counts.at[d_from].add(-vf).at[d_to].add(vf)
+        ok &= counts.max() < dg.frame_idx.shape[0]
+    return ok
 
 
 def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
@@ -136,6 +194,8 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
     """Draw a proposal per the invalid-move policy. Returns
     (v, d_to, valid, tries)."""
     k = spec.n_districts
+    frame_counts = _frame_counts(dg, spec, state) if spec.frame_interface \
+        else None
 
     def draw(key):
         if spec.proposal == "bi":
@@ -146,7 +206,8 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
             v, d_to, ok = _sample_pair(key, dg, state, k)
         else:
             raise ValueError(f"proposal {spec.proposal!r}")
-        return v, d_to, _validate(dg, spec, params, state, v, d_to, ok)
+        return v, d_to, _validate(dg, spec, params, state, v, d_to, ok,
+                                  frame_counts)
 
     if spec.invalid == "selfloop":
         v, d_to, valid = draw(key)
@@ -185,7 +246,8 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
     dcut = delta.sum()
 
     # Metropolis in log space: u < base**(beta * -dcut) [* b ratio]
-    log_bound = -params.beta * dcut.astype(jnp.float32) * params.log_base
+    beta = effective_beta(spec, params, state)
+    log_bound = -beta * dcut.astype(jnp.float32) * params.log_base
     if spec.accept == "corrected":
         cut_deg_new = state.cut_deg.astype(jnp.int32)
         cut_deg_new = cut_deg_new.at[nb].add(jnp.where(nbm, delta, 0))

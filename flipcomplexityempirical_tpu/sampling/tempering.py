@@ -15,6 +15,11 @@ bandwidth-optimal formulation on TPU.
 Acceptance: with per-rung target pi_r(x) ∝ exp(-beta_r * log(base) * |cut(x)|),
 the swap of rungs (i, j) accepts with probability
 min(1, exp(log(base) * (beta_i - beta_j) * (cut_i - cut_j))).
+
+Incompatible with ``Spec.anneal != 'none'``: the annealed kernel derives its
+inverse temperature from the step counter and ignores ``StepParams.beta``,
+so exchanged betas would have no dynamical effect (distribute/sharded.py
+raises on this combination).
 """
 
 from __future__ import annotations
@@ -43,17 +48,25 @@ def make_ladder_params(params: StepParams, betas, n_ladders: int) -> StepParams:
         pop_lo=tile(params.pop_lo),
         pop_hi=tile(params.pop_hi),
         label_values=params.label_values,
+        anneal_t0=params.anneal_t0,
+        anneal_ramp=params.anneal_ramp,
+        anneal_beta_max=params.anneal_beta_max,
     )
 
 
 def swap_within_batch(key, states: ChainState, params: StepParams,
-                      n_rungs: int, parity: int):
+                      n_rungs: int, parity: int, spec=None):
     """One even-odd swap round inside a batch laid out (ladders, rungs).
 
     ``parity`` 0 pairs rungs (0,1),(2,3),...; parity 1 pairs (1,2),(3,4),...
     Returns (params with exchanged betas, swap-accept mask) — states are
-    untouched by design.
+    untouched by design. Pass the chains' ``Spec`` so the annealing
+    incompatibility (module docstring) is caught at the misuse site.
     """
+    if spec is not None and spec.anneal != "none":
+        raise ValueError("replica exchange is incompatible with Spec.anneal "
+                         "!= 'none': the annealed kernel ignores "
+                         "StepParams.beta, so swapped betas have no effect")
     c = states.assignment.shape[0]
     rung = jnp.arange(c) % n_rungs
     # partner of each chain within its ladder (identity at ladder edges)

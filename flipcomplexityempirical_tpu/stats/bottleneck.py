@@ -33,22 +33,31 @@ def conductance_profile(x, thresholds=None):
     if t < 2:
         raise ValueError("need T >= 2 transitions")
     if thresholds is None:
-        lo, hi = np.min(x), np.max(x)
-        thresholds = np.unique(x) if hi - lo <= 256 else \
-            np.linspace(lo, hi, 257)
-    thresholds = np.asarray(thresholds, dtype=np.float64)
+        uniq = np.unique(x)
+        thresholds = uniq if len(uniq) <= 256 else \
+            np.linspace(uniq[0], uniq[-1], 257)
+    thresholds = np.sort(np.asarray(thresholds, dtype=np.float64))
 
-    cur, nxt = x[:, :-1], x[:, 1:]
+    cur, nxt = x[:, :-1].ravel(), x[:, 1:].ravel()
     n_trans = cur.size
-    phi = np.full(len(thresholds), np.nan)
-    for i, r in enumerate(thresholds):
-        in_s = cur <= r
-        pi_s = in_s.mean()
-        if pi_s == 0.0 or pi_s == 1.0:
-            continue
-        crossings = np.count_nonzero(in_s & (nxt > r))
-        q = crossings / n_trans
-        phi[i] = q / min(pi_s, 1.0 - pi_s)
+    nb = len(thresholds)
+    # Bin once instead of scanning per threshold (O(C*T + B)):
+    # b(v) = index of the first threshold >= v, so v <= thresholds[i]
+    # iff b(v) <= i.
+    bc = np.searchsorted(thresholds, cur, side="left")
+    bn = np.searchsorted(thresholds, nxt, side="left")
+    # occupancy of S_i = fraction with b(cur) <= i
+    occ = np.cumsum(np.bincount(bc, minlength=nb + 1)[:nb]) / n_trans
+    # a transition crosses out of S_i iff b(cur) <= i < b(nxt): contributes
+    # to i in [b(cur), b(nxt)); accumulate via a difference array
+    out = bc < bn
+    diff = (np.bincount(bc[out], minlength=nb + 1)
+            - np.bincount(bn[out], minlength=nb + 1))
+    crossings = np.cumsum(diff[:nb])
+    two_sided = (occ > 0.0) & (occ < 1.0)
+    phi = np.full(nb, np.nan)
+    denom = np.minimum(occ, 1.0 - occ)
+    phi[two_sided] = (crossings[two_sided] / n_trans) / denom[two_sided]
     return thresholds, phi
 
 

@@ -62,19 +62,18 @@ def integrated_autocorr_time(x, c: float = 5.0) -> np.ndarray:
     ok = lags >= c * taus_run
     m = int(np.argmax(ok)) if ok.any() else len(rho_mean) - 1
     m = max(m, 1)
-    tau = 2.0 * np.cumsum(rho[:, :m + 1], axis=1)[:, -1] - 1.0
+    tau = 2.0 * rho[:, :m + 1].sum(axis=1) - 1.0
     return np.maximum(tau, 1.0)
 
 
 def ess(x, c: float = 5.0):
     """Effective sample size. Returns ``(ess_per_chain, ess_total)`` where
-    ``ess_total = C * T / tau_mean`` pools all chains (independent chains'
-    samples add)."""
+    ``ess_total = sum_i T / tau_i`` — independent chains' effective samples
+    add, each discounted by its own autocorrelation time."""
     x = _chains(x)
-    n_chains, t = x.shape
     tau = integrated_autocorr_time(x, c=c)
-    per = t / tau
-    return per, float(n_chains * t / tau.mean())
+    per = x.shape[1] / tau
+    return per, float(per.sum())
 
 
 def gelman_rubin(x) -> float:
@@ -94,7 +93,10 @@ def gelman_rubin(x) -> float:
     w = variances.mean()
     b = n * means.var(ddof=1)
     if w == 0:
-        return 1.0
+        # zero within-chain variance: converged only if the chains also
+        # agree; chains frozen at DIFFERENT values are maximally diverged
+        # (the metastable regime this diagnostic exists to flag)
+        return 1.0 if b == 0 else float("inf")
     var_plus = (n - 1) / n * w + b / n
     return float(np.sqrt(var_plus / w))
 

@@ -38,10 +38,11 @@ def _shares(tallies) -> np.ndarray:
 
 
 def mean_median(tallies) -> np.ndarray:
-    """mean - median of party-0 district vote shares, per chain: positive
-    favors party 0 (gerrychain sign convention). (C,) from (C, K, 2)."""
+    """median - mean of party-0 district vote shares, per chain: positive
+    means party 0's median district exceeds its mean — an advantage for
+    party 0 (gerrychain sign convention). (C,) from (C, K, 2)."""
     s = _shares(tallies)
-    return s.mean(axis=-1) - np.median(s, axis=-1)
+    return np.median(s, axis=-1) - s.mean(axis=-1)
 
 
 def efficiency_gap(tallies) -> np.ndarray:

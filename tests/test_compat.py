@@ -133,3 +133,80 @@ def test_pairs_proposal_k_districts():
     for dist in range(4):
         sub = g.subgraph(np.nonzero(a == dist)[0].tolist())
         assert nx.is_connected(sub)
+
+
+def _frame_chain_parts(n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    lat = graphs.square_grid(n, n)
+    plan = graphs.stripes_plan(lat, 2)
+    signed = {lab: 1 - 2 * int(plan[i]) for i, lab in enumerate(lat.labels)}
+    updaters = {
+        "population": compat.Tally("population"),
+        "cut_edges": compat.cut_edges,
+        "b_nodes": compat.b_nodes_bi,
+        "boundary": compat.bnodes_p,
+        "step_num": compat.step_num,
+    }
+    part = compat.Partition(lat, signed, updaters)
+    return rng, lat, part
+
+
+def test_boundary_condition_and_bnodes_p():
+    rng, lat, part = _frame_chain_parts()
+    # stripes plan: the frame touches both districts
+    assert compat.boundary_condition(part)
+    assert set(part["boundary"]) == {
+        lat.labels[i] for i in np.nonzero(lat.frame_mask)[0]}
+    # all-one-district partition: frame touches one district only
+    mono = compat.Partition(
+        lat, {lab: 1 for lab in lat.labels},
+        {"boundary": compat.bnodes_p, "cut_edges": compat.cut_edges})
+    assert not compat.boundary_condition(mono)
+
+
+def test_fixed_endpoints_predicate():
+    _, lat, part = _frame_chain_parts()
+    # vertical stripes on 6x6: (2,y) and (3,y) straddle the boundary
+    pred = compat.make_fixed_endpoints(
+        pairs=(((2, 0), (3, 0)), ((2, 5), (3, 5))))
+    assert pred(part)
+    bad = compat.make_fixed_endpoints(pairs=(((0, 0), (0, 1)),))
+    assert not bad(part)
+
+
+def test_uniform_accept_requires_frame_interface():
+    rng, lat, part = _frame_chain_parts()
+    popbound = compat.within_percent_of_ideal_population(part, 0.9)
+    acc = compat.make_uniform_accept(rng, popbound)
+    # initial state: parent is None so single_flip_contiguous falls back to
+    # full contiguity; stripes are contiguous and touch the frame => accept
+    assert acc(part)
+
+
+def test_linear_beta_schedule_matches_commented_reference():
+    beta = compat.linear_beta_schedule(t0=100000, ramp=100000, beta_max=3)
+    assert beta(0) == 0.0
+    assert beta(100000) == 0.0
+    assert beta(250000) == pytest.approx(1.5)
+    assert beta(400000) == pytest.approx(3.0)
+    assert beta(10**7) == pytest.approx(3.0)
+
+
+def test_annealing_accept_matches_analytic_bound():
+    # Fixed parent and fixed (cut-increasing) child: the acceptance
+    # frequency must match base**(beta*delta) * |b(child)|/|b(parent)|.
+    rng, lat, part = _frame_chain_parts(seed=5)
+    popbound = compat.within_percent_of_ideal_population(part, 0.9)
+    base, beta = 10.0, 1.0
+    acc = compat.make_annealing_cut_accept_backwards(
+        rng, popbound, base=base, beta=beta)
+    child = part.flip({(2, 0): -part.assignment[(2, 0)]})
+    delta = -len(child["cut_edges"]) + len(part["cut_edges"])
+    assert delta == -1  # flipping a stripe-edge corner node adds one cut
+    b1 = {x for e in child["cut_edges"] for x in e}
+    b2 = {x for e in part["cut_edges"] for x in e}
+    expected = (base ** (beta * delta)) * (len(b1) / len(b2))
+    assert 0.01 < expected < 0.99
+    n = 4000
+    freq = sum(acc(child) for _ in range(n)) / n
+    assert abs(freq - expected) < 4 * np.sqrt(expected * (1 - expected) / n)

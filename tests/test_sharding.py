@@ -73,7 +73,7 @@ def test_within_batch_tempering_swaps():
     res = fce.run_chains(dg, spec, params, states, n_steps=60)
     key = jax.random.PRNGKey(0)
     p2, accept = tempering.swap_within_batch(
-        key, res.state, params, n_rungs=4, parity=0)
+        key, res.state, params, n_rungs=4, parity=0, spec=spec)
     accept = np.asarray(accept)
     b0 = np.asarray(params.beta).reshape(4, 4)
     b2 = np.asarray(p2.beta).reshape(4, 4)
