@@ -10,6 +10,7 @@ from .partition import (
     Partition, Tally, cut_edges, b_nodes_bi, b_nodes_pairs,
     make_geom_wait, make_boundary_slope, step_num, bnodes_p,
 )
+from .recom import make_recom, random_spanning_tree, bipartition_tree
 from .chain import (
     MarkovChain, Validator, within_percent_of_ideal_population,
     single_flip_contiguous, contiguous,
@@ -30,4 +31,5 @@ __all__ = [
     "make_cut_accept", "make_corrected_cut_accept",
     "make_fixed_endpoints", "boundary_condition", "make_uniform_accept",
     "linear_beta_schedule", "make_annealing_cut_accept_backwards",
+    "make_recom", "random_spanning_tree", "bipartition_tree",
 ]

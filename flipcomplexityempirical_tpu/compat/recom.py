@@ -1,0 +1,169 @@
+"""ReCom (recombination) spanning-tree proposal — the gerrychain surface the
+reference constructs but never wires into a chain (grid_chain_sec11.py:
+328-335: ``partial(recom, pop_col="population", pop_target=ideal,
+epsilon=0.05, node_repeats=1)``; a live capability target per SURVEY.md
+section 2.2 row 21 and the BASELINE.json config lineage).
+
+Semantics (gerrychain ~0.2.x recom):
+1. pick a uniformly random cut edge; the two districts it straddles merge;
+2. draw a random spanning tree of the merged induced subgraph (random iid
+   edge weights -> minimum spanning tree, gerrychain's
+   ``random_spanning_tree``);
+3. find a tree edge whose removal splits the merged region into two sides
+   each within ``epsilon * pop_target`` of ``pop_target`` (gerrychain's
+   ``bipartition_tree``), retrying with a fresh tree up to ``node_repeats``
+   times per cut edge;
+4. reassign the two sides to the two district labels.
+
+Both split sides are connected by construction (each is a subtree), so no
+contiguity check is needed on recom moves.
+
+The batched TPU implementation of the same move is sampling/recom.py; this
+host version is its oracle and the ``backend="python"`` path.
+"""
+
+from __future__ import annotations
+
+from functools import partial  # noqa: F401  (mirrors the reference import)
+from typing import Callable, Optional
+
+import numpy as np
+
+from .partition import Partition
+
+
+class _UnionFind:
+    def __init__(self, n: int):
+        self.parent = list(range(n))
+
+    def find(self, x: int) -> int:
+        while self.parent[x] != x:
+            self.parent[x] = self.parent[self.parent[x]]
+            x = self.parent[x]
+        return x
+
+    def union(self, a: int, b: int) -> bool:
+        ra, rb = self.find(a), self.find(b)
+        if ra == rb:
+            return False
+        self.parent[ra] = rb
+        return True
+
+
+def random_spanning_tree(graph, nodes: np.ndarray,
+                         rng: np.random.Generator) -> list:
+    """Random-weight MST of the subgraph induced by ``nodes`` (index array):
+    iid uniform edge weights + Kruskal — gerrychain's tree distribution.
+    Returns a list of edge-index pairs (u, v). Raises if the induced
+    subgraph is disconnected (cannot happen for a merged district pair)."""
+    member = np.zeros(graph.n_nodes, dtype=bool)
+    member[nodes] = True
+    eu, ev = graph.edges[:, 0], graph.edges[:, 1]
+    internal = np.nonzero(member[eu] & member[ev])[0]
+    order = internal[np.argsort(rng.random(len(internal)))]
+    uf = _UnionFind(graph.n_nodes)
+    tree = []
+    need = len(nodes) - 1
+    for ei in order:
+        u, v = int(eu[ei]), int(ev[ei])
+        if uf.union(u, v):
+            tree.append((u, v))
+            if len(tree) == need:
+                break
+    if len(tree) != need:
+        raise ValueError("induced subgraph is disconnected")
+    return tree
+
+
+def bipartition_tree(graph, nodes: np.ndarray, pop: np.ndarray,
+                     pop_target: float, epsilon: float,
+                     rng: np.random.Generator,
+                     max_attempts: int = 1000) -> Optional[np.ndarray]:
+    """Split ``nodes`` into two connected sides with populations within
+    ``epsilon * pop_target`` of ``pop_target`` by cutting one edge of a
+    random spanning tree. A tree with no balanced edge is redrawn, up to
+    ``max_attempts`` trees (gerrychain's bipartition_tree loops unbounded;
+    the cap here trades a hang for a None return). Returns the node-index
+    array of one side, or None."""
+    total = float(pop[nodes].sum())
+    lo, hi = pop_target * (1 - epsilon), pop_target * (1 + epsilon)
+    if not (2 * lo <= total <= 2 * hi):
+        return None  # no tree edge can balance an infeasible total
+    for _ in range(max(1, max_attempts)):
+        tree = random_spanning_tree(graph, nodes, rng)
+        adj: dict[int, list[int]] = {int(x): [] for x in nodes}
+        for (u, v) in tree:
+            adj[u].append(v)
+            adj[v].append(u)
+        # iterative post-order from an arbitrary root: subtree populations
+        root = int(nodes[0])
+        parent = {root: -1}
+        order = [root]
+        stack = [root]
+        while stack:
+            x = stack.pop()
+            for y in adj[x]:
+                if y not in parent:
+                    parent[y] = x
+                    order.append(y)
+                    stack.append(y)
+        sub = {x: float(pop[x]) for x in parent}
+        for x in reversed(order[1:]):
+            sub[parent[x]] += sub[x]
+        balanced = [x for x in order[1:]
+                    if lo <= sub[x] <= hi and lo <= total - sub[x] <= hi]
+        if not balanced:
+            continue
+        cut_child = balanced[rng.integers(len(balanced))]
+        # the chosen side = the subtree under cut_child (children of x are
+        # exactly the tree neighbors whose parent is x)
+        side = []
+        stack = [cut_child]
+        while stack:
+            x = stack.pop()
+            side.append(x)
+            stack.extend(y for y in adj[x] if parent[y] == x)
+        return np.asarray(side, dtype=np.int64)
+    return None
+
+
+def make_recom(rng: np.random.Generator, pop_col: str = "population",
+               pop_target: Optional[float] = None, epsilon: float = 0.05,
+               node_repeats: int = 1) -> Callable:
+    """The proposal factory matching the reference's partial(recom, ...)
+    call shape (grid_chain_sec11.py:330-335). ``pop_target`` defaults to
+    half the merged pair's population. ``node_repeats`` scales the
+    tree-redraw budget (node_repeats * 1000 attempts, approximating
+    gerrychain's unbounded redraw loop); exhausting it degrades to the
+    identity move, keeping total-step semantics intact."""
+
+    def propose(partition: Partition) -> Partition:
+        g = partition.graph
+        a = partition.assignment_array
+        mask = partition.cut_edge_mask()
+        cut_ids = np.nonzero(mask)[0]
+        if len(cut_ids) == 0:
+            return partition.flip({})
+        e = int(cut_ids[rng.integers(len(cut_ids))])
+        u, v = int(g.edges[e, 0]), int(g.edges[e, 1])
+        d1, d2 = int(a[u]), int(a[v])
+        nodes = np.nonzero((a == d1) | (a == d2))[0]
+        # per-node weights come from the graph metadata, same as Tally
+        # (pop_col exists for call-shape parity with the reference partial)
+        pop = np.asarray(g.pop, dtype=np.float64)
+        target = (pop_target if pop_target is not None
+                  else float(pop[nodes].sum()) / 2.0)
+        side = bipartition_tree(g, nodes, pop, target, epsilon, rng,
+                                max_attempts=max(1, node_repeats) * 1000)
+        if side is None:
+            return partition.flip({})
+        in_side = np.zeros(g.n_nodes, dtype=bool)
+        in_side[side] = True
+        flips = {}
+        for x in nodes:
+            newd = d1 if in_side[x] else d2
+            if int(a[x]) != newd:
+                flips[g.labels[x]] = newd
+        return partition.flip(flips)
+
+    return propose

@@ -123,4 +123,4 @@ def states_struct():
         key=0, assignment=0, cut=0, cut_deg=0, dist_pop=0, cut_count=0,
         b_count=0, cur_wait=0, cur_flip_node=0, t_yield=0, part_sum=0,
         last_flipped=0, num_flips=0, cut_times=0, waits_sum=0,
-        accept_count=0, tries_sum=0, exhausted_count=0)
+        move_clock=0, accept_count=0, tries_sum=0, exhausted_count=0)

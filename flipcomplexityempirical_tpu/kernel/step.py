@@ -116,7 +116,7 @@ def effective_beta(spec: Spec, params: StepParams, state: ChainState):
     if spec.anneal == "none":
         return params.beta
     if spec.anneal == "linear":
-        t = (state.accept_count + 1).astype(jnp.float32)
+        t = (state.move_clock + 1).astype(jnp.float32)
         return jnp.clip((t - params.anneal_t0) / params.anneal_ramp,
                         0.0, params.anneal_beta_max)
     raise ValueError(f"anneal mode {spec.anneal!r}")
@@ -287,6 +287,7 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
         key=key, assignment=a_new, cut=cut, cut_deg=cut_deg,
         dist_pop=dist_pop, cut_count=cut_count, b_count=b_count,
         cur_wait=cur_wait, cur_flip_node=cur_flip_node,
+        move_clock=state.move_clock + accept.astype(jnp.int32),
         accept_count=state.accept_count + accept.astype(jnp.int32),
         tries_sum=state.tries_sum + tries,
         exhausted_count=state.exhausted_count + (~valid).astype(jnp.int32),

@@ -1,3 +1,5 @@
 from .runner import RunResult, run_chains, init_batch, pop_bounds
+from .recom import recom_move
 
-__all__ = ["RunResult", "run_chains", "init_batch", "pop_bounds"]
+__all__ = ["RunResult", "run_chains", "init_batch", "pop_bounds",
+           "recom_move"]

@@ -1,0 +1,258 @@
+"""Batched TPU ReCom: the spanning-tree recombination move as a jit+vmap
+kernel over the (n_chains, n_nodes) assignment tensor.
+
+The host oracle (semantics source) is compat/recom.py; the reference
+constructs exactly this proposal at grid_chain_sec11.py:328-335. The
+vectorized redesign replaces every data-dependent structure with
+fixed-shape array passes:
+
+- random spanning tree: iid uniform edge weights -> minimum spanning forest
+  via Boruvka rounds (scatter-min per component + pointer-jumping union),
+  the parallel-friendly MST that matches gerrychain's random-weight-MST
+  tree distribution;
+- rooting + subtree populations: parent pointers by masked BFS
+  (lax.while_loop frontier expansion), then leaf-to-root accumulation by
+  scatter-adding each BFS level from deepest to shallowest;
+- balanced-cut choice: masked Gumbel-max over tree edges whose subtree
+  population lands both sides within epsilon of target;
+- the move commits by relabeling one subtree and re-deriving the chain
+  state's incremental fields (a recom move touches O(N) nodes, so a full
+  O(E) re-derive is the right cost model, unlike the O(deg) flip commit).
+
+A chain whose bipartition attempt finds no balanced tree edge keeps its
+current partition for that round (the host path's node_repeats retry
+becomes "retry next round": with batched chains, per-chain retry loops
+would straggle the whole batch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graphs.lattice import DeviceGraph
+from ..kernel import step as kstep
+from ..kernel.step import Spec
+from ..state.chain_state import ChainState, derive
+
+
+def _ceil_log2(n: int) -> int:
+    b = 1
+    while (1 << b) < n:
+        b += 1
+    return b
+
+
+def spanning_forest(dg: DeviceGraph, member, key):
+    """Random minimum-spanning-forest edge mask of the subgraph induced by
+    ``member`` (bool[N]): Boruvka with iid uniform weights. Non-member
+    nodes stay singleton components. Returns bool[E]."""
+    n, e = dg.n_nodes, dg.n_edges
+    eu, ev = dg.edges[:, 0], dg.edges[:, 1]
+    internal = member[eu] & member[ev]
+    # Random-MST depends only on the weight ORDER, so draw a uniform random
+    # permutation as integer ranks: ties are impossible by construction
+    # (float iid uniforms collide, and Boruvka with ties can cycle). Kept
+    # as int32 — a float32 cast would re-introduce ties above 2^24 edges.
+    w = jax.random.permutation(key, e).astype(jnp.int32)
+    big = jnp.int32(e)  # ranks are 0..e-1, so e acts as +inf
+
+    def round_body(carry):
+        comp, in_tree, _ = carry
+        cu, cv = comp[eu], comp[ev]
+        alive = internal & (cu != cv)
+        we = jnp.where(alive, w, big)
+        # per-component minimum outgoing edge (scatter-min both endpoints)
+        best = jnp.full(n, big, jnp.int32).at[cu].min(we).at[cv].min(we)
+        # an edge is selected if it is the minimum for either component
+        sel = alive & ((we <= best[cu]) | (we <= best[cv]))
+        # union: point the larger component id at the smaller (deterministic)
+        lo = jnp.minimum(cu, cv)
+        hi = jnp.maximum(cu, cv)
+        parent = jnp.arange(n).at[jnp.where(sel, hi, 0)].min(
+            jnp.where(sel, lo, n))
+        parent = jnp.minimum(parent, jnp.arange(n))
+        # pointer jumping to canonical roots
+        for _ in range(_ceil_log2(max(n, 2))):
+            parent = parent[parent]
+        comp = parent[comp]
+        return comp, in_tree | sel, alive.any()
+
+    def cond(carry):
+        return carry[2]
+
+    comp0 = jnp.arange(n)
+    in_tree0 = jnp.zeros(e, dtype=bool)
+    comp, in_tree, _ = jax.lax.while_loop(
+        cond, round_body, (comp0, in_tree0, jnp.bool_(True)))
+    return in_tree
+
+
+def tree_structure(dg: DeviceGraph, in_tree, member, root):
+    """Parent pointers and BFS depth for the spanning tree restricted to
+    ``member``, rooted at ``root``. parent[root] = root; non-members keep
+    parent = self, depth = -1. Returns (parent i32[N], depth i32[N])."""
+    n = dg.n_nodes
+    tree_nbr = in_tree[dg.nbr_edge] & dg.nbr_mask          # (N, D)
+    parent0 = jnp.arange(n).at[root].set(root)
+    depth0 = jnp.full(n, -1, jnp.int32).at[root].set(0)
+
+    def cond(carry):
+        _, _, frontier, lvl = carry
+        return frontier.any()
+
+    def body(carry):
+        parent, depth, frontier, lvl = carry
+        # nodes adjacent (in-tree) to the frontier and not yet visited
+        hit = (frontier[dg.nbr] & tree_nbr).any(axis=1)
+        new = hit & (depth < 0) & member
+        # choose the parent = any frontier tree-neighbor (first slot wins)
+        nbr_is_par = frontier[dg.nbr] & tree_nbr
+        first = jnp.argmax(nbr_is_par, axis=1)
+        cand = dg.nbr[jnp.arange(n), first]
+        parent = jnp.where(new, cand, parent)
+        depth = jnp.where(new, lvl + 1, depth)
+        return parent, depth, new, lvl + 1
+
+    parent, depth, _, _ = jax.lax.while_loop(
+        cond, body, (parent0, depth0,
+                     jnp.zeros(n, bool).at[root].set(True), jnp.int32(0)))
+    return parent, depth
+
+
+def subtree_populations(dg: DeviceGraph, parent, depth):
+    """f32[N] subtree population sums via level-by-level rollup from the
+    deepest BFS level to the root."""
+    n = dg.n_nodes
+    pop = jnp.where(depth >= 0, dg.pop.astype(jnp.float32), 0.0)
+    maxd = depth.max()
+
+    def cond(carry):
+        _, lvl = carry
+        return lvl > 0
+
+    def body(carry):
+        acc, lvl = carry
+        at_lvl = depth == lvl
+        acc = acc.at[jnp.where(at_lvl, parent, n)].add(
+            jnp.where(at_lvl, acc, 0.0), mode="drop")
+        return acc, lvl - 1
+
+    acc, _ = jax.lax.while_loop(cond, body, (pop, maxd))
+    return acc
+
+
+def mark_subtree(dg: DeviceGraph, parent, depth, cut_child):
+    """bool[N]: nodes whose root-path passes through ``cut_child``, by
+    top-down level sweep (a node is in the subtree iff its parent is,
+    seeded at cut_child)."""
+    n = dg.n_nodes
+    mark0 = jnp.zeros(n, bool).at[cut_child].set(True)
+    maxd = depth.max()
+
+    def cond(carry):
+        _, lvl = carry
+        return lvl <= maxd
+
+    def body(carry):
+        mark, lvl = carry
+        at_lvl = (depth == lvl) & (jnp.arange(n) != cut_child)
+        mark = mark | (at_lvl & mark[parent])
+        return mark, lvl + 1
+
+    mark, _ = jax.lax.while_loop(
+        cond, body, (mark0, depth[cut_child] + 1))
+    return mark
+
+
+def recom_move(dg: DeviceGraph, spec: Spec, state: ChainState,
+               epsilon: float = 0.05, pop_target=None, label_values=None):
+    """One ReCom move for one chain (vmap over chains): merge the two
+    districts straddling a random cut edge, tree-bipartition, commit if a
+    balanced cut exists. Returns the new ChainState (unchanged assignment
+    when no balanced edge was found).
+
+    ``pop_target`` is the ideal per-district population the split sides
+    must land within epsilon of (the reference's pop_target,
+    grid_chain_sec11.py:330-335); default = half the merged pair's total
+    (exact only while district populations haven't drifted).
+
+    ``label_values`` (i32[K] district -> +1/-1 label, as in StepParams) is
+    required to keep the reference part_sum/num_flips parity metrics
+    consistent when interleaving recom with flip chains; None skips the
+    settlement (fine when parity metrics are unused)."""
+    n = dg.n_nodes
+    key, k_edge, k_tree, k_cut, k_wait = jax.random.split(state.key, 5)
+    a = state.assignment.astype(jnp.int32)
+
+    # 1. random cut edge -> merged district pair
+    cut_mask = state.cut > 0
+    u = jax.random.uniform(k_edge, (dg.n_edges,))
+    e_star = jnp.argmax(jnp.where(cut_mask, u, -1.0))
+    any_cut = cut_mask.any()
+    d1 = a[dg.edges[e_star, 0]]
+    d2 = a[dg.edges[e_star, 1]]
+    member = (a == d1) | (a == d2)
+
+    # 2. random spanning tree of the merged region
+    in_tree = spanning_forest(dg, member, k_tree)
+    root = dg.edges[e_star, 0]
+    parent, depth = tree_structure(dg, in_tree, member, root)
+
+    # 3. balanced tree edge via masked Gumbel-max
+    sub = subtree_populations(dg, parent, depth)
+    total = sub[root]
+    target = total / 2.0 if pop_target is None else jnp.float32(pop_target)
+    lo, hi = target * (1 - epsilon), target * (1 + epsilon)
+    is_tree_child = (depth > 0)  # every non-root member cuts its parent edge
+    ok = is_tree_child & (sub >= lo) & (sub <= hi) \
+        & (total - sub >= lo) & (total - sub <= hi)
+    g = jax.random.gumbel(k_cut, (n,))
+    cut_child = jnp.argmax(jnp.where(ok, g, -jnp.inf))
+    found = ok.any() & any_cut
+
+    # 4. commit: subtree -> d1, rest of merged region -> d2
+    side = mark_subtree(dg, parent, depth, cut_child)
+    a_new = jnp.where(member, jnp.where(side, d1, d2), a)
+    a_new = jnp.where(found, a_new, a).astype(state.assignment.dtype)
+
+    cut, cut_deg, dist_pop, cut_count, b_count = derive(
+        dg, a_new, spec.n_districts)
+
+    # settle per-node parity clocks for relabeled nodes: credit the OLD
+    # sign over (last_flipped, now], stamp the relabel time, and count the
+    # relabel as a flip — otherwise the next flip-kernel record()
+    # attributes the pre-recom interval to the post-recom sign
+    # (kernel/step.py record; reference part_sum semantics,
+    # grid_chain_sec11.py:396-400).
+    part_sum = state.part_sum
+    last_flipped = state.last_flipped
+    num_flips = state.num_flips
+    if spec.parity_metrics and label_values is not None:
+        lv = jnp.asarray(label_values, jnp.int32)
+        changed = a_new.astype(jnp.int32) != a
+        t_now = state.t_yield
+        part_sum = part_sum + jnp.where(
+            changed, lv[a] * (t_now - last_flipped), 0)
+        last_flipped = jnp.where(changed, t_now, last_flipped)
+        num_flips = num_flips + changed.astype(jnp.int32)
+
+    # a committed recom changes the boundary wholesale: the memoized
+    # geometric wait must be resampled from the NEW |b_nodes|, and the
+    # flip-bookkeeping cursor cleared (recom is not a single-node flip, so
+    # the reference's per-node flip metrics don't apply to this move)
+    if spec.geom_waits:
+        wait_new = kstep.sample_geom_minus1(
+            k_wait, b_count, dg.n_nodes, spec.n_districts)
+        cur_wait = jnp.where(found, wait_new, state.cur_wait)
+    else:
+        cur_wait = state.cur_wait
+    cur_flip_node = jnp.where(found, jnp.int32(-1), state.cur_flip_node)
+    return state.replace(
+        key=key, assignment=a_new, cut=cut.astype(state.cut.dtype),
+        cut_deg=cut_deg.astype(state.cut_deg.dtype), dist_pop=dist_pop,
+        cut_count=cut_count, b_count=b_count,
+        cur_wait=cur_wait, cur_flip_node=cur_flip_node,
+        part_sum=part_sum, last_flipped=last_flipped, num_flips=num_flips,
+        move_clock=state.move_clock + found.astype(jnp.int32),
+        accept_count=state.accept_count + found.astype(jnp.int32))

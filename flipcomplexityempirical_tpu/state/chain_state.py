@@ -34,6 +34,10 @@ class ChainState:
     cur_wait: jnp.ndarray      # float32 scalar, memoized geometric wait
     cur_flip_node: jnp.ndarray  # int32 scalar, -1 until first acceptance
     t_yield: jnp.ndarray       # int32 scalar, number of yields recorded
+    move_clock: jnp.ndarray    # int32 scalar: accepted moves since init —
+                               # the reference's step_num; load-bearing for
+                               # Spec.anneal schedules, NEVER reset mid-run
+                               # (unlike the accept_count telemetry below)
     # accumulators (reference metric store)
     part_sum: jnp.ndarray      # int32[N] time-integral of signed membership
     last_flipped: jnp.ndarray  # int32[N]
@@ -96,6 +100,7 @@ def init_state(dg: DeviceGraph, assignment: jnp.ndarray, k: int,
         num_flips=jnp.zeros(dg.n_nodes, jnp.int32),
         cut_times=jnp.zeros(dg.n_edges, jnp.int32),
         waits_sum=jnp.float32(0.0),
+        move_clock=jnp.int32(0),
         accept_count=jnp.int32(0),
         tries_sum=jnp.int32(0),
         exhausted_count=jnp.int32(0),

@@ -1,0 +1,287 @@
+"""ReCom proposal tests: host oracle invariants, then the batched JAX
+kernel validated against the same invariants (tree is spanning, split is
+balanced, both sides connected, untouched districts untouched)."""
+
+import numpy as np
+import networkx as nx
+import jax
+import jax.numpy as jnp
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import compat
+from flipcomplexityempirical_tpu.sampling import recom as jrecom
+from flipcomplexityempirical_tpu.state import derive
+
+
+def nx_graph(lat):
+    return nx.Graph(list(map(tuple, lat.edges)))
+
+
+# ---------------------------------------------------------------------------
+# host oracle
+# ---------------------------------------------------------------------------
+
+def test_random_spanning_tree_is_spanning():
+    rng = np.random.default_rng(0)
+    lat = fce.graphs.square_grid(6, 6)
+    nodes = np.arange(lat.n_nodes)
+    tree = compat.random_spanning_tree(lat, nodes, rng)
+    assert len(tree) == lat.n_nodes - 1
+    t = nx.Graph(tree)
+    assert t.number_of_nodes() == lat.n_nodes
+    assert nx.is_tree(t)
+
+
+def test_bipartition_tree_balance_and_connectivity():
+    rng = np.random.default_rng(1)
+    lat = fce.graphs.square_grid(8, 8)
+    nodes = np.arange(lat.n_nodes)
+    pop = np.asarray(lat.pop, dtype=np.float64)
+    target = pop.sum() / 2
+    for seed in range(5):
+        side = compat.bipartition_tree(lat, nodes, pop, target, 0.1,
+                                       np.random.default_rng(seed))
+        assert side is not None
+        s = pop[side].sum()
+        assert target * 0.9 <= s <= target * 1.1
+        g = nx_graph(lat)
+        other = np.setdiff1d(nodes, side)
+        assert nx.is_connected(g.subgraph(side.tolist()))
+        assert nx.is_connected(g.subgraph(other.tolist()))
+
+
+def test_host_recom_chain_preserves_invariants():
+    rng = np.random.default_rng(2)
+    lat = fce.graphs.square_grid(8, 8)
+    plan = fce.graphs.stripes_plan(lat, 4)
+    updaters = {"population": compat.Tally("population"),
+                "cut_edges": compat.cut_edges,
+                "step_num": compat.step_num}
+    part = compat.Partition(lat, plan, updaters)
+    ideal = lat.n_nodes / 4
+    proposal = compat.make_recom(rng, pop_target=ideal, epsilon=0.25,
+                                 node_repeats=2)
+    g = nx_graph(lat)
+    moved = 0
+    for _ in range(15):
+        child = proposal(part)
+        if child.flips:
+            moved += 1
+        a = child.assignment_array
+        # exactly 4 districts, all connected, all within pop bounds
+        assert set(np.unique(a)) == set(np.unique(plan))
+        for d in np.unique(a):
+            members = np.nonzero(a == d)[0].tolist()
+            assert nx.is_connected(g.subgraph(members))
+            assert ideal * 0.75 - 1e-9 <= len(members) <= ideal * 1.25 + 1e-9
+        part = child
+    assert moved >= 10  # recom on a loose tolerance should mostly succeed
+
+
+def test_host_recom_only_touches_merged_pair():
+    rng = np.random.default_rng(3)
+    lat = fce.graphs.square_grid(8, 8)
+    plan = fce.graphs.stripes_plan(lat, 4)
+    part = compat.Partition(
+        lat, plan, {"population": compat.Tally("population"),
+                    "cut_edges": compat.cut_edges})
+    proposal = compat.make_recom(rng, pop_target=lat.n_nodes / 4,
+                                 epsilon=0.25, node_repeats=2)
+    for _ in range(10):
+        child = proposal(part)
+        if not child.flips:
+            continue
+        changed_from = {int(part.assignment_array[lat.index[lab]])
+                        for lab in child.flips}
+        changed_to = {int(v) for v in child.flips.values()}
+        assert len(changed_from | changed_to) <= 2
+
+
+# ---------------------------------------------------------------------------
+# batched JAX kernel
+# ---------------------------------------------------------------------------
+
+def setup_jax(n=8, k=2, chains=8, seed=0):
+    g = fce.graphs.square_grid(n, n)
+    plan = fce.graphs.stripes_plan(g, k)
+    spec = fce.Spec(n_districts=k, proposal="pair" if k > 2 else "bi")
+    dg, states, params = fce.init_batch(
+        g, plan, n_chains=chains, seed=seed, spec=spec, base=1.0,
+        pop_tol=0.5)
+    return g, dg, spec, states
+
+
+def test_jax_spanning_forest_is_spanning_tree():
+    g, dg, spec, states = setup_jax()
+    n = dg.n_nodes
+    member = jnp.ones(n, bool)
+    in_tree = jrecom.spanning_forest(dg, member, jax.random.PRNGKey(0))
+    in_tree = np.asarray(in_tree)
+    assert in_tree.sum() == n - 1
+    t = nx.Graph([tuple(e) for e in np.asarray(dg.edges)[in_tree]])
+    assert t.number_of_nodes() == n and nx.is_tree(t)
+
+
+def test_jax_spanning_forest_respects_membership():
+    g, dg, spec, states = setup_jax()
+    a = np.asarray(states.assignment)[0]
+    member = jnp.asarray(a == a[0])
+    in_tree = np.asarray(
+        jrecom.spanning_forest(dg, member, jax.random.PRNGKey(1)))
+    edges = np.asarray(dg.edges)
+    m = np.asarray(member)
+    assert (m[edges[in_tree][:, 0]] & m[edges[in_tree][:, 1]]).all()
+    assert in_tree.sum() == m.sum() - 1
+
+
+def test_jax_tree_structure_and_subtree_pops():
+    g, dg, spec, states = setup_jax(n=6)
+    n = dg.n_nodes
+    member = jnp.ones(n, bool)
+    key = jax.random.PRNGKey(2)
+    in_tree = jrecom.spanning_forest(dg, member, key)
+    parent, depth = jrecom.tree_structure(dg, in_tree, member, jnp.int32(0))
+    parent, depth = np.asarray(parent), np.asarray(depth)
+    assert depth[0] == 0 and parent[0] == 0
+    assert (depth >= 0).all()
+    # parent depth is one less
+    nz = np.arange(n) != 0
+    assert (depth[parent[nz]] == depth[nz] - 1).all()
+    sub = np.asarray(jrecom.subtree_populations(
+        dg, jnp.asarray(parent), jnp.asarray(depth)))
+    assert sub[0] == n  # root subtree = everything (unit pops)
+    # oracle: per-node subtree sums via networkx descendants
+    t = nx.DiGraph([(int(parent[i]), i) for i in range(n) if i != 0])
+    for v in [3, 7, n - 1]:
+        expect = 1 + len(nx.descendants(t, v)) if v in t else 1
+        assert sub[v] == expect
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_jax_recom_move_invariants(k):
+    g, dg, spec, states = setup_jax(n=8, k=k, chains=8, seed=3)
+    move = jax.jit(jax.vmap(
+        lambda s: jrecom.recom_move(dg, spec, s, epsilon=0.4),
+        in_axes=0), static_argnums=())
+    gx = nx_graph(g)
+    s = states
+    for it in range(3):
+        s = move(s)
+    a_all = np.asarray(s.assignment)
+    found = np.asarray(s.accept_count)
+    assert (found > 0).any()  # at least some chains executed real moves
+    for c in range(a_all.shape[0]):
+        a = a_all[c]
+        assert set(np.unique(a)) == set(range(k))
+        for d in range(k):
+            members = np.nonzero(a == d)[0].tolist()
+            assert nx.is_connected(gx.subgraph(members))
+    # derived fields consistent
+    cut, cdeg, dpop, cc, bc = jax.vmap(
+        lambda a: derive(dg, a, k))(jnp.asarray(a_all))
+    assert (np.asarray(cut) == np.asarray(s.cut)).all()
+    assert (np.asarray(dpop) == np.asarray(s.dist_pop)).all()
+
+
+def test_jax_recom_balance():
+    # epsilon bounds hold for every executed move
+    g, dg, spec, states = setup_jax(n=10, k=2, chains=16, seed=4)
+    eps = 0.1
+    move = jax.jit(jax.vmap(
+        lambda s: jrecom.recom_move(dg, spec, s, epsilon=eps)))
+    s2 = move(states)
+    a = np.asarray(s2.assignment)
+    moved = np.asarray(s2.accept_count) > 0
+    assert moved.any()
+    target = g.n_nodes / 2
+    for c in np.nonzero(moved)[0]:
+        pops = np.bincount(a[c], minlength=2)
+        assert (np.abs(pops - target) <= eps * target + 1e-6).all()
+
+
+def test_jax_recom_pop_target_k4():
+    # global ideal target honored for a k=4 merged pair
+    g, dg, spec, states = setup_jax(n=8, k=4, chains=16, seed=5)
+    ideal = g.n_nodes / 4
+    eps = 0.25
+    move = jax.jit(jax.vmap(
+        lambda s: jrecom.recom_move(dg, spec, s, epsilon=eps,
+                                    pop_target=ideal)))
+    s2 = move(states)
+    a = np.asarray(s2.assignment)
+    moved = np.asarray(s2.accept_count) > 0
+    assert moved.any()
+    for c in np.nonzero(moved)[0]:
+        pops = np.bincount(a[c], minlength=4)
+        assert (np.abs(pops - ideal) <= eps * ideal + 1e-6).all()
+
+
+def test_spanning_forest_always_tree_many_keys():
+    g, dg, spec, states = setup_jax(n=7)
+    n = dg.n_nodes
+    member = jnp.ones(n, bool)
+    sf = jax.jit(lambda k: jrecom.spanning_forest(dg, member, k))
+    for seed in range(20):
+        in_tree = np.asarray(sf(jax.random.PRNGKey(seed)))
+        assert in_tree.sum() == n - 1
+        t = nx.Graph([tuple(e) for e in np.asarray(dg.edges)[in_tree]])
+        assert t.number_of_nodes() == n and nx.is_tree(t)
+
+
+def test_move_clock_survives_telemetry_reset():
+    # the anneal clock must not reset when telemetry counters are zeroed
+    # (the bench warmup pattern)
+    spec = fce.Spec(anneal="linear")
+    g = fce.graphs.square_grid(8, 8)
+    plan = fce.graphs.stripes_plan(g, 2)
+    dg, states, params = fce.init_batch(
+        g, plan, n_chains=4, seed=6, spec=spec, base=0.5, pop_tol=0.5)
+    res = fce.run_chains(dg, spec, params, states, n_steps=100)
+    s = res.state
+    clock1 = np.asarray(s.move_clock).copy()
+    assert (clock1 == np.asarray(s.accept_count)).all()
+    s = s.replace(accept_count=jnp.zeros_like(s.accept_count))
+    res2 = fce.run_chains(dg, spec, params, s, n_steps=100)
+    s2 = res2.state
+    assert (np.asarray(s2.move_clock)
+            >= clock1 + np.asarray(s2.accept_count)).all()
+    assert (np.asarray(s2.move_clock) > np.asarray(s2.accept_count)).all()
+
+
+def test_jax_recom_settles_parity_clocks():
+    g, dg, spec, states = setup_jax(n=8, k=2, chains=8, seed=7)
+    lv = jnp.asarray([1, -1], jnp.int32)
+    res = fce.run_chains(dg, spec,
+                         fce.kernel.step.make_params(
+                             1.0, 0.0, g.n_nodes, lv, n_chains=8),
+                         states, n_steps=50)
+    s = res.state
+    a_before = np.asarray(s.assignment).copy()
+    nf_before = np.asarray(s.num_flips).copy()
+    lf_before = np.asarray(s.last_flipped).copy()
+    move = jax.jit(jax.vmap(
+        lambda st: jrecom.recom_move(dg, spec, st, epsilon=0.3,
+                                     label_values=lv)))
+    s2 = move(s)
+    a_after = np.asarray(s2.assignment)
+    t_now = np.asarray(s.t_yield)
+    for c in range(8):
+        changed = a_before[c] != a_after[c]
+        assert (np.asarray(s2.num_flips)[c][changed]
+                == nf_before[c][changed] + 1).all()
+        assert (np.asarray(s2.last_flipped)[c][changed] == t_now[c]).all()
+        un = ~changed
+        assert (np.asarray(s2.num_flips)[c][un] == nf_before[c][un]).all()
+        assert (np.asarray(s2.last_flipped)[c][un] == lf_before[c][un]).all()
+
+
+def test_host_bipartition_infeasible_total_fast_none():
+    lat = fce.graphs.square_grid(6, 6)
+    nodes = np.arange(lat.n_nodes)
+    pop = np.asarray(lat.pop, dtype=np.float64)
+    # target far from total/2: infeasible, must return None immediately
+    side = compat.bipartition_tree(lat, nodes, pop, pop.sum(), 0.05,
+                                   np.random.default_rng(0),
+                                   max_attempts=10**9)
+    assert side is None
