@@ -135,7 +135,16 @@ def make_recom(rng: np.random.Generator, pop_col: str = "population",
     half the merged pair's population. ``node_repeats`` scales the
     tree-redraw budget (node_repeats * 1000 attempts, approximating
     gerrychain's unbounded redraw loop); exhausting it degrades to the
-    identity move, keeping total-step semantics intact."""
+    identity move, keeping total-step semantics intact.
+
+    Population weights come from the graph's ``pop`` array (what
+    Tally('population') tallies); other columns are not wired up, and a
+    different ``pop_col`` raises rather than silently balancing the wrong
+    quantity."""
+    if pop_col != "population":
+        raise ValueError(
+            f"pop_col {pop_col!r} is not supported: balancing uses the "
+            "graph's pop array (the 'population' column)")
 
     def propose(partition: Partition) -> Partition:
         g = partition.graph

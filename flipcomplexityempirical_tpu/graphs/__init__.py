@@ -3,10 +3,15 @@ from .builders import (
     square_grid, grid_sec11, triangular_lattice, hex_lattice, frankengraph,
     sec11_plan, frank_plan, stripes_plan, PARITY_LABELS,
 )
+from .dualgraph import (
+    GeoAttributes, from_geojson, from_shapefile, synthetic_precincts,
+)
 
 __all__ = [
     "LatticeGraph", "DeviceGraph", "build_lattice", "from_networkx",
     "square_grid", "grid_sec11", "triangular_lattice", "hex_lattice",
     "frankengraph", "sec11_plan", "frank_plan", "stripes_plan",
     "PARITY_LABELS",
+    "GeoAttributes", "from_geojson", "from_shapefile",
+    "synthetic_precincts",
 ]

@@ -68,6 +68,7 @@ class DeviceGraph:
     frame_mask: jnp.ndarray   # bool[N]   reference "boundary_node" attr
     frame_idx: jnp.ndarray    # int32[F]  indices of frame nodes (static)
     wall_id: jnp.ndarray      # int8[E]   -1 none, 0..3 walls, 4 corner diag
+    edge_len: jnp.ndarray     # f32[E]    boundary-length weight (1 = count)
     patch_nodes: jnp.ndarray  # int32[N, P], pad = self
     patch_adj: jnp.ndarray    # uint32[N, P] bitset adjacency within patch
     patch_size: jnp.ndarray   # int32[N]
@@ -110,6 +111,7 @@ class LatticeGraph:
     coords: np.ndarray            # float64[N, 2]
     frame_mask: np.ndarray        # bool[N]
     wall_id: np.ndarray           # int8[E]
+    edge_len: np.ndarray          # f32[E] boundary-length weights
     patch_nodes: np.ndarray       # int32[N, P]
     patch_adj: np.ndarray         # uint32[N, P]
     patch_size: np.ndarray        # int32[N]
@@ -152,6 +154,7 @@ class LatticeGraph:
                 frame_idx=jnp.asarray(
                     np.nonzero(self.frame_mask)[0], jnp.int32),
                 wall_id=jnp.asarray(self.wall_id, jnp.int8),
+                edge_len=jnp.asarray(self.edge_len, jnp.float32),
                 patch_nodes=jnp.asarray(self.patch_nodes, jnp.int32),
                 patch_adj=jnp.asarray(self.patch_adj, jnp.uint32),
                 patch_size=jnp.asarray(self.patch_size, jnp.int32),
@@ -319,6 +322,7 @@ def build_lattice(
         coords=coords_arr,
         frame_mask=frame_mask,
         wall_id=wall_arr,
+        edge_len=np.ones(e, dtype=np.float32),
         patch_nodes=patch_nodes,
         patch_adj=patch_adj,
         patch_size=patch_size,

@@ -50,6 +50,11 @@ class Spec:
     frame_interface: bool = False  # boundary_condition constraint
                                    # (grid_chain_sec11.py:43-52): the outer
                                    # frame must touch >= 2 districts
+    weighted_cut: bool = False    # Metropolis on boundary LENGTH
+                                  # (sum of DeviceGraph.edge_len over cut
+                                  # edges) instead of cut-edge count — the
+                                  # geometric compactness target for real
+                                  # precinct dual graphs (BASELINE config 5)
     max_tries: int = 256          # re-propose cap per step
     record_interface: bool = False  # slope/angle wall metrics
     parity_metrics: bool = True   # reference-exact accumulator quirks
@@ -247,7 +252,12 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
 
     # Metropolis in log space: u < base**(beta * -dcut) [* b ratio]
     beta = effective_beta(spec, params, state)
-    log_bound = -beta * dcut.astype(jnp.float32) * params.log_base
+    if spec.weighted_cut:
+        dscore = jnp.sum(jnp.where(
+            nbm, delta.astype(jnp.float32) * dg.edge_len[eids], 0.0))
+    else:
+        dscore = dcut.astype(jnp.float32)
+    log_bound = -beta * dscore * params.log_base
     if spec.accept == "corrected":
         cut_deg_new = state.cut_deg.astype(jnp.int32)
         cut_deg_new = cut_deg_new.at[nb].add(jnp.where(nbm, delta, 0))

@@ -1,0 +1,126 @@
+"""Dual-graph importer tests: GeoJSON parsing, rook/queen adjacency,
+geometry attributes, compactness scores, and a k-district chain on a real
+(synthetic) precinct geometry with the boundary-length-weighted target."""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import graphs, stats
+
+
+def test_synthetic_precincts_rook_is_grid():
+    gj = graphs.synthetic_precincts(5, 4, seed=1)
+    assert len(gj["features"]) == 20
+    g, geo = graphs.from_geojson(gj, pop_property="POP",
+                                 name_property="NAME")
+    # rook adjacency of a jittered quad grid == the 5x4 grid graph
+    assert g.n_nodes == 20
+    assert g.n_edges == 5 * 3 + 4 * 4  # (nx*(ny-1) + (nx-1)*ny)
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    ref = nx.grid_2d_graph(5, 4)
+    assert nx.is_isomorphic(gx, ref)
+    # populations forwarded
+    assert g.pop.min() >= 80 and g.pop.max() <= 120
+    # labels preserved
+    assert "p0_0" in g.labels
+
+
+def test_geometry_attributes_consistency():
+    gj = graphs.synthetic_precincts(4, 4, seed=2, jitter=0.0)
+    g, geo = graphs.from_geojson(gj)
+    # unit squares: area 1, perimeter 4
+    assert np.allclose(geo.area, 1.0)
+    assert np.allclose(geo.perimeter, 4.0)
+    # every interior edge shares a unit segment
+    assert np.allclose(geo.shared_perim, 1.0)
+    # exterior perimeter: corners 2, edges 1, interior 0
+    n_corner = (np.isclose(geo.exterior_perim, 2.0)).sum()
+    n_side = (np.isclose(geo.exterior_perim, 1.0)).sum()
+    n_int = (np.isclose(geo.exterior_perim, 0.0)).sum()
+    assert (n_corner, n_side, n_int) == (4, 8, 4)
+    # total exterior == bounding square perimeter
+    assert np.isclose(geo.exterior_perim.sum(), 16.0)
+    # edge_len attached to the graph for weighted-cut chains
+    assert np.allclose(g.edge_len, 1.0)
+
+
+def test_queen_adjacency_supersets_rook():
+    gj = graphs.synthetic_precincts(4, 3, seed=3, jitter=0.0)
+    g_rook, _ = graphs.from_geojson(gj, adjacency="rook")
+    g_queen, _ = graphs.from_geojson(gj, adjacency="queen")
+    rook_edges = {tuple(e) for e in g_rook.edges.tolist()}
+    queen_edges = {tuple(e) for e in g_queen.edges.tolist()}
+    assert rook_edges < queen_edges
+    # queen adds the diagonal contacts: 2 per interior vertex
+    assert len(queen_edges) == len(rook_edges) + 2 * 3 * 2
+
+
+def test_polsby_popper_on_synthetic_state():
+    gj = graphs.synthetic_precincts(6, 6, seed=4, jitter=0.0)
+    g, geo = graphs.from_geojson(gj, pop_property="POP")
+    # vertical split into 2 districts of 3 columns each: each district is
+    # a 3x6 rectangle => PP = 4*pi*18 / 18^2
+    plan = graphs.stripes_plan(g, 2)
+    pp = stats.polsby_popper(
+        plan, 2, edges=g.edges, shared_perim=geo.shared_perim,
+        node_area=geo.area, node_exterior_perim=geo.exterior_perim)
+    expect = 4 * np.pi * 18.0 / (18.0 ** 2)
+    assert np.allclose(pp, expect, rtol=1e-6)
+
+
+def test_weighted_cut_chain_on_precinct_graph():
+    # full pipeline: jittered geometry -> dual graph -> weighted-cut chain
+    gj = graphs.synthetic_precincts(8, 8, seed=5, jitter=0.2)
+    g, geo = graphs.from_geojson(gj, pop_property="POP")
+    assert not np.allclose(g.edge_len, g.edge_len[0])  # lengths vary
+    plan = graphs.stripes_plan(g, 2)
+    spec = fce.Spec(weighted_cut=True)
+    dg, states, params = fce.init_batch(
+        g, plan, n_chains=8, seed=0, spec=spec, base=8.0, pop_tol=0.4)
+    res = fce.run_chains(dg, spec, params, states, n_steps=400)
+    s = res.host_state()
+    # strongly compactness-favoring base: boundary length must not blow up
+    def blen(a):
+        cut = a[g.edges[:, 0]] != a[g.edges[:, 1]]
+        return (geo.shared_perim * cut).sum()
+    init_len = blen(np.asarray(plan))
+    final = np.array([blen(np.asarray(s.assignment)[c]) for c in range(8)])
+    assert (final <= init_len * 1.5 + 1e-6).all()
+    # chains stayed valid: connected districts, pop within bounds
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    ideal = g.pop.sum() / 2
+    for c in range(8):
+        a = np.asarray(s.assignment)[c]
+        for d in (0, 1):
+            assert nx.is_connected(gx.subgraph(np.nonzero(a == d)[0].tolist()))
+            pd = g.pop[a == d].sum()
+            assert (1 - 0.4) * ideal - 1e-6 <= pd <= (1 + 0.4) * ideal + 1e-6
+
+
+def test_from_geojson_accepts_string_and_multipolygon():
+    gj = graphs.synthetic_precincts(3, 3, seed=6)
+    # wrap one feature as a MultiPolygon; parse from a JSON string
+    f0 = gj["features"][0]
+    f0["geometry"] = {
+        "type": "MultiPolygon",
+        "coordinates": [f0["geometry"]["coordinates"]],
+    }
+    import json
+    g, geo = graphs.from_geojson(json.dumps(gj))
+    assert g.n_nodes == 9
+    assert g.n_edges == 12
+
+
+def test_duplicate_labels_raise():
+    gj = graphs.synthetic_precincts(3, 3, seed=7)
+    gj["features"][1]["properties"]["NAME"] = "p0_0"  # collide with f0
+    with pytest.raises(ValueError, match="not unique"):
+        graphs.from_geojson(gj, name_property="NAME")
+
+
+def test_recom_rejects_unknown_pop_col():
+    from flipcomplexityempirical_tpu import compat
+    with pytest.raises(ValueError, match="pop_col"):
+        compat.make_recom(np.random.default_rng(0), pop_col="VAP")
