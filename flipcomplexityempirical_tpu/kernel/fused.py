@@ -1,0 +1,374 @@
+"""Fused Pallas TPU chain kernel: whole step-loop on-chip, state in VMEM.
+
+Why this exists: under plain XLA, every per-chain dynamic index (scatter,
+gather, one-hot select) on the (C, N) state lowers to a full HBM pass, and
+the scan carry is re-materialized in HBM every step — measured ~8 ms per
+step at C=4096, N=4096 regardless of arithmetic. The flip chain is
+latency/bandwidth-bound, not FLOP-bound, so the fix is architectural: move
+the entire T-step loop into one Pallas kernel whose grid blocks keep their
+chains' state resident in VMEM, cutting HBM traffic from O(state x T) to
+O(state + logs) per chunk.
+
+TPU-native redesign of the step itself (square-grid, 2-district — the
+BASELINE.json north-star workload):
+
+- neighbors via static lane shifts of the flattened (chains, nx*ny) board
+  (no gathers): cut masks, incident-cut counts, and flip deltas are dense
+  elementwise stencils (VPU-cheap);
+- single-flip contiguity is the Moore-ring arc criterion evaluated DENSELY
+  for every node at once: the <=4 edge-neighbors of v form the nodes of a
+  4-cycle whose links are the diagonal cells; the flip keeps the origin
+  district connected iff (#present-neighbors - #active-links) <= 1. On a
+  plain square lattice this equals the radius-2 patch criterion of
+  kernel/contiguity.py (tests assert equivalence against the exact BFS);
+- the re-propose-until-valid semantics of the reference chain collapses to
+  ONE draw: uniform over boundary nodes retried until valid == uniform
+  over the VALID boundary set, which the dense validity mask materializes
+  directly — masked argmax over per-node random uniforms samples it in a
+  single reduction, no while_loop;
+- cross-lane REDUCTIONS are the on-chip cost unit (~20-40 us each vs ~ns
+  elementwise), so the step uses exactly three: (1) argmax of the masked
+  random scores -> v; (2) a packed-payload max that reads validity and
+  dcut at v without a gather; (3) the new |b_nodes| for the geometric-wait
+  sample. Everything else is elementwise or per-chain scalar rows.
+
+Reference bookkeeping strategy: cut_times accumulates in VMEM as two
+(C, N) edge panels (elementwise adds); the per-node parity metrics
+(part_sum / last_flipped / num_flips, whose reference semantics re-apply
+the LAST flip on every self-loop yield, grid_chain_sec11.py:396-400) are
+NOT touched per step — the kernel emits a signed flip log
+(+-(v+1) on accept, 0 on reject) and sampling/fused_runner.py replays the
+log into the accumulators once per chunk, exactly.
+
+Edge panels: a plain nx x ny grid's edges split into the 'vert' family
+((x,y)-(x,y+1), slot u = x*ny+y, y < ny-1) and the 'horiz' family
+((x,y)-(x+1,y), slot u, x < nx-1); fold_cut_panels maps them back to the
+canonical LatticeGraph edge order.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _rand_bits_i32(shape):
+    """Random bits as int32 (Mosaic has no uint32->f32 cast path)."""
+    return pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.int32)
+
+
+def _u01(bits_i32):
+    """int32 random bits -> float32 uniform in [0, 1) (23 mantissa bits)."""
+    m = jax.lax.shift_right_logical(bits_i32, 9)
+    return m.astype(jnp.float32) * jnp.float32(2 ** -23)
+
+
+def _shift(x, s: int):
+    """Shift lanes left by s (element u reads u+s), zero fill."""
+    if s == 0:
+        return x
+    z = jnp.zeros_like(x)
+    if s > 0:
+        return jnp.concatenate([x[:, s:], z[:, :s]], axis=1)
+    return jnp.concatenate([z[:, s:], x[:, :s]], axis=1)
+
+
+def _grid_kernel(nx: int, ny: int, n_steps: int, log_base: float,
+                 pop_lo: float, pop_hi: float, record: bool,
+                 seed_ref, a_ref, ctv_ref, cth_ref, sc_i_ref, sc_f_ref,
+                 flip_ref, *hist_refs):
+    n = nx * ny
+    bc = a_ref.shape[0]
+    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    y = idx % ny
+    x = idx // ny
+    has_n = y < ny - 1
+    has_s = y > 0
+    has_e = x < nx - 1
+    has_w = x > 0
+    deg = (has_n.astype(jnp.int32) + has_s.astype(jnp.int32)
+           + has_e.astype(jnp.int32) + has_w.astype(jnp.int32))
+    iota_t = jax.lax.broadcasted_iota(jnp.int32, (1, n_steps), 1)
+
+    a0 = a_ref[:].astype(jnp.int32)
+    # per-chain scalar rows (BC, 1)
+    cut_count = sc_i_ref[:, 0:1]
+    accept_count = sc_i_ref[:, 1:2]
+    move_clock = sc_i_ref[:, 2:3]
+    t_yield = sc_i_ref[:, 3:4]
+    pop0_init = jnp.sum((a0 == 0).astype(jnp.int32), axis=1, keepdims=True)
+
+    ctv_acc = ctv_ref[:]
+    cth_acc = cth_ref[:]
+    flip_log0 = jnp.zeros((bc, n_steps), jnp.int32)
+    if record:
+        cc_h0 = jnp.zeros((bc, n_steps), jnp.int32)
+        bc_h0 = jnp.zeros((bc, n_steps), jnp.int32)
+        w_h0 = jnp.zeros((bc, n_steps), jnp.float32)
+    else:
+        cc_h0 = bc_h0 = jnp.zeros((1, 1), jnp.int32)
+        w_h0 = jnp.zeros((1, 1), jnp.float32)
+
+    def body(t, carry):
+        (a, pop0, cut_count, accept_count, move_clock, t_yield,
+         cur_wait, waits_sum, ctv_acc, cth_acc, flip_log,
+         cc_h, bc_h, w_h) = carry
+
+        # --- dense stencils (elementwise; VPU-cheap) -------------------
+        cut_v = (a != _shift(a, 1)) & has_n
+        cut_h = (a != _shift(a, ny)) & has_e
+        cut_deg = (cut_v.astype(jnp.int32) + cut_h.astype(jnp.int32)
+                   + _shift(cut_v.astype(jnp.int32), -1)
+                   + _shift(cut_h.astype(jnp.int32), -ny))
+        b_mask = cut_deg > 0
+
+        s_n = (~cut_v) & has_n
+        s_e = (~cut_h) & has_e
+        s_s = (_shift(s_n.astype(jnp.int32), -1) > 0) & has_s
+        s_w = (_shift(s_e.astype(jnp.int32), -ny) > 0) & has_w
+        l_ne = (a == _shift(a, ny + 1))
+        l_se = (a == _shift(a, ny - 1))
+        l_nw = (a == _shift(a, -ny + 1))
+        l_sw = (a == _shift(a, -ny - 1))
+        present = (s_n.astype(jnp.int32) + s_e.astype(jnp.int32)
+                   + s_s.astype(jnp.int32) + s_w.astype(jnp.int32))
+        links = ((s_n & s_e & l_ne).astype(jnp.int32)
+                 + (s_e & s_s & l_se).astype(jnp.int32)
+                 + (s_s & s_w & l_sw).astype(jnp.int32)
+                 + (s_w & s_n & l_nw).astype(jnp.int32))
+        contig_ok = (present - links) <= 1
+
+        pop1 = n - pop0
+        ok_from0 = ((pop0 - 1).astype(jnp.float32) >= pop_lo) \
+            & ((pop1 + 1).astype(jnp.float32) <= pop_hi)
+        ok_from1 = ((pop1 - 1).astype(jnp.float32) >= pop_lo) \
+            & ((pop0 + 1).astype(jnp.float32) <= pop_hi)
+        is0 = a == 0
+        pop_ok = (is0 & ok_from0) | (~is0 & ok_from1)
+
+        valid = b_mask & contig_ok & pop_ok
+
+        # --- reduction 1: sample v uniform over the valid set ----------
+        bits = _rand_bits_i32((bc, n))
+        score = jnp.where(valid, _u01(bits), jnp.float32(-1.0))
+        v = jnp.argmax(score, axis=1).astype(jnp.int32)[:, None]
+        onehot = idx == v
+
+        # --- reduction 2: packed payload at v ((dcut+8)*2 + is0, so one
+        # max also yields validity, the flip delta, and the origin side
+        # without any gather) ------------------------------------------
+        dcut_map = deg - 2 * cut_deg                 # in [-4, 4]
+        payload = jnp.where(
+            valid,
+            ((dcut_map + 8) * 2 + is0.astype(jnp.int32)).astype(
+                jnp.float32),
+            jnp.float32(0.0))
+        pv = jnp.max(jnp.where(onehot, payload, jnp.float32(-1.0)),
+                     axis=1, keepdims=True)
+        chose_valid = pv > 0.5                       # S nonempty
+        ipv = pv.astype(jnp.int32)
+        from0 = ipv % 2                              # v was district 0
+        dcut_v = ipv // 2 - 8
+
+        u2 = _rand_bits_i32((bc, 2))
+        logu = jnp.log(jnp.maximum(_u01(u2[:, 0:1]), jnp.float32(1e-12)))
+        accept = chose_valid & (logu < -dcut_v.astype(jnp.float32)
+                                * jnp.float32(log_base))
+
+        # --- commit (elementwise) --------------------------------------
+        a = jnp.where(onehot & accept, 1 - a, a)
+        acc_i = accept.astype(jnp.int32)
+        pop0 = pop0 + acc_i * (1 - 2 * from0)
+        cut_count = cut_count + jnp.where(accept, dcut_v, 0)
+        accept_count = accept_count + acc_i
+        move_clock = move_clock + acc_i
+
+        # --- reduction 3: new |b_nodes| for the wait sample ------------
+        cut_v2 = (a != _shift(a, 1)) & has_n
+        cut_h2 = (a != _shift(a, ny)) & has_e
+        cut_deg2 = (cut_v2.astype(jnp.int32) + cut_h2.astype(jnp.int32)
+                    + _shift(cut_v2.astype(jnp.int32), -1)
+                    + _shift(cut_h2.astype(jnp.int32), -ny))
+        b_new = jnp.sum((cut_deg2 > 0).astype(jnp.int32), axis=1,
+                        keepdims=True)
+
+        p = b_new.astype(jnp.float32) / jnp.float32(float(n) ** 2 - 1.0)
+        uw = jnp.maximum(_u01(u2[:, 1:2]), jnp.float32(1e-12))
+        w_new = jnp.maximum(jnp.floor(jnp.log(uw) / jnp.log1p(-p)), 0.0)
+        cur_wait = jnp.where(accept, w_new, cur_wait)
+
+        # --- record one yield ------------------------------------------
+        ctv_acc = ctv_acc + cut_v2.astype(jnp.int32)
+        cth_acc = cth_acc + cut_h2.astype(jnp.int32)
+        waits_sum = waits_sum + cur_wait
+
+        col = iota_t == t
+        # signed flip log: sign = post-flip label of v (district 0 -> +1,
+        # district 1 -> -1); v flipped FROM 0 means it is now district 1
+        sign_new = 1 - 2 * from0
+        logval = jnp.where(accept, sign_new * (v + 1), 0)
+        flip_log = flip_log + jnp.where(col, logval, 0)
+
+        if record:
+            cc_h = cc_h + jnp.where(col, cut_count, 0)
+            bc_h = bc_h + jnp.where(col, b_new, 0)
+            w_h = w_h + jnp.where(col, cur_wait, 0.0)
+
+        t_yield = t_yield + 1
+        return (a, pop0, cut_count, accept_count, move_clock, t_yield,
+                cur_wait, waits_sum, ctv_acc, cth_acc, flip_log,
+                cc_h, bc_h, w_h)
+
+    carry = (a0, pop0_init, cut_count, accept_count, move_clock, t_yield,
+             sc_f_ref[:, 0:1], sc_f_ref[:, 1:2], ctv_acc, cth_acc,
+             flip_log0, cc_h0, bc_h0, w_h0)
+    carry = jax.lax.fori_loop(0, n_steps, body, carry)
+    (a, pop0, cut_count, accept_count, move_clock, t_yield, cur_wait,
+     waits_sum, ctv_acc, cth_acc, flip_log, cc_h, bc_h, w_h) = carry
+
+    a_ref[:] = a.astype(jnp.int8)
+    ctv_ref[:] = ctv_acc
+    cth_ref[:] = cth_acc
+    sc_i_ref[:, 0:1] = cut_count
+    sc_i_ref[:, 1:2] = accept_count
+    sc_i_ref[:, 2:3] = move_clock
+    sc_i_ref[:, 3:4] = t_yield
+    sc_f_ref[:, 0:1] = cur_wait
+    sc_f_ref[:, 1:2] = waits_sum
+    flip_ref[:] = flip_log
+    if record:
+        cc_r, bc_r, w_r = hist_refs
+        cc_r[:] = cc_h
+        bc_r[:] = bc_h
+        w_r[:] = w_h
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "nx", "ny", "n_steps", "log_base", "pop_lo", "pop_hi", "record",
+    "block_chains"))
+def fused_grid_chunk(seed, assignment, ct_v, ct_h, scal_i, scal_f, *, nx,
+                     ny, n_steps, log_base, pop_lo, pop_hi, record,
+                     block_chains=256):
+    """Run n_steps yields for all chains, fully fused on-chip.
+
+    State (chains-major): assignment i8 (C, N); ct_v/ct_h i32 (C, N)
+    cut_times panels; scal_i i32 (C, 8) = [cut_count, accept_count,
+    move_clock, t_yield, pad...]; scal_f f32 (C, 8) = [cur_wait,
+    waits_sum, pad...]. Returns updated state + flip log (C, n_steps)
+    (+histories of cut_count / b_count / wait when record=True)."""
+    c, n = assignment.shape
+    assert n == nx * ny
+    # lane-dim alignment: blocks whose minor dim is not a multiple of
+    # 128 force full-array VMEM materialization in Mosaic
+    assert n_steps % 128 == 0, "chunk length must be a multiple of 128"
+    assert scal_i.shape[1] == 128 and scal_f.shape[1] == 128
+    bc = min(block_chains, c)
+    assert c % bc == 0
+    grid = (c // bc,)
+
+    def row_block(cols):
+        return pl.BlockSpec((bc, cols), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(_grid_kernel, nx, ny, n_steps,
+                               float(log_base), float(pop_lo),
+                               float(pop_hi), record)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((c, n), jnp.int8),
+        jax.ShapeDtypeStruct((c, n), jnp.int32),
+        jax.ShapeDtypeStruct((c, n), jnp.int32),
+        jax.ShapeDtypeStruct((c, 128), jnp.int32),
+        jax.ShapeDtypeStruct((c, 128), jnp.float32),
+        jax.ShapeDtypeStruct((c, n_steps), jnp.int32),   # flip log
+    ]
+    out_specs = [row_block(n), row_block(n), row_block(n),
+                 row_block(128), row_block(128), row_block(n_steps)]
+    if record:
+        out_shape += [jax.ShapeDtypeStruct((c, n_steps), jnp.int32),
+                      jax.ShapeDtypeStruct((c, n_steps), jnp.int32),
+                      jax.ShapeDtypeStruct((c, n_steps), jnp.float32)]
+        out_specs += [row_block(n_steps)] * 3
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        row_block(n), row_block(n), row_block(n), row_block(128),
+        row_block(128),
+    ]
+    def wrapped(seed_ref, a_in, ctv_in, cth_in, si_in, sf_in,
+                a_o, ctv_o, cth_o, si_o, sf_o, flip_o, *hist):
+        # copy block in -> out, then run in-place on the output block
+        # (no input_output_aliases: aliasing pins the whole result tuple
+        # into VMEM in this Mosaic version, OOMing at C=4096)
+        a_o[:] = a_in[:]
+        ctv_o[:] = ctv_in[:]
+        cth_o[:] = cth_in[:]
+        si_o[:] = si_in[:]
+        sf_o[:] = sf_in[:]
+        kernel(seed_ref, a_o, ctv_o, cth_o, si_o, sf_o, flip_o, *hist)
+
+    return pl.pallas_call(
+        wrapped,
+        grid=grid,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )(jnp.reshape(jnp.asarray(seed, jnp.int32), (1,)), assignment, ct_v,
+      ct_h, scal_i, scal_f)
+
+
+def fold_cut_panels(nx: int, ny: int, ct_v: np.ndarray, ct_h: np.ndarray,
+                    graph) -> np.ndarray:
+    """Fold the (C, N) vert/horiz cut_times panels into the canonical
+    (C, E) edge order of ``graph`` (a plain square_grid LatticeGraph)."""
+    c = ct_v.shape[0]
+    out = np.zeros((c, graph.n_edges), dtype=np.int64)
+    for ei in range(graph.n_edges):
+        ia, ib = int(graph.edges[ei, 0]), int(graph.edges[ei, 1])
+        (xa, ya), (xb, yb) = graph.labels[ia], graph.labels[ib]
+        if xa == xb:
+            out[:, ei] = ct_v[:, xa * ny + min(ya, yb)]
+        else:
+            out[:, ei] = ct_h[:, min(xa, xb) * ny + ya]
+    return out
+
+
+def replay_parity(flip_log: np.ndarray, t_start: np.ndarray,
+                  part_sum: np.ndarray, last_flipped: np.ndarray,
+                  num_flips: np.ndarray, cur_flip: np.ndarray,
+                  cur_sign: np.ndarray):
+    """Replay the signed flip log into the reference parity accumulators.
+
+    Reference record semantics (grid_chain_sec11.py:396-400, re-applied on
+    EVERY yield via the memoized part.flips): at yield t with flip cursor
+    f and post-flip sign s: part_sum[f] -= s * (t - last_flipped[f]);
+    last_flipped[f] = t; num_flips[f] += 1.
+
+    Arguments are mutated in place. ``flip_log`` is (C, T) signed
+    (+-(slot+1), 0 = rejected yield); ``cur_flip``/``cur_sign`` carry the
+    cursor across chunks ((C,) arrays, slot index or -1). ``t_start`` (C,)
+    is the absolute yield index of flip_log[:, 0].
+    """
+    c, t_len = flip_log.shape
+    rows = np.arange(c)
+    for t in range(t_len):
+        ev = flip_log[:, t]
+        newf = ev != 0
+        cur_flip[newf] = np.abs(ev[newf]) - 1
+        cur_sign[newf] = np.sign(ev[newf])
+        has = cur_flip >= 0
+        f = np.where(has, cur_flip, 0)
+        t_abs = t_start + t
+        dt = t_abs - last_flipped[rows, f]
+        upd = np.where(has, -cur_sign * dt, 0)
+        part_sum[rows, f] += upd
+        last_flipped[rows, f] = np.where(has, t_abs,
+                                         last_flipped[rows, f])
+        num_flips[rows, f] += has.astype(np.int64)

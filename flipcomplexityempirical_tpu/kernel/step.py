@@ -138,30 +138,48 @@ def sample_geom_minus1(key, b_count, n_nodes: int, k: int):
     return jnp.maximum(w, 0.0).astype(jnp.float32)
 
 
+def _select_nth_true(mask, m):
+    """Index of the (m+1)-th True element of a boolean vector (prefix-sum
+    selection). Returns 0 when mask is empty — callers must check
+    mask[idx]."""
+    c = jnp.cumsum(mask.astype(jnp.int32))
+    return jnp.argmax(c > m).astype(jnp.int32)
+
+
 def _sample_bi(key, state: ChainState):
-    """Uniform over boundary nodes (masked-argmax of iid uniforms), flip to
-    the other district (grid_chain_sec11.py:132-145)."""
+    """Uniform over boundary nodes, flip to the other district
+    (grid_chain_sec11.py:132-145). One uniform + prefix-sum selection —
+    NOT a per-node Gumbel/uniform draw, which would cost N PRNG evaluations
+    per proposal (the dominant kernel cost at N=4096)."""
     b_mask = state.cut_deg > 0
-    u = jax.random.uniform(key, b_mask.shape)
-    v = jnp.argmax(jnp.where(b_mask, u, -1.0)).astype(jnp.int32)
+    bc = state.b_count
+    u = jax.random.uniform(key)
+    m = jnp.minimum((u * bc.astype(jnp.float32)).astype(jnp.int32),
+                    jnp.maximum(bc - 1, 0))
+    v = _select_nth_true(b_mask, m)
     d_from = state.assignment[v].astype(jnp.int32)
     return v, 1 - d_from, b_mask[v]
 
 
 def _sample_pair(key, dg: DeviceGraph, state: ChainState, k: int):
     """Uniform over distinct (boundary node, neighboring district) pairs
-    (grid_chain_sec11.py:117-130, the k-district move set)."""
+    (grid_chain_sec11.py:117-130, the k-district move set). One uniform +
+    prefix-sum selection over the flattened (N, K) pair mask."""
     a = state.assignment.astype(jnp.int32)
     nbr_a = a[dg.nbr]                                        # (N, D)
     onehot = jax.nn.one_hot(nbr_a, k, dtype=jnp.bool_)       # (N, D, K)
     onehot = onehot & dg.nbr_mask[:, :, None]
     has_part = onehot.any(axis=1)                            # (N, K)
-    pair_mask = has_part & (jnp.arange(k)[None, :] != a[:, None])
-    u = jax.random.uniform(key, pair_mask.shape)
-    idx = jnp.argmax(jnp.where(pair_mask, u, -1.0))
+    pair_mask = (has_part & (jnp.arange(k)[None, :] != a[:, None])).reshape(-1)
+    c = jnp.cumsum(pair_mask.astype(jnp.int32))
+    total = c[-1]
+    u = jax.random.uniform(key)
+    m = jnp.minimum((u * total.astype(jnp.float32)).astype(jnp.int32),
+                    jnp.maximum(total - 1, 0))
+    idx = jnp.argmax(c > m)
     v = (idx // k).astype(jnp.int32)
     d_to = (idx % k).astype(jnp.int32)
-    return v, d_to, pair_mask.reshape(-1)[idx]
+    return v, d_to, pair_mask[idx]
 
 
 def _frame_counts(dg: DeviceGraph, spec: Spec, state: ChainState):

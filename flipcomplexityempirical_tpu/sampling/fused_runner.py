@@ -1,0 +1,223 @@
+"""Runner for the fused Pallas grid kernel (kernel/fused.py): ChainState in,
+ChainState out, same yield semantics as sampling/runner.run_chains.
+
+Restricted to the workload the fused kernel specializes (plain nx x ny
+square grid with unit populations, 2 districts, 'bi' proposal, re-propose
+semantics, literal cut acceptance, beta == 1); everything else uses the
+general XLA runner. The two paths are distribution-equivalent (asserted
+statistically in tests/test_fused.py).
+
+Division of labor per chunk: the kernel advances the chains entirely
+on-chip and emits a signed flip log; this runner replays the log into the
+reference parity accumulators (part_sum / last_flipped / num_flips,
+including the re-apply-on-self-loop quirk) on host — a ~T-iteration numpy
+loop over (C,) vectors, amortized across the whole chunk.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..graphs.lattice import LatticeGraph
+from ..kernel.step import Spec
+from ..kernel import fused
+from ..state.chain_state import ChainState, derive
+
+
+@dataclasses.dataclass
+class FusedRunResult:
+    state: ChainState
+    history: dict
+    waits_total: np.ndarray
+    n_yields: int
+
+    def host_state(self):
+        return jax.tree.map(np.asarray, self.state)
+
+
+def supports(graph: LatticeGraph, spec: Spec, beta=1.0) -> bool:
+    """True when the fused kernel implements these exact semantics:
+    2-district bi-proposal re-propose cut-accept chain, beta 1, on a plain
+    rook nx x ny grid with unit populations (checked structurally)."""
+    if spec.n_districts != 2 or spec.proposal != "bi":
+        return False
+    if spec.contiguity not in ("patch", "exact"):
+        return False
+    if spec.invalid != "repropose" or spec.accept != "cut":
+        return False
+    if spec.anneal != "none" or spec.frame_interface or spec.weighted_cut:
+        return False
+    if not np.all(np.asarray(beta) == 1.0):
+        return False
+    try:
+        nx_, ny_ = _grid_dims(graph)
+    except (ValueError, TypeError, IndexError):
+        return False
+    if graph.n_edges != nx_ * (ny_ - 1) + (nx_ - 1) * ny_:
+        return False
+    if graph.max_deg > 4 or not np.all(graph.pop == 1):
+        return False
+    return True
+
+
+def _grid_dims(graph: LatticeGraph):
+    xs = [lab[0] for lab in graph.labels]
+    ys = [lab[1] for lab in graph.labels]
+    nx_, ny_ = max(xs) + 1, max(ys) + 1
+    if graph.n_nodes != nx_ * ny_:
+        raise ValueError("fused runner needs a full nx x ny grid")
+    return nx_, ny_
+
+
+def _node_perm(graph: LatticeGraph, nx_: int, ny_: int):
+    """graph node index -> fused slot (x * ny + y)."""
+    perm = np.zeros(graph.n_nodes, dtype=np.int64)
+    for i, (x, y) in enumerate(graph.labels):
+        perm[i] = x * ny_ + y
+    return perm
+
+
+def run_fused(graph: LatticeGraph, spec: Spec, states: ChainState,
+              n_steps: int, *, base: float, pop_lo: float, pop_hi: float,
+              seed: int = 0, record_history: bool = True,
+              chunk: int = 512, block_chains: int = 256) -> FusedRunResult:
+    """Advance the batch ``n_steps`` yields (first yield = initial state,
+    as in run_chains) on the fused kernel."""
+    if not supports(graph, spec):
+        raise ValueError("workload not supported by the fused kernel; use "
+                         "sampling.run_chains")
+    nx_, ny_ = _grid_dims(graph)
+    n = graph.n_nodes
+    perm = _node_perm(graph, nx_, ny_)
+    inv_perm = np.argsort(perm)
+    c = states.assignment.shape[0]
+
+    def pack(arr, dtype):
+        a = np.asarray(arr)
+        out = np.empty_like(a, dtype=dtype)
+        out[:, perm] = a
+        return out
+
+    a = jnp.asarray(pack(states.assignment, np.int8))
+
+    # parity accumulators stay host-side (replayed from the flip log)
+    part_sum = pack(states.part_sum, np.int64)
+    last_flipped = pack(states.last_flipped, np.int64)
+    num_flips = pack(states.num_flips, np.int64)
+
+    # cut_times -> vert/horiz slot panels
+    ctv = np.zeros((c, n), np.int32)
+    cth = np.zeros((c, n), np.int32)
+    ct = np.asarray(states.cut_times)
+    for ei in range(graph.n_edges):
+        ia, ib = int(graph.edges[ei, 0]), int(graph.edges[ei, 1])
+        (xa, ya), (xb, yb) = graph.labels[ia], graph.labels[ib]
+        if xa == xb:
+            ctv[:, xa * ny_ + min(ya, yb)] = ct[:, ei]
+        else:
+            cth[:, min(xa, xb) * ny_ + ya] = ct[:, ei]
+    ctv, cth = jnp.asarray(ctv), jnp.asarray(cth)
+
+    scal_i = np.zeros((c, 128), np.int32)
+    scal_i[:, 0] = np.asarray(states.cut_count)
+    scal_i[:, 1] = np.asarray(states.accept_count)
+    scal_i[:, 2] = np.asarray(states.move_clock)
+    scal_i[:, 3] = np.asarray(states.t_yield)
+    scal_f = np.zeros((c, 128), np.float32)
+    scal_f[:, 0] = np.asarray(states.cur_wait)
+
+    # flip cursor carried across chunks, in fused slot space
+    flip = np.asarray(states.cur_flip_node).astype(np.int64)
+    cur_flip = np.where(flip >= 0, perm[np.clip(flip, 0, n - 1)], -1)
+    a_host = np.asarray(a, np.int64)
+    cur_sign = np.where(
+        cur_flip >= 0,
+        1 - 2 * a_host[np.arange(c), np.clip(cur_flip, 0, n - 1)], 1)
+
+    # --- initial record (yield 0): one dense XLA pass + one replay step -
+    idx = np.arange(n)
+    has_n = ((idx % ny_) < ny_ - 1)[None, :]
+    has_e = ((idx // ny_) < nx_ - 1)[None, :]
+    a_i32 = a.astype(jnp.int32)
+    cut_v0 = (a_i32 != jnp.roll(a_i32, -1, axis=1)) & jnp.asarray(has_n)
+    cut_h0 = (a_i32 != jnp.roll(a_i32, -ny_, axis=1)) & jnp.asarray(has_e)
+    ctv = ctv + cut_v0.astype(jnp.int32)
+    cth = cth + cut_h0.astype(jnp.int32)
+    waits_total = np.asarray(states.cur_wait, np.float64).copy()
+    fused.replay_parity(np.zeros((c, 1), np.int64), scal_i[:, 3].copy(),
+                        part_sum, last_flipped, num_flips, cur_flip,
+                        cur_sign)
+    scal_i[:, 3] += 1
+    scal_i = jnp.asarray(scal_i)
+    scal_f = jnp.asarray(scal_f)
+
+    hist = {"cut_count": [np.asarray(states.cut_count)[:, None]],
+            "b_count": [np.asarray(states.b_count)[:, None]],
+            "wait": [np.asarray(states.cur_wait)[:, None]]} \
+        if record_history else None
+
+    if chunk % 128 or (n_steps - 1) % 128:
+        raise ValueError(
+            "fused runner needs chunk and n_steps-1 divisible by 128 "
+            "(Mosaic lane alignment for the per-chunk log blocks); got "
+            f"chunk={chunk}, n_steps={n_steps}")
+    done = 1
+    while done < n_steps:
+        this = min(chunk, n_steps - done)
+        t_start = np.asarray(scal_i[:, 3]).astype(np.int64)
+        out = fused.fused_grid_chunk(
+            seed + done, a, ctv, cth, scal_i, scal_f,
+            nx=nx_, ny=ny_, n_steps=this, log_base=float(np.log(base)),
+            pop_lo=float(pop_lo), pop_hi=float(pop_hi),
+            record=record_history, block_chains=block_chains)
+        if record_history:
+            a, ctv, cth, scal_i, scal_f, flog, cc_h, bc_h, w_h = out
+            hist["cut_count"].append(np.asarray(cc_h))
+            hist["b_count"].append(np.asarray(bc_h))
+            hist["wait"].append(np.asarray(w_h))
+        else:
+            a, ctv, cth, scal_i, scal_f, flog = out
+        fused.replay_parity(np.asarray(flog, np.int64), t_start,
+                            part_sum, last_flipped, num_flips, cur_flip,
+                            cur_sign)
+        waits_total += np.asarray(scal_f[:, 1], np.float64)
+        scal_f = scal_f.at[:, 1].set(0.0)
+        done += this
+
+    # --- unpack back to ChainState graph order --------------------------
+    def unpack(arr, dtype):
+        return jnp.asarray(np.asarray(arr)[:, perm].astype(dtype))
+
+    ct_full = fused.fold_cut_panels(nx_, ny_, np.asarray(ctv),
+                                    np.asarray(cth), graph)
+    flip_g = np.where(cur_flip >= 0,
+                      inv_perm[np.clip(cur_flip, 0, n - 1)], -1)
+
+    a_graph = unpack(a, np.int8)
+    cut, cut_deg, dist_pop, cut_count, b_count = jax.vmap(
+        lambda x: derive(graph.device(), x, 2))(a_graph)
+
+    state = states.replace(
+        assignment=a_graph,
+        cut=cut, cut_deg=cut_deg, dist_pop=dist_pop,
+        cut_count=jnp.asarray(np.asarray(scal_i[:, 0])),
+        b_count=b_count,
+        cur_wait=jnp.asarray(np.asarray(scal_f[:, 0])),
+        cur_flip_node=jnp.asarray(flip_g.astype(np.int32)),
+        t_yield=jnp.asarray(np.asarray(scal_i[:, 3])),
+        part_sum=unpack(part_sum, np.int32),
+        last_flipped=unpack(last_flipped, np.int32),
+        num_flips=unpack(num_flips, np.int32),
+        cut_times=jnp.asarray(ct_full.astype(np.int32)),
+        waits_sum=jnp.zeros_like(states.waits_sum),
+        accept_count=jnp.asarray(np.asarray(scal_i[:, 1])),
+        move_clock=jnp.asarray(np.asarray(scal_i[:, 2])),
+    )
+    history = ({k: np.concatenate(v, axis=1) for k, v in hist.items()}
+               if record_history else {})
+    return FusedRunResult(state=state, history=history,
+                          waits_total=waits_total, n_yields=n_steps)
