@@ -8,11 +8,17 @@ waits, parity metric bookkeeping). Target: >=1e4 chains at >=1e7 aggregate
 flips/sec on a v5e-8 — i.e. >=1.25e6 flips/sec/chip, which is the
 vs_baseline denominator here (this box exposes one chip).
 
+Routes through the board (stencil) fast path when
+``kernel.board.supports(graph, spec)`` holds — tests/test_board.py proves it
+distribution-identical to the general path — and falls back to the general
+gather/while_loop kernel otherwise (``--general`` forces the fallback).
+
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "flips/s", "vs_baseline": N}
 """
 
 import argparse
+import contextlib
 import json
 import sys
 import time
@@ -32,6 +38,12 @@ def main():
     ap.add_argument("--base", type=float, default=2.63815853)
     ap.add_argument("--pop-tol", type=float, default=0.1)
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument("--general", action="store_true",
+                    help="force the general (gather) path even when the "
+                         "board fast path supports the workload")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="wrap the timed region in a jax.profiler trace "
+                         "written to DIR (SURVEY.md section 5 tracing)")
     args = ap.parse_args()
     if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
             or args.warmup - 1 < args.chunk):
@@ -44,6 +56,7 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     import flipcomplexityempirical_tpu as fce
+    from flipcomplexityempirical_tpu.kernel import board as kboard
 
     g = fce.graphs.square_grid(args.grid, args.grid)
     plan = fce.graphs.stripes_plan(g, 2)
@@ -51,14 +64,29 @@ def main():
                     invalid="repropose", accept="cut",
                     parity_metrics=True, geom_waits=True,
                     record_interface=False)
-    dg, states, params = fce.init_batch(
-        g, plan, n_chains=args.chains, seed=0, spec=spec,
-        base=args.base, pop_tol=args.pop_tol)
+
+    use_board = kboard.supports(g, spec) and not args.general
+    if use_board:
+        bg, states, params = fce.sampling.init_board(
+            g, plan, n_chains=args.chains, seed=0, spec=spec,
+            base=args.base, pop_tol=args.pop_tol)
+
+        def run(states, n_steps):
+            return fce.sampling.run_board(
+                bg, spec, params, states, n_steps=n_steps,
+                record_history=False, chunk=args.chunk)
+    else:
+        dg, states, params = fce.init_batch(
+            g, plan, n_chains=args.chains, seed=0, spec=spec,
+            base=args.base, pop_tol=args.pop_tol)
+
+        def run(states, n_steps):
+            return fce.run_chains(dg, spec, params, states, n_steps=n_steps,
+                                  record_history=False, chunk=args.chunk)
 
     # compile + mix in (reach steady-state boundary sizes); same chunk as
     # the timed run so the timed region reuses the compiled kernel
-    res = fce.run_chains(dg, spec, params, states, n_steps=args.warmup,
-                         record_history=False, chunk=args.chunk)
+    res = run(states, args.warmup)
     states = res.state
     # zero telemetry so rates below cover only the timed steps
     import jax.numpy as jnp
@@ -66,12 +94,14 @@ def main():
         accept_count=jnp.zeros_like(states.accept_count),
         tries_sum=jnp.zeros_like(states.tries_sum),
         exhausted_count=jnp.zeros_like(states.exhausted_count))
-    jax.block_until_ready(states.assignment)
+    jax.block_until_ready(jax.tree.leaves(states)[0])
 
+    prof = (jax.profiler.trace(args.profile) if args.profile
+            else contextlib.nullcontext())
     t0 = time.perf_counter()
-    res = fce.run_chains(dg, spec, params, states, n_steps=args.steps,
-                         record_history=False, chunk=args.chunk)
-    jax.block_until_ready(res.state.assignment)
+    with prof:
+        res = run(states, args.steps)
+        jax.block_until_ready(jax.tree.leaves(res.state)[0])
     dt = time.perf_counter() - t0
 
     flips = args.chains * (args.steps - 1)  # yields minus the initial record
@@ -79,6 +109,7 @@ def main():
     s = res.host_state()
     meta = {
         "device": str(jax.devices()[0]),
+        "path": "board" if use_board else "general",
         "chains": args.chains,
         "steps": args.steps,
         "grid": args.grid,

@@ -3,8 +3,10 @@ from .step import (
     sample_geom_minus1, interface_metrics, finalize_host,
 )
 from . import contiguity
+from . import board
 
 __all__ = [
     "Spec", "StepParams", "make_params", "transition", "record", "propose",
     "sample_geom_minus1", "interface_metrics", "finalize_host", "contiguity",
+    "board",
 ]

@@ -1,0 +1,579 @@
+"""Board kernel: the dense stencil fast path for plain rook-grid lattices.
+
+This is the TPU-first redesign of the hot flip chain for the benchmark
+workload (2-district chains on an HxW rook grid, BASELINE.json north star).
+Where ``kernel/step.py`` is general (any padded-neighbor graph, re-propose
+``while_loop``, per-node gather contiguity), this kernel exploits the grid
+and the memory system:
+
+- State is a flat ``(C, N)`` int8 board (N = H*W, minor dim N so every
+  plane tiles the full 128-lane vector width with no padding waste).
+  Neighbor reads are *stencil slices* of one padded array at offsets
+  {+-1, +-W, +-W+-1} with static row-wrap masks — no gathers in the hot
+  loop, so XLA fuses the whole per-step dataflow into a few passes.
+- The re-propose-until-valid loop of the reference chain (gerrychain
+  MarkovChain semantics, SURVEY.md section 2.3) collapses into ONE masked
+  draw: the proposal is uniform over boundary nodes and the state does not
+  change between retries, so "redraw until valid" is exactly "uniform over
+  the *valid* boundary nodes" (and an empty valid set is exactly the
+  exhausted self-loop). This removes the batch-synchronized
+  ``lax.while_loop`` whose iteration count is the max tries over all C
+  chains (~3-4 full batch passes per step at C=4096).
+- Contiguity is the ring criterion: flipping v keeps its origin district
+  locally connected iff v's same-district rook neighbors form a single
+  block in the cyclic 8-neighborhood ring, where two cyclically adjacent
+  rook neighbors are linked iff the diagonal between them is also
+  same-district. On a plain rook grid this is *equivalent* to the
+  radius-2 patch check of ``kernel/contiguity.patch_connected`` (the
+  distance-2 straight nodes of the patch are pendants attached to a
+  single rook neighbor, so they never affect seed-to-seed connectivity);
+  ``tests/test_board.py`` proves the equivalence exhaustively over all
+  2^8 neighborhood patterns at interior, edge, and corner positions.
+  Computed for ALL nodes at once as ~12 fused elementwise ops.
+- The reference's per-yield flip bookkeeping (part_sum / last_flipped /
+  num_flips, grid_chain_sec11.py:396-400) would cost three full (C, N)
+  read-modify-write passes per step as in-loop accumulators — the
+  dominant cost by far. Instead the scan emits a 2-word-per-chain log
+  (flip pointer, sign) per yield, and ``apply_flip_log`` reconstructs all
+  three arrays once per chunk: a stable per-chain sort of the log by
+  pointer node makes each yield's ``last_flipped`` read adjacent, turning
+  the whole replay into one gather + three scatters (see its docstring).
+  ``tests/test_board.py`` checks the reconstruction against a sequential
+  replay, including mid-run chunk splits.
+- cut_times accumulates in chunk-local int16 planes (chunk <= 32767
+  asserted) folded into the int32 state once per chunk — half the HBM
+  traffic of the per-step int32 read-modify-write.
+
+Reference semantics preserved (same quirk set as kernel/step.py):
+- uniform boundary-node proposal, flip to the other district
+  (grid_chain_sec11.py:132-145);
+- literal Metropolis ``base**(-dcut)`` without the reversibility
+  correction (grid_chain_sec11.py:171-179);
+- memoized geometric waits with the literal ``n**k - 1`` denominator
+  (grid_chain_sec11.py:147-148) — sampled from the boundary count of the
+  *post-move* state, re-recorded unchanged on self-loop yields;
+- per-yield re-application of the last flip's part_sum / last_flipped /
+  num_flips bookkeeping (grid_chain_sec11.py:396-400);
+- per-yield cut_times accumulation over the current cut set
+  (grid_chain_sec11.py:383-384).
+
+The board loop records yield t *before* transition t+1 (the general path
+records after), so the wait of a freshly accepted move is sampled from the
+next iteration's boundary plane; the yielded sequence is identical:
+R(S_0), [T, R] x (n-1)  ==  [R, T] x (n-1), R (epilogue).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..graphs.lattice import LatticeGraph
+from .step import Spec, StepParams, sample_geom_minus1
+
+@struct.dataclass
+class BoardGraph:
+    """Static per-graph planes (a small pytree; loop-invariant).
+
+    ``h``/``w`` ride the treedef (static), so jitted kernels specialize on
+    the grid shape."""
+
+    pop: jnp.ndarray      # int32[N] node population weights (flat x*W+y)
+    deg: jnp.ndarray      # int32[N] rook degree (2/3/4)
+    east_ok: jnp.ndarray  # bool[N] node has an east (+1 flat) neighbor
+    west_ok: jnp.ndarray  # bool[N] node has a west (-1 flat) neighbor
+    h: int = struct.field(pytree_node=False, default=0)
+    w: int = struct.field(pytree_node=False, default=0)
+
+    @property
+    def n(self) -> int:
+        return self.h * self.w
+
+
+@struct.dataclass
+class BoardState:
+    """Batched chain state in board form. C chains over an HxW grid.
+
+    Mirrors state.ChainState field-for-field where semantics overlap;
+    node-indexed arrays are flat (C, N) with flat index = x*W + y
+    (LatticeGraph's sorted (x, y) label order). ``cut_times_e[c, i]``
+    counts cut yields of edge (i, i+1) (zero where no east neighbor);
+    ``cut_times_s[c, i]`` of edge (i, i+W)."""
+
+    key: jnp.ndarray           # uint32[C, 2] per-chain PRNG keys
+    board: jnp.ndarray         # int8[C, N] district 0/1
+    dist_pop: jnp.ndarray      # int32[C, 2]
+    cut_count: jnp.ndarray     # int32[C]
+    cur_wait: jnp.ndarray      # f32[C] memoized geometric wait
+    wait_pending: jnp.ndarray  # bool[C] accepted move awaits its wait sample
+    cur_flip: jnp.ndarray      # int32[C] flat node of last accepted flip; -1
+    t_yield: jnp.ndarray       # int32[C]
+    move_clock: jnp.ndarray    # int32[C] accepted moves (reference step_num)
+    part_sum: jnp.ndarray      # int32[C, N]
+    last_flipped: jnp.ndarray  # int32[C, N]
+    num_flips: jnp.ndarray     # int32[C, N]
+    cut_times_e: jnp.ndarray   # int32[C, N]
+    cut_times_s: jnp.ndarray   # int32[C, N]
+    waits_sum: jnp.ndarray     # f32[C] chunk-local (host drains to f64)
+    accept_count: jnp.ndarray  # int32[C]
+    tries_sum: jnp.ndarray     # int32[C] == yields processed (one draw/step)
+    exhausted_count: jnp.ndarray  # int32[C] steps with empty valid set
+
+
+# ---------------------------------------------------------------------------
+# Grid-shape inference and support predicate
+# ---------------------------------------------------------------------------
+
+def board_shape(graph: LatticeGraph):
+    """(H, W) if ``graph`` is a plain full rook grid in sorted (x, y) label
+    order — the layout this kernel requires — else None."""
+    labs = graph.labels
+    n = graph.n_nodes
+    if n == 0 or not all(isinstance(l, tuple) and len(l) == 2 for l in labs):
+        return None
+    xs = [l[0] for l in labs]
+    ys = [l[1] for l in labs]
+    if not all(isinstance(v, (int, np.integer)) for v in (*xs[:1], *ys[:1])):
+        return None
+    h, w = max(xs) + 1, max(ys) + 1
+    if min(xs) != 0 or min(ys) != 0 or h * w != n:
+        return None
+    if list(labs) != [(x, y) for x in range(h) for y in range(w)]:
+        return None
+    if graph.n_edges != h * (w - 1) + (h - 1) * w:
+        return None
+    lab_arr = np.array(labs, dtype=np.int64)
+    d = np.abs(lab_arr[graph.edges[:, 0]] - lab_arr[graph.edges[:, 1]])
+    if not (d.sum(axis=1) == 1).all():
+        return None
+    return h, w
+
+
+def supports(graph: LatticeGraph, spec: Spec) -> bool:
+    """True iff this kernel reproduces run_chains semantics exactly for
+    (graph, spec). Everything outside falls back to the general path."""
+    return (
+        board_shape(graph) is not None
+        and spec.n_districts == 2
+        and spec.proposal == "bi"
+        and spec.contiguity in ("patch", "none")
+        and spec.invalid == "repropose"
+        and spec.accept in ("cut", "always")
+        and spec.anneal == "none"
+        and not spec.frame_interface
+        and not spec.weighted_cut
+        and not spec.record_interface
+        and not spec.record_assignment_bits
+    )
+
+
+def make_board_graph(graph: LatticeGraph) -> BoardGraph:
+    h, w = board_shape(graph)
+    deg = np.full((h, w), 4, np.int32)
+    deg[0, :] -= 1
+    deg[-1, :] -= 1
+    deg[:, 0] -= 1
+    deg[:, -1] -= 1
+    ys = np.arange(h * w) % w
+    return BoardGraph(
+        pop=jnp.asarray(graph.pop, jnp.int32),
+        deg=jnp.asarray(deg.reshape(-1)),
+        east_ok=jnp.asarray(ys != w - 1),
+        west_ok=jnp.asarray(ys != 0),
+        h=h, w=w)
+
+
+# ---------------------------------------------------------------------------
+# Stencil planes
+# ---------------------------------------------------------------------------
+
+def same_planes(bg: BoardGraph, board):
+    """same[i][c, n] = ring-offset-i neighbor of n exists and shares n's
+    district. Ring order (cyclic, rook at even indices): E(+1), SE(+1+W),
+    S(+W), SW(+W-1), W(-1), NW(-1-W), N(-W), NE(-W+1) in flat offsets.
+    Out-of-grid pads compare against -1 => False; row wraps are masked."""
+    w, n = bg.w, bg.n
+    p = jnp.pad(board, ((0, 0), (w + 1, w + 1)), constant_values=-1)
+
+    def sh(o):
+        return p[:, w + 1 + o: w + 1 + o + n] == board
+
+    e, wk = bg.east_ok, bg.west_ok
+    return [sh(1) & e, sh(w + 1) & e, sh(w), sh(w - 1) & wk,
+            sh(-1) & wk, sh(-w - 1) & wk, sh(-w), sh(-w + 1) & e]
+
+
+def ring_contig_ok(same):
+    """The ring criterion (== patch_connected on plain rook grids; see
+    module docstring). ok iff <=1 same-district rook neighbor, or all
+    same-district rook neighbors lie in one cyclic-adjacent block."""
+    seeds = (same[0].astype(jnp.int32) + same[2] + same[4] + same[6])
+    runs = jnp.zeros_like(seeds)
+    for i in (0, 2, 4, 6):
+        linked = same[(i - 1) % 8] & same[(i - 2) % 8]
+        runs = runs + (same[i] & ~linked)
+    return (seeds <= 1) | (runs <= 1)
+
+
+def _planes(bg: BoardGraph, spec: Spec, params: StepParams,
+            state: BoardState):
+    """One fused pass over the board: cut planes, boundary mask, per-node
+    validity, boundary count."""
+    board = state.board
+    same = same_planes(bg, board)
+    same_deg = (same[0].astype(jnp.int32) + same[2] + same[4] + same[6])
+    diff_deg = bg.deg[None] - same_deg
+    b_mask = diff_deg > 0
+    b_count = b_mask.sum(axis=1, dtype=jnp.int32)
+    south_ok = jnp.arange(bg.n) < (bg.h - 1) * bg.w
+    cut_e = bg.east_ok[None] & ~same[0]      # edge (i, i+1)
+    cut_s = south_ok[None] & ~same[2]        # edge (i, i+W)
+    cut_count = (cut_e.sum(axis=1, dtype=jnp.int32)
+                 + cut_s.sum(axis=1, dtype=jnp.int32))
+
+    if spec.contiguity == "patch":
+        contig = ring_contig_ok(same)
+    else:  # 'none'
+        contig = jnp.ones_like(b_mask)
+
+    # population bounds for flipping each node OUT of its current district
+    popn = bg.pop[None].astype(jnp.float32)
+    is1 = board == 1
+    pop_of = jnp.where(is1, state.dist_pop[:, 1, None],
+                       state.dist_pop[:, 0, None]).astype(jnp.float32)
+    pop_to = jnp.where(is1, state.dist_pop[:, 0, None],
+                       state.dist_pop[:, 1, None]).astype(jnp.float32)
+    pop_ok = ((pop_of - popn >= params.pop_lo[:, None])
+              & (pop_to + popn <= params.pop_hi[:, None]))
+
+    valid = b_mask & contig & pop_ok
+    return dict(valid=valid, b_count=b_count, diff_deg=diff_deg,
+                cut_e=cut_e, cut_s=cut_s, cut_count=cut_count)
+
+
+# ---------------------------------------------------------------------------
+# One scan iteration: [complete pending wait, record yield, transition]
+# ---------------------------------------------------------------------------
+
+def _split4(keys):
+    ks = jax.vmap(lambda k: jax.random.split(k, 4))(keys)
+    return ks[:, 0], ks[:, 1], ks[:, 2], ks[:, 3]
+
+
+def _uniform(keys):
+    return jax.vmap(jax.random.uniform)(keys)
+
+
+def _complete_wait(spec: Spec, state: BoardState, b_count, kwait,
+                   n_nodes: int):
+    if not spec.geom_waits:
+        return state.cur_wait
+    w = jax.vmap(lambda k, b: sample_geom_minus1(k, b, n_nodes, 2))(
+        kwait, b_count)
+    return jnp.where(state.wait_pending, w, state.cur_wait)
+
+
+def _record(bg: BoardGraph, spec: Spec, params: StepParams,
+            state: BoardState, ct_e16, ct_s16, planes, cur_wait):
+    """The measurement yield (grid_chain_sec11.py:366-402), batched.
+    Bookkeeping for part_sum/last_flipped/num_flips is deferred: this
+    emits the (flip pointer, sign) log row instead."""
+    c = state.board.shape[0]
+    out = {
+        "cut_count": planes["cut_count"],
+        "b_count": planes["b_count"],
+        "wait": cur_wait,
+        "accepts": state.accept_count,
+    }
+    ct_e16 = ct_e16 + planes["cut_e"].astype(jnp.int16)
+    ct_s16 = ct_s16 + planes["cut_s"].astype(jnp.int16)
+    waits_sum = state.waits_sum + cur_wait
+
+    f = state.cur_flip
+    fi = jnp.maximum(f, 0)
+    sign = params.label_values[
+        state.board[jnp.arange(c), fi].astype(jnp.int32)]
+    log = {"f": f, "s": sign.astype(jnp.int32)}
+
+    state = state.replace(
+        cur_wait=cur_wait, wait_pending=jnp.zeros_like(state.wait_pending),
+        waits_sum=waits_sum, t_yield=state.t_yield + 1,
+        cut_count=planes["cut_count"])
+    return state, ct_e16, ct_s16, out, log
+
+
+def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
+                state: BoardState, planes, kprop, kacc):
+    """Propose (single masked draw == re-propose-until-valid), accept,
+    commit."""
+    c, n = state.board.shape
+    h, w = bg.h, bg.w
+    cidx = jnp.arange(c)
+    valid = planes["valid"]
+
+    # two-level prefix selection of the (m+1)-th valid cell
+    rowcnt = valid.reshape(c, h, w).sum(axis=2, dtype=jnp.int32)
+    rowcum = jnp.cumsum(rowcnt, axis=1)                    # (C, H)
+    total = rowcum[:, -1]                                  # (C,)
+    any_valid = total > 0
+    u = _uniform(kprop)
+    m = jnp.minimum((u * total.astype(jnp.float32)).astype(jnp.int32),
+                    jnp.maximum(total - 1, 0))
+    row = jnp.argmax(rowcum > m[:, None], axis=1).astype(jnp.int32)
+    before = jnp.where(row > 0,
+                       rowcum[cidx, jnp.maximum(row - 1, 0)], 0)
+    m_in_row = m - before
+    vrow = valid.reshape(c, h, w)[cidx, row]               # (C, W)
+    colcum = jnp.cumsum(vrow.astype(jnp.int32), axis=1)
+    col = jnp.argmax(colcum > m_in_row[:, None], axis=1).astype(jnp.int32)
+    flat = row * w + col
+
+    d_from = state.board[cidx, flat].astype(jnp.int32)
+    d_to = 1 - d_from
+    # 2 districts: post-flip differing neighbors = pre-flip same neighbors
+    dd = planes["diff_deg"][cidx, flat]
+    dcut = bg.deg[flat] - 2 * dd
+
+    if spec.accept == "always":
+        accept = any_valid
+    else:
+        log_bound = (-params.beta * dcut.astype(jnp.float32)
+                     * params.log_base)
+        logu = jnp.log(jnp.maximum(_uniform(kacc), jnp.float32(1e-12)))
+        accept = any_valid & (logu < log_bound)
+
+    # one-hot masked write: cheaper than a batched scatter on TPU (no
+    # layout round-trip; fuses with the surrounding elementwise pass)
+    sel = (jnp.arange(n)[None, :] == flat[:, None]) & accept[:, None]
+    board = jnp.where(sel, d_to[:, None].astype(state.board.dtype),
+                      state.board)
+    popv = bg.pop[flat] * accept.astype(jnp.int32)
+    sgn = jnp.where(d_from == 0, 1, -1)       # moving out of 0 => 0 loses
+    dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
+    dist_pop = dist_pop.at[:, 1].add(popv * sgn)
+
+    return state.replace(
+        board=board,
+        dist_pop=dist_pop,
+        # cut_count is refreshed from recomputed planes at every record —
+        # the single maintenance path
+        cur_flip=jnp.where(accept, flat, state.cur_flip),
+        wait_pending=accept,
+        move_clock=state.move_clock + accept.astype(jnp.int32),
+        accept_count=state.accept_count + accept.astype(jnp.int32),
+        tries_sum=state.tries_sum + 1,
+        exhausted_count=state.exhausted_count
+        + (~any_valid).astype(jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Deferred flip bookkeeping: log -> (part_sum, last_flipped, num_flips)
+# ---------------------------------------------------------------------------
+
+def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0):
+    """Replay the reference's per-yield flip bookkeeping
+    (grid_chain_sec11.py:396-400) from a chunk's (T, C) log with
+    order-independent scatters. ``t0[c]`` is the absolute yield index of
+    log row 0.
+
+    Sequential semantics reproduced exactly, per yield t with pointer f
+    (f >= 0) and sign s = label of f's current district:
+        part_sum[f]     += -s * (t - last_flipped[f])
+        last_flipped[f]  = t
+        num_flips[f]    += 1
+
+    The only sequential dependence is ``last_flipped[f]`` at each yield,
+    which equals the PREVIOUS yield whose pointer was f (or the carry-in
+    value). A stable per-chain sort of the log by pointer node makes every
+    entry's previous occurrence adjacent, so all T*C contributions reduce
+    to one gather + one scatter-add. Chunk boundaries compose exactly
+    through the carried last_flipped (asserted by
+    tests/test_board.py::test_apply_flip_log_chunked_composition)."""
+    tlen, c = log_f.shape
+    n = part_sum.shape[1]
+    t_mat = t0[None, :] + jnp.arange(tlen, dtype=jnp.int32)[:, None]
+    act = log_f >= 0
+    base = (jnp.arange(c, dtype=jnp.int32) * n)[None, :]
+    idx = jnp.where(act, log_f + base, 0).reshape(-1)
+
+    ps = part_sum.reshape(-1)
+    lf = last_flipped.reshape(-1)
+    nf = num_flips.reshape(-1)
+
+    # group each chain's entries by pointer node, original order preserved
+    # within groups (=> ascending yield time)
+    order = jnp.argsort(log_f, axis=0, stable=True)
+    f_s = jnp.take_along_axis(log_f, order, axis=0)
+    t_s = jnp.take_along_axis(t_mat, order, axis=0)
+    s_s = jnp.take_along_axis(log_s, order, axis=0)
+    act_s = f_s >= 0
+    idx_s = jnp.where(act_s, f_s + base, 0).reshape(-1)
+
+    prev_same = jnp.concatenate(
+        [jnp.zeros((1, c), bool), f_s[1:] == f_s[:-1]])
+    prev_t = jnp.concatenate([jnp.zeros((1, c), t_s.dtype), t_s[:-1]])
+    lf_carry = lf[idx_s].reshape(tlen, c)
+    dt = t_s - jnp.where(prev_same, prev_t, lf_carry)
+    contrib = jnp.where(act_s, -s_s * dt, 0)
+
+    ps_new = ps.at[idx_s].add(contrib.reshape(-1))
+    lf_new = lf.at[idx].max(jnp.where(act, t_mat, -1).reshape(-1))
+    nf_new = nf.at[idx].add(act.astype(jnp.int32).reshape(-1))
+
+    return (ps_new.reshape(-1, n), lf_new.reshape(-1, n),
+            nf_new.reshape(-1, n))
+
+
+# ---------------------------------------------------------------------------
+# Chunk runners
+# ---------------------------------------------------------------------------
+
+_BOOKKEEPING = ("part_sum", "last_flipped", "num_flips",
+                "cut_times_e", "cut_times_s")
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "chunk", "collect"))
+def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
+                    state: BoardState, chunk: int, collect: bool = True):
+    """``chunk`` iterations of [complete-wait, record, transition]; records
+    yields t .. t+chunk-1 and advances ``chunk`` transitions. The heavy
+    accumulators stay OUT of the scan carry: cut_times in int16 planes
+    folded afterwards, flip bookkeeping replayed from the emitted log."""
+    if chunk > 32767:
+        raise ValueError("chunk must be <= 32767 (int16 cut_times planes)")
+    n = bg.n
+    c = state.board.shape[0]
+    t0 = state.t_yield
+    big = {k: getattr(state, k) for k in _BOOKKEEPING}
+    loop_state = state.replace(
+        **{k: None for k in _BOOKKEEPING})
+
+    def body(carry, _):
+        state, ct_e16, ct_s16 = carry
+        key, kprop, kacc, kwait = _split4(state.key)
+        state = state.replace(key=key)
+        planes = _planes(bg, spec, params, state)
+        cur_wait = _complete_wait(spec, state, planes["b_count"], kwait, n)
+        state, ct_e16, ct_s16, out, log = _record(
+            bg, spec, params, state, ct_e16, ct_s16, planes, cur_wait)
+        state = _transition(bg, spec, params, state, planes, kprop, kacc)
+        return (state, ct_e16, ct_s16), (out if collect else {}, log)
+
+    ct16 = (jnp.zeros((c, n), jnp.int16), jnp.zeros((c, n), jnp.int16))
+    (loop_state, ct_e16, ct_s16), (outs, logs) = jax.lax.scan(
+        body, (loop_state, *ct16), None, length=chunk)
+
+    big["cut_times_e"] = big["cut_times_e"] + ct_e16
+    big["cut_times_s"] = big["cut_times_s"] + ct_s16
+    if spec.parity_metrics:
+        big["part_sum"], big["last_flipped"], big["num_flips"] = \
+            apply_flip_log(big["part_sum"], big["last_flipped"],
+                           big["num_flips"], logs["f"], logs["s"], t0)
+    state = loop_state.replace(**big)
+    return state, outs
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def record_final(bg: BoardGraph, spec: Spec, params: StepParams,
+                 state: BoardState):
+    """Epilogue: complete any pending wait and record the last yield,
+    without a trailing transition."""
+    t0 = state.t_yield
+    big = {k: getattr(state, k) for k in _BOOKKEEPING}
+    loop_state = state.replace(**{k: None for k in _BOOKKEEPING})
+    key, _, _, kwait = _split4(loop_state.key)
+    loop_state = loop_state.replace(key=key)
+    planes = _planes(bg, spec, params, loop_state)
+    cur_wait = _complete_wait(spec, loop_state, planes["b_count"], kwait,
+                              bg.n)
+    ct16 = (jnp.zeros_like(big["cut_times_e"], jnp.int16),
+            jnp.zeros_like(big["cut_times_s"], jnp.int16))
+    loop_state, ct_e16, ct_s16, out, log = _record(
+        bg, spec, params, loop_state, *ct16, planes, cur_wait)
+    big["cut_times_e"] = big["cut_times_e"] + ct_e16
+    big["cut_times_s"] = big["cut_times_s"] + ct_s16
+    if spec.parity_metrics:
+        big["part_sum"], big["last_flipped"], big["num_flips"] = \
+            apply_flip_log(big["part_sum"], big["last_flipped"],
+                           big["num_flips"], log["f"][None], log["s"][None],
+                           t0)
+    return loop_state.replace(**big), out
+
+
+# ---------------------------------------------------------------------------
+# Init and host-side conversions
+# ---------------------------------------------------------------------------
+
+def init_board_state(graph: LatticeGraph, bg: BoardGraph,
+                     assignment: np.ndarray, n_chains: int, seed: int,
+                     spec: Spec, params: StepParams) -> BoardState:
+    n = bg.n
+    a0 = np.asarray(assignment, np.int8)
+    board = jnp.broadcast_to(jnp.asarray(a0), (n_chains, n))
+    pop0 = int(graph.pop[a0 == 0].sum())
+    pop1 = int(graph.pop.sum()) - pop0
+    dist_pop = jnp.broadcast_to(
+        jnp.asarray([pop0, pop1], jnp.int32), (n_chains, 2))
+    keys = jax.random.key_data(
+        jax.random.split(jax.random.PRNGKey(seed), n_chains))
+    label_values = np.asarray(params.label_values)
+    part0 = label_values[a0.astype(np.int64)].astype(np.int32)
+    a2 = a0.reshape(bg.h, bg.w)
+    cut0 = int((a2[:, :-1] != a2[:, 1:]).sum()
+               + (a2[:-1, :] != a2[1:, :]).sum())
+    return BoardState(
+        key=keys,
+        board=board,
+        dist_pop=dist_pop,
+        cut_count=jnp.full(n_chains, cut0, jnp.int32),
+        cur_wait=jnp.zeros(n_chains, jnp.float32),
+        # the initial state's wait is sampled at the first yield via the
+        # pending mechanism, matching init_state's sample_initial_wait
+        wait_pending=jnp.full(n_chains, bool(spec.geom_waits)),
+        cur_flip=jnp.full(n_chains, -1, jnp.int32),
+        t_yield=jnp.zeros(n_chains, jnp.int32),
+        move_clock=jnp.zeros(n_chains, jnp.int32),
+        part_sum=jnp.broadcast_to(jnp.asarray(part0), (n_chains, n)),
+        last_flipped=jnp.zeros((n_chains, n), jnp.int32),
+        num_flips=jnp.zeros((n_chains, n), jnp.int32),
+        cut_times_e=jnp.zeros((n_chains, n), jnp.int32),
+        cut_times_s=jnp.zeros((n_chains, n), jnp.int32),
+        waits_sum=jnp.zeros(n_chains, jnp.float32),
+        accept_count=jnp.zeros(n_chains, jnp.int32),
+        tries_sum=jnp.zeros(n_chains, jnp.int32),
+        exhausted_count=jnp.zeros(n_chains, jnp.int32),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class _EdgeIndex:
+    east: np.ndarray    # bool[E] edge is (i, i+1); else (i, i+W)
+    lo: np.ndarray      # int64[E] flat index of the smaller endpoint
+
+
+def _edge_index(graph: LatticeGraph) -> _EdgeIndex:
+    h, w = board_shape(graph)
+    lab = np.array(graph.labels, np.int64)
+    a = lab[graph.edges[:, 0]]
+    b = lab[graph.edges[:, 1]]
+    lo = np.minimum(a, b)
+    east = a[:, 0] == b[:, 0]
+    return _EdgeIndex(east=east, lo=lo[:, 0] * w + lo[:, 1])
+
+
+def edge_cut_times(graph: LatticeGraph, state: BoardState) -> np.ndarray:
+    """cut_times as an (C, E) array in LatticeGraph edge order (for the
+    artifact pipeline and general-path parity tests)."""
+    ei = _edge_index(graph)
+    te = np.asarray(state.cut_times_e)
+    ts = np.asarray(state.cut_times_s)
+    out = np.empty((te.shape[0], graph.n_edges), te.dtype)
+    out[:, ei.east] = te[:, ei.lo[ei.east]]
+    out[:, ~ei.east] = ts[:, ei.lo[~ei.east]]
+    return out

@@ -1,5 +1,6 @@
 from .runner import RunResult, run_chains, init_batch, pop_bounds
+from .board_runner import run_board, init_board
 from .recom import recom_move
 
 __all__ = ["RunResult", "run_chains", "init_batch", "pop_bounds",
-           "recom_move"]
+           "run_board", "init_board", "recom_move"]

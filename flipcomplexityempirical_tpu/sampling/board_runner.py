@@ -1,0 +1,82 @@
+"""Runner for the board (stencil) fast path: chunked scan + host readback.
+
+Mirrors ``sampling/runner.py``'s contract — same RunResult shape, same
+history keys, same f64 host accumulation of waits — so callers (bench,
+driver, tests) can switch between the general and board paths on a
+``board.supports(graph, spec)`` check without touching downstream code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graphs.lattice import LatticeGraph
+from ..kernel import board as kboard
+from ..kernel import step as kstep
+from ..kernel.step import Spec, StepParams
+from .runner import RunResult, pick_chunk, pop_bounds
+
+
+def init_board(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
+               seed: int, spec: Spec, base: float, pop_tol: float,
+               label_values=None, beta=1.0):
+    """Build (BoardGraph, BoardState, StepParams) — the board-path analogue
+    of ``runner.init_batch``."""
+    if not kboard.supports(graph, spec):
+        raise ValueError(
+            f"board path does not support (graph={graph.name!r}, {spec})")
+    if label_values is None:
+        label_values = [1, -1]
+    lo, hi = pop_bounds(graph, spec.n_districts, pop_tol)
+    params = kstep.make_params(base, lo, hi, label_values, beta=beta,
+                               n_chains=n_chains)
+    bg = kboard.make_board_graph(graph)
+    state = kboard.init_board_state(graph, bg, assignment, n_chains, seed,
+                                    spec, params)
+    return bg, state, params
+
+
+def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
+              state: kboard.BoardState, n_steps: int,
+              record_history: bool = True,
+              chunk: Optional[int] = None) -> RunResult:
+    """Run the batched board chain for ``n_steps`` yields (yield 0 is the
+    initial state, as the reference's ``for part in exp_chain`` sees it)."""
+    if chunk is None:
+        chunk = pick_chunk(n_steps, 2048)
+
+    hist_parts = {} if record_history else None
+    waits_total = np.asarray(state.waits_sum, np.float64).copy()
+    state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+
+    done = 0                      # yields recorded so far
+    transitions = n_steps - 1
+    while done < transitions:
+        this = min(chunk, transitions - done)
+        state, outs = kboard.run_board_chunk(bg, spec, params, state, this,
+                                             collect=record_history)
+        if record_history:
+            outs = jax.tree.map(np.asarray, outs)
+            for k, v in outs.items():
+                hist_parts.setdefault(k, []).append(v.T)  # (T, C) -> (C, T)
+        waits_total += np.asarray(state.waits_sum, np.float64)
+        state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+        done += this
+
+    # final yield (no trailing transition)
+    state, out_last = kboard.record_final(bg, spec, params, state)
+    if record_history:
+        out_last = jax.tree.map(np.asarray, out_last)
+        for k, v in out_last.items():
+            hist_parts.setdefault(k, []).append(v[:, None])
+    waits_total += np.asarray(state.waits_sum, np.float64)
+    state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+
+    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+               if record_history else {})
+    return RunResult(state=state, history=history,
+                     waits_total=waits_total, n_yields=n_steps)

@@ -36,6 +36,18 @@ class RunResult:
         return jax.tree.map(np.asarray, self.state)
 
 
+def pick_chunk(n_steps: int, cap: int) -> int:
+    """Default scan length: at most ``cap``, snapped to a nearby divisor of
+    the transition count so long runs compile a single scan length instead
+    of paying a second full compile for the remainder chunk."""
+    chunk = max(1, min(n_steps - 1, cap))
+    total = n_steps - 1
+    for d in range(chunk, max(chunk // 2, 1) - 1, -1):
+        if total % d == 0:
+            return d
+    return chunk
+
+
 def pop_bounds(graph: LatticeGraph, k: int, tol: float):
     """within_percent_of_ideal_population semantics
     (grid_chain_sec11.py:319): bounds from the ideal of the initial
@@ -108,15 +120,7 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     """
     n_chains = states.assignment.shape[0]
     if chunk is None:
-        chunk = max(1, min(n_steps - 1, 4096))
-        # snap to a divisor of the transition count when one is nearby, so
-        # long runs compile a single scan length instead of paying a second
-        # full compile for the remainder chunk
-        total = n_steps - 1
-        for d in range(chunk, max(chunk // 2, 1) - 1, -1):
-            if total % d == 0:
-                chunk = d
-                break
+        chunk = pick_chunk(n_steps, 4096)
 
     states, out0 = _record_initial(dg, spec, params, states)
     hist_parts = {k: [np.asarray(v)[:, None]] for k, v in out0.items()} \

@@ -1,0 +1,356 @@
+"""Board (stencil) fast-path verification.
+
+Four layers, per the test strategy of SURVEY.md section 4:
+
+1. Exhaustive local equivalence: the ring contiguity criterion equals
+   ``contiguity.patch_connected`` for EVERY membership pattern of the
+   radius-2 patch (up to 2^12 patterns) at interior, edge, corner, and
+   near-corner positions — the proof obligation for collapsing the patch
+   BFS into elementwise stencil ops.
+2. Exact replay of the deferred flip bookkeeping: ``apply_flip_log``'s
+   scatter algebra against a sequential Python replay of the reference's
+   per-yield updates (grid_chain_sec11.py:396-400), including chunked
+   application.
+3. Exact per-run invariants: derived fields never drift from the board;
+   accumulators tie out against histories (sum cut_times == sum cut_count
+   over yields; waits_total == sum of wait history); chunking invisible.
+4. Cross-path distributional parity: run_board vs run_chains (same spec,
+   independent RNG streams) agree on cut/b/wait trajectory statistics and
+   accumulator profiles.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.kernel import board as kb
+from flipcomplexityempirical_tpu.kernel import contiguity
+
+from test_parity import ks_stat
+
+
+# ---------------------------------------------------------------------------
+# 1. ring criterion == patch_connected, exhaustively
+# ---------------------------------------------------------------------------
+
+def _patch_cells(h, w, x, y):
+    """Radius-2 rook ball around (x, y), clipped to the grid (== the patch
+    of graphs.lattice.build_lattice for a plain grid)."""
+    cells = []
+    for dx in range(-2, 3):
+        for dy in range(-2, 3):
+            if 0 < abs(dx) + abs(dy) <= 2:
+                cx, cy = x + dx, y + dy
+                if 0 <= cx < h and 0 <= cy < w:
+                    cells.append((cx, cy))
+    return cells
+
+
+@pytest.mark.parametrize("pos", [(2, 2), (0, 2), (2, 0), (0, 0), (1, 1),
+                                 (1, 2), (4, 4), (4, 2), (0, 4)])
+def test_ring_equals_patch_exhaustive(pos):
+    h = w = 5
+    g = fce.graphs.square_grid(h, w)
+    dg = g.device()
+    bg = kb.make_board_graph(g)
+    x, y = pos
+    v = x * w + y
+    cells = _patch_cells(h, w, x, y)
+    assert len(cells) <= 12
+
+    boards = []
+    for bits in itertools.product((0, 1), repeat=len(cells)):
+        b = np.ones((h, w), np.int8)       # everything else: other district
+        b[x, y] = 0                        # v's own district is 0
+        for (cx, cy), m in zip(cells, bits):
+            b[cx, cy] = 0 if m else 1
+        boards.append(b.reshape(-1))
+    boards = np.stack(boards)              # (2^k, N)
+
+    # ring criterion, batched over patterns (patterns act as the C axis)
+    same = kb.same_planes(bg, jnp.asarray(boards))
+    ring = np.asarray(kb.ring_contig_ok(same))[:, v]
+
+    patch = np.asarray(jax.vmap(
+        lambda a: contiguity.patch_connected(dg, a, v, jnp.int32(0)))(
+            jnp.asarray(boards)))
+
+    mism = np.nonzero(ring != patch)[0]
+    assert mism.size == 0, (
+        f"ring vs patch disagree at pos {pos} for {mism.size} patterns, "
+        f"first board:\n{boards[mism[0]].reshape(h, w)}")
+
+
+def test_ring_equals_patch_random_boards(rng):
+    """Whole-board comparison on random assignments: every node's ring
+    verdict equals its patch verdict (both origin districts arise since
+    membership is relative to each node's own label)."""
+    h = w = 7
+    g = fce.graphs.square_grid(h, w)
+    dg = g.device()
+    bg = kb.make_board_graph(g)
+
+    boards = (rng.random((64, h * w)) < 0.5).astype(np.int8)
+    same = kb.same_planes(bg, jnp.asarray(boards))
+    ring = np.asarray(kb.ring_contig_ok(same))
+
+    nodes = jnp.arange(h * w)
+
+    def one(a):
+        return jax.vmap(
+            lambda vv: contiguity.patch_connected(
+                dg, a, vv, a[vv].astype(jnp.int32)))(nodes)
+
+    patch = np.asarray(jax.vmap(one)(jnp.asarray(boards)))
+    assert (ring == patch).all()
+
+
+# ---------------------------------------------------------------------------
+# 2. deferred flip bookkeeping == sequential replay
+# ---------------------------------------------------------------------------
+
+def _replay_sequential(part_sum, last_flipped, num_flips, log_f, log_s, t0):
+    """The reference's per-yield updates (grid_chain_sec11.py:396-400),
+    literally."""
+    ps, lf, nf = part_sum.copy(), last_flipped.copy(), num_flips.copy()
+    tlen, c = log_f.shape
+    for r in range(tlen):
+        for ci in range(c):
+            f = log_f[r, ci]
+            if f < 0:
+                continue
+            t = t0[ci] + r
+            s = log_s[r, ci]
+            ps[ci, f] += -s * (t - lf[ci, f])
+            lf[ci, f] = t
+            nf[ci, f] += 1
+    return ps, lf, nf
+
+
+def _random_log(rng, tlen, c, n, p_accept=0.4, p_none=0.1):
+    """A log with the structure the chain produces: the pointer holds
+    between accepts, each accept moves it to a fresh node and flips that
+    node's sign; chains may start with no pointer."""
+    log_f = np.full((tlen, c), -1, np.int32)
+    log_s = np.ones((tlen, c), np.int32)
+    node_sign = {}
+    for ci in range(c):
+        f = -1 if rng.random() < p_none else int(rng.integers(n))
+        if f >= 0:
+            node_sign[(ci, f)] = rng.choice([-1, 1])
+        for r in range(tlen):
+            if rng.random() < p_accept:
+                f = int(rng.integers(n))
+                node_sign[(ci, f)] = -node_sign.get((ci, f), -1)
+            if f >= 0:
+                log_f[r, ci] = f
+                log_s[r, ci] = node_sign[(ci, f)]
+    return log_f, log_s
+
+
+def test_apply_flip_log_matches_sequential(rng):
+    tlen, c, n = 60, 5, 12
+    log_f, log_s = _random_log(rng, tlen, c, n)
+    t0 = rng.integers(0, 50, size=c).astype(np.int32)
+    ps0 = rng.integers(-5, 5, size=(c, n)).astype(np.int32)
+    lf0 = rng.integers(0, 3, size=(c, n)).astype(np.int32)
+    nf0 = rng.integers(0, 3, size=(c, n)).astype(np.int32)
+    # last_flipped carry-in must precede the log (reference invariant)
+    lf0 = np.minimum(lf0, t0[:, None])
+
+    want = _replay_sequential(ps0, lf0, nf0, log_f, log_s, t0)
+    got = kb.apply_flip_log(jnp.asarray(ps0), jnp.asarray(lf0),
+                            jnp.asarray(nf0), jnp.asarray(log_f),
+                            jnp.asarray(log_s), jnp.asarray(t0))
+    for w_arr, g_arr, name in zip(want, got,
+                                  ("part_sum", "last_flipped", "num_flips")):
+        np.testing.assert_array_equal(np.asarray(g_arr), w_arr, err_msg=name)
+
+
+def test_apply_flip_log_chunked_composition(rng):
+    """Splitting a log at an arbitrary boundary (including mid-run) and
+    applying the pieces sequentially gives the same result as one piece."""
+    tlen, c, n = 50, 4, 10
+    log_f, log_s = _random_log(rng, tlen, c, n)
+    t0 = np.zeros(c, np.int32)
+    ps0 = np.zeros((c, n), np.int32)
+    lf0 = np.zeros((c, n), np.int32)
+    nf0 = np.zeros((c, n), np.int32)
+
+    whole = kb.apply_flip_log(jnp.asarray(ps0), jnp.asarray(lf0),
+                              jnp.asarray(nf0), jnp.asarray(log_f),
+                              jnp.asarray(log_s), jnp.asarray(t0))
+    for cut in (1, 17, 23, 49):
+        a = kb.apply_flip_log(jnp.asarray(ps0), jnp.asarray(lf0),
+                              jnp.asarray(nf0), jnp.asarray(log_f[:cut]),
+                              jnp.asarray(log_s[:cut]), jnp.asarray(t0))
+        b = kb.apply_flip_log(*a, jnp.asarray(log_f[cut:]),
+                              jnp.asarray(log_s[cut:]),
+                              jnp.asarray(t0 + cut))
+        for w_arr, g_arr in zip(whole, b):
+            np.testing.assert_array_equal(np.asarray(g_arr),
+                                          np.asarray(w_arr))
+
+
+# ---------------------------------------------------------------------------
+# 3. exact invariants of a run
+# ---------------------------------------------------------------------------
+
+def _run(grid=8, chains=32, steps=601, base=1.4, tol=0.3, seed=3, **kw):
+    g = fce.graphs.square_grid(grid, grid)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                    invalid="repropose", accept="cut",
+                    parity_metrics=True, geom_waits=True, **kw)
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=seed, spec=spec, base=base,
+        pop_tol=tol)
+    res = fce.sampling.run_board(bg, spec, params, st, n_steps=steps,
+                                 chunk=100)
+    return g, res
+
+
+def test_board_invariants():
+    g, res = _run()
+    s = res.host_state()
+    h = w = 8
+    b = s.board.reshape(-1, h, w)
+
+    # derived fields are pure functions of the board
+    pop0 = (b == 0).sum(axis=(1, 2))
+    assert (s.dist_pop[:, 0] == pop0).all()
+    assert (s.dist_pop[:, 1] == h * w - pop0).all()
+    cut = ((b[:, :, :-1] != b[:, :, 1:]).sum(axis=(1, 2))
+           + (b[:, :-1, :] != b[:, 1:, :]).sum(axis=(1, 2)))
+    assert (s.cut_count == cut).all()
+
+    # every chain still satisfies contiguity (district connected) — the
+    # single masked draw must never commit a disconnecting flip
+    from scipy.ndimage import label as cc_label
+    for c in range(b.shape[0]):
+        for d in (0, 1):
+            _, ncomp = cc_label(b[c] == d)
+            assert ncomp == 1, f"chain {c} district {d} split into {ncomp}"
+
+    # accumulators tie out against histories
+    cut_t = kb.edge_cut_times(g, res.state)
+    np.testing.assert_array_equal(cut_t.sum(axis=1),
+                                  res.history["cut_count"].sum(axis=1))
+    np.testing.assert_allclose(res.waits_total,
+                               res.history["wait"].sum(axis=1, dtype=float),
+                               rtol=1e-6)
+    # num_flips counts every yield whose state carries a flip pointer
+    # (reference re-application quirk): equals yields after first accept
+    first = (res.history["accepts"] > 0).argmax(axis=1)
+    expect = np.where(res.history["accepts"][:, -1] > 0,
+                      res.history["accepts"].shape[1] - first, 0)
+    np.testing.assert_array_equal(s.num_flips.sum(axis=1), expect)
+
+
+def test_board_population_bounds_respected():
+    g, res = _run(tol=0.05, steps=801)
+    s = res.host_state()
+    ideal = g.n_nodes / 2
+    assert (s.dist_pop >= (1 - 0.05) * ideal - 1e-6).all()
+    assert (s.dist_pop <= (1 + 0.05) * ideal + 1e-6).all()
+
+
+def test_board_chunking_is_invisible():
+    """Same seed, different chunking => bit-identical state and history."""
+    g = fce.graphs.square_grid(6, 6)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    outs = []
+    for chunk in (7, 50):
+        bg, st, params = fce.sampling.init_board(
+            g, plan, n_chains=8, seed=5, spec=spec, base=1.2, pop_tol=0.3)
+        res = fce.sampling.run_board(bg, spec, params, st, n_steps=201,
+                                     chunk=chunk)
+        outs.append(res)
+    a, b = outs
+    for k in a.history:
+        np.testing.assert_array_equal(a.history[k], b.history[k])
+    for fld in ("board", "part_sum", "last_flipped", "num_flips",
+                "cut_times_e", "cut_times_s"):
+        np.testing.assert_array_equal(np.asarray(getattr(a.state, fld)),
+                                      np.asarray(getattr(b.state, fld)),
+                                      err_msg=fld)
+    np.testing.assert_allclose(a.waits_total, b.waits_total)
+
+
+def test_supports_gates():
+    spec = fce.Spec(contiguity="patch")
+    assert kb.supports(fce.graphs.square_grid(6, 6), spec)
+    # non-board graphs and unsupported specs must fall back
+    assert not kb.supports(fce.graphs.grid_sec11(), spec)
+    assert not kb.supports(fce.graphs.frankengraph(), spec)
+    g = fce.graphs.square_grid(6, 6)
+    assert not kb.supports(g, fce.Spec(contiguity="exact"))
+    assert not kb.supports(g, fce.Spec(proposal="pair"))
+    assert not kb.supports(g, fce.Spec(invalid="selfloop"))
+    assert not kb.supports(g, fce.Spec(accept="corrected"))
+    assert not kb.supports(g, fce.Spec(anneal="linear"))
+    assert not kb.supports(g, fce.Spec(record_interface=True))
+
+
+# ---------------------------------------------------------------------------
+# 4. board path vs general path: same distribution
+# ---------------------------------------------------------------------------
+
+def test_board_matches_general_path():
+    grid, chains, steps, burn = 8, 24, 4001, 800
+    base, tol = 1.4, 0.2
+    g = fce.graphs.square_grid(grid, grid)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                    invalid="repropose", accept="cut",
+                    parity_metrics=True, geom_waits=True)
+
+    dg, st_g, par_g = fce.init_batch(g, plan, n_chains=chains, seed=11,
+                                     spec=spec, base=base, pop_tol=tol)
+    res_g = fce.run_chains(dg, spec, par_g, st_g, n_steps=steps)
+
+    bg, st_b, par_b = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=17, spec=spec, base=base, pop_tol=tol)
+    res_b = fce.sampling.run_board(bg, spec, par_b, st_b, n_steps=steps)
+
+    sub = slice(burn, None, 25)
+    for key, tol_ks in (("cut_count", 0.06), ("b_count", 0.06)):
+        a = res_g.history[key][:, sub].ravel()
+        b = res_b.history[key][:, sub].ravel()
+        ks = ks_stat(a, b)
+        assert ks < tol_ks, f"{key} KS {ks:.4f}"
+        ma, mb = a.mean(), b.mean()
+        assert abs(ma - mb) / ma < 0.02, f"{key} means {ma:.2f} vs {mb:.2f}"
+
+    # waits are heavy-tailed; compare means loosely and accept rates tightly
+    wa = res_g.history["wait"][:, burn:].mean()
+    wb = res_b.history["wait"][:, burn:].mean()
+    assert abs(wa - wb) / wa < 0.1, f"wait means {wa:.2f} vs {wb:.2f}"
+    aa = np.asarray(res_g.state.accept_count).mean()
+    ab = np.asarray(res_b.state.accept_count).mean()
+    assert abs(aa - ab) / aa < 0.05, f"accepts {aa:.1f} vs {ab:.1f}"
+
+    # parity accumulators: per-node flip-count fields drawn from the same
+    # distribution => chain-averaged profiles correlate strongly
+    nf_g = np.asarray(res_g.state.num_flips).mean(axis=0)
+    nf_b = np.asarray(res_b.state.num_flips).mean(axis=0)
+    corr = np.corrcoef(nf_g, nf_b)[0, 1]
+    assert corr > 0.97, f"num_flips profile corr {corr:.3f}"
+
+    # cut-edge heat profiles likewise (exercises edge_cut_times mapping)
+    ct_g = np.asarray(res_g.state.cut_times).mean(axis=0)
+    ct_b = kb.edge_cut_times(g, res_b.state).mean(axis=0)
+    corr_ct = np.corrcoef(ct_g, ct_b)[0, 1]
+    assert corr_ct > 0.97, f"cut_times profile corr {corr_ct:.3f}"
+
+    # part_sum profiles: same time-integral structure across the board
+    psg = np.asarray(res_g.state.part_sum).mean(axis=0)
+    psb = np.asarray(res_b.state.part_sum).mean(axis=0)
+    corr_ps = np.corrcoef(psg, psb)[0, 1]
+    assert corr_ps > 0.9, f"part_sum profile corr {corr_ps:.3f}"
