@@ -23,14 +23,25 @@ def main():
                     default="patch")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="steps between mid-config checkpoints (0: only at "
+                         "config completion); an interrupted config resumes "
+                         "from the last saved segment")
     ap.add_argument("--only", nargs="*", default=None,
                     help="config tags to run, e.g. 2B30P10")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (jax.config, which works "
+                         "even where JAX_PLATFORMS env is pre-pinned)")
     args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     sweep = sec11_sweep if args.family == "sec11" else frank_sweep
     configs = list(sweep(total_steps=args.steps, n_chains=args.chains,
                          backend=args.backend, contiguity=args.contiguity,
-                         seed=args.seed))
+                         seed=args.seed,
+                         checkpoint_every=args.checkpoint_every))
     if args.only:
         configs = [c for c in configs if c.tag in set(args.only)]
     run_sweep(configs, args.out, checkpoint_dir=args.checkpoint_dir)

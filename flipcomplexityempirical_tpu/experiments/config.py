@@ -34,6 +34,9 @@ class ExperimentConfig:
     backend: str = "jax"      # 'jax' | 'python'
     contiguity: str = "patch"  # 'patch' | 'exact'
     accept: str = "cut"       # 'cut' | 'corrected'
+    checkpoint_every: int = 0  # steps between mid-config checkpoints
+                               # (0 = only at completion); resume picks up
+                               # from the last saved segment
 
     @property
     def tag(self) -> str:

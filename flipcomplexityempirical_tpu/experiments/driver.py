@@ -20,6 +20,7 @@ import os
 import time
 from typing import Optional
 
+import jax
 import numpy as np
 
 from .. import compat
@@ -70,34 +71,97 @@ def run_config(cfg: ExperimentConfig, outdir: str,
     return data
 
 
-def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None) -> dict:
+def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
+             _stop_after_segments: Optional[int] = None) -> dict:
+    """Batched run, in checkpoint segments when cfg.checkpoint_every > 0.
+
+    A crash between segments loses at most ``checkpoint_every`` steps: the
+    next run_config resumes chain state, histories, and wait totals from
+    the per-config npz (upgrading the reference's redo-everything crash
+    story, SURVEY.md section 5 'Checkpoint / resume'). The segmented run
+    is bit-identical to an uninterrupted one because PRNG keys live in the
+    chain state and segment boundaries reuse the chunked runner.
+    ``_stop_after_segments`` simulates an interruption for tests."""
     spec = Spec(n_districts=2, proposal="bi", contiguity=cfg.contiguity,
                 invalid="repropose", accept=cfg.accept,
                 record_interface=True, parity_metrics=True, geom_waits=True)
     dg, states, params = init_batch(
         g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
         base=cfg.base, pop_tol=cfg.pop_tol)
-    res = run_chains(dg, spec, params, states, n_steps=cfg.total_steps)
-    s = res.host_state()
+
+    done = 0
+    n_parts = 0
+    hist_parts: dict = {}
+    waits_total = np.zeros(cfg.n_chains, np.float64)
+    if checkpoint_dir:
+        loaded = load_checkpoint(checkpoint_dir, cfg)
+        if loaded is not None:
+            done = int(loaded["meta_done"])
+            n_parts = int(loaded["meta_n_parts"])
+            states = _state_from_arrays(states, loaded)
+            hist_parts = {k[len("hist_"):]: [v] for k, v in loaded.items()
+                          if k.startswith("hist_")}
+            waits_total = loaded["meta_waits_total"].copy()
+
+    every = cfg.checkpoint_every or cfg.total_steps
+    segments = 0
+    while done < cfg.total_steps:
+        n = min(every, cfg.total_steps - done)
+        res = run_chains(dg, spec, params, states, n_steps=n,
+                         record_initial=(done == 0))
+        states = res.state
+        for k, v in res.history.items():
+            hist_parts.setdefault(k, []).append(v)
+        waits_total += res.waits_total
+        done += n
+        segments += 1
+        if checkpoint_dir:
+            n_parts = save_checkpoint(
+                checkpoint_dir, cfg, res.host_state(), done=done,
+                waits_total=waits_total, new_hist=res.history,
+                part_idx=n_parts)
+        if _stop_after_segments and segments >= _stop_after_segments:
+            raise _SegmentStop(done)
+
+    history = {k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+    s = jax.tree.map(np.asarray, states)
     t_final = cfg.total_steps  # reference t after the loop (line 402)
     c0 = type(s)(**{f: np.asarray(getattr(s, f))[0]
                     for f in s.__dataclass_fields__})
     part_sum, _ = finalize_host(c0, np.asarray(PARITY_LABELS), t_final)
-    if checkpoint_dir:
-        save_checkpoint(checkpoint_dir, cfg, s)
     return {
         "end_signed": np.asarray(PARITY_LABELS)[
             np.asarray(c0.assignment, dtype=np.int64)],
         "cut_times": np.asarray(c0.cut_times),
         "part_sum": part_sum,
         "num_flips": np.asarray(c0.num_flips),
-        "slopes": res.history["slope"][0],
-        "angles": res.history["angle"][0],
-        "waits_sum": float(res.waits_total[0]),
-        "history": res.history,
-        "waits_all": res.waits_total,
+        "slopes": history["slope"][0],
+        "angles": history["angle"][0],
+        "waits_sum": float(waits_total[0]),
+        "history": history,
+        "waits_all": waits_total,
         "state": s,
     }
+
+
+class _SegmentStop(RuntimeError):
+    """Raised by _run_jax when _stop_after_segments simulates a crash."""
+
+    def __init__(self, done):
+        super().__init__(f"stopped after {done} steps")
+        self.done = done
+
+
+def _state_from_arrays(template, loaded: dict):
+    """Rebuild a device ChainState from checkpoint arrays, using the
+    freshly-initialized state as the shape/dtype template."""
+    import jax.numpy as jnp
+
+    fields = {}
+    for f in template.__dataclass_fields__:
+        arr = loaded[f"state_{f}"]
+        fields[f] = jnp.asarray(arr)
+    return type(template)(**fields)
 
 
 def make_wall_lookup(g):
@@ -193,18 +257,72 @@ def _run_python(cfg: ExperimentConfig, g, plan) -> dict:
     }
 
 
-def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state):
+def _ckpt_identity(cfg: ExperimentConfig) -> str:
+    """Everything the tag does NOT encode but resume correctness needs."""
+    return (f"{cfg.family}|steps={cfg.total_steps}|chains={cfg.n_chains}|"
+            f"seed={cfg.seed}|contiguity={cfg.contiguity}|"
+            f"accept={cfg.accept}")
+
+
+def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,
+                    done: int = 0, waits_total=None, new_hist=None,
+                    part_idx: int = 0) -> int:
+    """Per-config checkpoint: ``<tag>.npz`` holds the chain state
+    (state_*), progress + config identity (meta_*); each segment's history
+    goes to its own ``<tag>.h<k>.npz`` part file so a save costs
+    O(segment), not O(run-so-far). The main file is written atomically
+    AFTER its part, so meta_n_parts never points at a missing file.
+    Returns the next part index."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    arrays = {f: np.asarray(getattr(host_state, f))
+    if new_hist:
+        ppath = os.path.join(ckpt_dir, f"{cfg.tag}.h{part_idx:04d}.npz")
+        np.savez_compressed(ppath + ".tmp.npz",
+                            **{k: np.asarray(v)
+                               for k, v in new_hist.items()})
+        os.replace(ppath + ".tmp.npz", ppath)
+        part_idx += 1
+    arrays = {f"state_{f}": np.asarray(getattr(host_state, f))
               for f in host_state.__dataclass_fields__}
-    np.savez_compressed(os.path.join(ckpt_dir, cfg.tag + ".npz"), **arrays)
+    arrays["meta_done"] = np.int64(done)
+    arrays["meta_n_parts"] = np.int64(part_idx)
+    arrays["meta_identity"] = np.array(_ckpt_identity(cfg))
+    if waits_total is not None:
+        arrays["meta_waits_total"] = np.asarray(waits_total, np.float64)
+    path = os.path.join(ckpt_dir, cfg.tag + ".npz")
+    np.savez_compressed(path + ".tmp.npz", **arrays)
+    os.replace(path + ".tmp.npz", path)
+    return part_idx
 
 
 def load_checkpoint(ckpt_dir: str, cfg: ExperimentConfig):
+    """Load and validate a checkpoint; None (fresh start) when absent,
+    written by an incompatible config, or in an unrecognized format —
+    the recovery path must never crash on stale files."""
     path = os.path.join(ckpt_dir, cfg.tag + ".npz")
     if not os.path.exists(path):
         return None
-    return dict(np.load(path))
+    d = dict(np.load(path))
+    if "meta_done" not in d or "meta_identity" not in d:
+        print(f"[ckpt] ignoring {path}: unrecognized format")
+        return None
+    if str(d["meta_identity"]) != _ckpt_identity(cfg):
+        print(f"[ckpt] ignoring {path}: config mismatch "
+              f"({d['meta_identity']} != {_ckpt_identity(cfg)})")
+        return None
+    if int(d["meta_done"]) > cfg.total_steps:
+        print(f"[ckpt] ignoring {path}: more steps than requested")
+        return None
+    hist: dict = {}
+    for k in range(int(d["meta_n_parts"])):
+        ppath = os.path.join(ckpt_dir, f"{cfg.tag}.h{k:04d}.npz")
+        if not os.path.exists(ppath):
+            print(f"[ckpt] ignoring {path}: missing part {ppath}")
+            return None
+        for name, arr in np.load(ppath).items():
+            hist.setdefault(name, []).append(arr)
+    for name, parts in hist.items():
+        d[f"hist_{name}"] = np.concatenate(parts, axis=1)
+    return d
 
 
 def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,

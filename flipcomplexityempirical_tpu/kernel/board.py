@@ -212,7 +212,7 @@ def ring_contig_ok(same):
     """The ring criterion (== patch_connected on plain rook grids; see
     module docstring). ok iff <=1 same-district rook neighbor, or all
     same-district rook neighbors lie in one cyclic-adjacent block."""
-    seeds = (same[0].astype(jnp.int32) + same[2] + same[4] + same[6])
+    seeds = (same[0].astype(jnp.int8) + same[2] + same[4] + same[6])
     runs = jnp.zeros_like(seeds)
     for i in (0, 2, 4, 6):
         linked = same[(i - 1) % 8] & same[(i - 2) % 8]
@@ -226,8 +226,10 @@ def _planes(bg: BoardGraph, spec: Spec, params: StepParams,
     validity, boundary count."""
     board = state.board
     same = same_planes(bg, board)
-    same_deg = (same[0].astype(jnp.int32) + same[2] + same[4] + same[6])
-    diff_deg = bg.deg[None] - same_deg
+    # small-range planes stay int8: half/quarter the HBM traffic of the
+    # default int32 promotion, and values are <= 4 by construction
+    same_deg = (same[0].astype(jnp.int8) + same[2] + same[4] + same[6])
+    diff_deg = bg.deg[None].astype(jnp.int8) - same_deg
     b_mask = diff_deg > 0
     b_count = b_mask.sum(axis=1, dtype=jnp.int32)
     south_ok = jnp.arange(bg.n) < (bg.h - 1) * bg.w
@@ -316,8 +318,14 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     cidx = jnp.arange(c)
     valid = planes["valid"]
 
-    # two-level prefix selection of the (m+1)-th valid cell
-    rowcnt = valid.reshape(c, h, w).sum(axis=2, dtype=jnp.int32)
+    # two-level prefix selection of the (m+1)-th valid cell. Row counts
+    # ride the MXU: (C, N) x (N, H) block matmul in bf16 (counts <= W
+    # stay exact) instead of reshaping to (C, H, W), whose tiled layout
+    # forces a full-plane copy on TPU.
+    block = (jnp.arange(n)[:, None] // w
+              == jnp.arange(h)[None, :]).astype(jnp.bfloat16)
+    rowcnt = jnp.dot(valid.astype(jnp.bfloat16), block,
+                     preferred_element_type=jnp.float32).astype(jnp.int32)
     rowcum = jnp.cumsum(rowcnt, axis=1)                    # (C, H)
     total = rowcum[:, -1]                                  # (C,)
     any_valid = total > 0
@@ -328,7 +336,8 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     before = jnp.where(row > 0,
                        rowcum[cidx, jnp.maximum(row - 1, 0)], 0)
     m_in_row = m - before
-    vrow = valid.reshape(c, h, w)[cidx, row]               # (C, W)
+    row_cols = row[:, None] * w + jnp.arange(w)[None, :]
+    vrow = jnp.take_along_axis(valid, row_cols, axis=1)    # (C, W)
     colcum = jnp.cumsum(vrow.astype(jnp.int32), axis=1)
     col = jnp.argmax(colcum > m_in_row[:, None], axis=1).astype(jnp.int32)
     flat = row * w + col
@@ -336,7 +345,7 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     d_from = state.board[cidx, flat].astype(jnp.int32)
     d_to = 1 - d_from
     # 2 districts: post-flip differing neighbors = pre-flip same neighbors
-    dd = planes["diff_deg"][cidx, flat]
+    dd = planes["diff_deg"][cidx, flat].astype(jnp.int32)
     dcut = bg.deg[flat] - 2 * dd
 
     if spec.accept == "always":
@@ -397,34 +406,43 @@ def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0):
     tests/test_board.py::test_apply_flip_log_chunked_composition)."""
     tlen, c = log_f.shape
     n = part_sum.shape[1]
-    t_mat = t0[None, :] + jnp.arange(tlen, dtype=jnp.int32)[:, None]
-    act = log_f >= 0
-    base = (jnp.arange(c, dtype=jnp.int32) * n)[None, :]
-    idx = jnp.where(act, log_f + base, 0).reshape(-1)
+    # chain-major orientation: after the per-chain sort the flat scatter
+    # index (c * n + f) is globally non-decreasing, unlocking the sorted
+    # scatter path (no index hashing/serialization on TPU)
+    f_cm = log_f.T                                       # (C, T)
+    s_cm = log_s.T
+    t_cm = t0[:, None] + jnp.arange(tlen, dtype=jnp.int32)[None, :]
+    base = (jnp.arange(c, dtype=jnp.int32) * n)[:, None]
+
+    # group each chain's entries by pointer node, original order preserved
+    # within groups (=> ascending yield time); inactive (-1) entries sort
+    # first within their chain and scatter to its node-0 slot with no-op
+    # values, keeping the flat index globally non-decreasing
+    order = jnp.argsort(f_cm, axis=1, stable=True)
+    f_s = jnp.take_along_axis(f_cm, order, axis=1)
+    t_s = jnp.take_along_axis(t_cm, order, axis=1)
+    s_s = jnp.take_along_axis(s_cm, order, axis=1)
+    act_s = f_s >= 0
+    idx_s = (jnp.maximum(f_s, 0) + base).reshape(-1)
 
     ps = part_sum.reshape(-1)
     lf = last_flipped.reshape(-1)
     nf = num_flips.reshape(-1)
 
-    # group each chain's entries by pointer node, original order preserved
-    # within groups (=> ascending yield time)
-    order = jnp.argsort(log_f, axis=0, stable=True)
-    f_s = jnp.take_along_axis(log_f, order, axis=0)
-    t_s = jnp.take_along_axis(t_mat, order, axis=0)
-    s_s = jnp.take_along_axis(log_s, order, axis=0)
-    act_s = f_s >= 0
-    idx_s = jnp.where(act_s, f_s + base, 0).reshape(-1)
-
     prev_same = jnp.concatenate(
-        [jnp.zeros((1, c), bool), f_s[1:] == f_s[:-1]])
-    prev_t = jnp.concatenate([jnp.zeros((1, c), t_s.dtype), t_s[:-1]])
-    lf_carry = lf[idx_s].reshape(tlen, c)
+        [jnp.zeros((c, 1), bool), f_s[:, 1:] == f_s[:, :-1]], axis=1)
+    prev_t = jnp.concatenate(
+        [jnp.zeros((c, 1), t_s.dtype), t_s[:, :-1]], axis=1)
+    lf_carry = lf[idx_s].reshape(c, tlen)
     dt = t_s - jnp.where(prev_same, prev_t, lf_carry)
     contrib = jnp.where(act_s, -s_s * dt, 0)
 
-    ps_new = ps.at[idx_s].add(contrib.reshape(-1))
-    lf_new = lf.at[idx].max(jnp.where(act, t_mat, -1).reshape(-1))
-    nf_new = nf.at[idx].add(act.astype(jnp.int32).reshape(-1))
+    ps_new = ps.at[idx_s].add(contrib.reshape(-1),
+                              indices_are_sorted=True)
+    lf_new = lf.at[idx_s].max(jnp.where(act_s, t_s, -1).reshape(-1),
+                              indices_are_sorted=True)
+    nf_new = nf.at[idx_s].add(act_s.astype(jnp.int32).reshape(-1),
+                              indices_are_sorted=True)
 
     return (ps_new.reshape(-1, n), lf_new.reshape(-1, n),
             nf_new.reshape(-1, n))

@@ -114,23 +114,32 @@ def _record_initial(dg: DeviceGraph, spec: Spec, params: StepParams,
 def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                states: ChainState, n_steps: int,
                record_history: bool = True,
-               chunk: Optional[int] = None) -> RunResult:
+               chunk: Optional[int] = None,
+               record_initial: bool = True) -> RunResult:
     """Run the batched chain for ``n_steps`` yields (the first yield is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
+
+    ``record_initial=False`` continues an earlier run: the current state
+    was already recorded as that run's last yield, so all ``n_steps``
+    yields here are fresh transitions (checkpoint-resume path).
     """
     n_chains = states.assignment.shape[0]
     if chunk is None:
-        chunk = pick_chunk(n_steps, 4096)
+        chunk = pick_chunk(n_steps + (0 if record_initial else 1), 4096)
 
-    states, out0 = _record_initial(dg, spec, params, states)
-    hist_parts = {k: [np.asarray(v)[:, None]] for k, v in out0.items()} \
-        if record_history else None
+    if record_initial:
+        states, out0 = _record_initial(dg, spec, params, states)
+        hist_parts = {k: [np.asarray(v)[:, None]] for k, v in out0.items()} \
+            if record_history else None
+        done = 1
+    else:
+        hist_parts = {} if record_history else None
+        done = 0
     # waits accumulate on device in f32 but are drained and zeroed at every
     # chunk boundary, so the host f64 total stays exact over long horizons
     waits_total = np.asarray(states.waits_sum, np.float64).copy()
     states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
 
-    done = 1
     while done < n_steps:
         this = min(chunk, n_steps - done)
         states, outs = _run_chunk(dg, spec, params, states, this,
@@ -138,7 +147,7 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         if record_history:
             outs = jax.tree.map(np.asarray, outs)
             for k, v in outs.items():
-                hist_parts[k].append(v.T)  # (chunk, C) -> (C, chunk)
+                hist_parts.setdefault(k, []).append(v.T)  # (chunk, C)->(C,)
         waits_total += np.asarray(states.waits_sum, np.float64)
         states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
         done += this

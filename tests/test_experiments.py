@@ -62,5 +62,66 @@ def test_checkpoint_roundtrip(tmp_path):
     data = ex.run_config(cfg, out, checkpoint_dir=ck)
     loaded = ex.load_checkpoint(ck, cfg)
     assert loaded is not None
-    assert (loaded["assignment"] ==
+    assert int(loaded["meta_done"]) == 150
+    assert (loaded["state_assignment"] ==
             np.asarray(data["state"].assignment)).all()
+
+
+def test_mid_config_resume_is_bit_identical(tmp_path):
+    """A crash between checkpoint segments resumes exactly: the
+    interrupted-and-resumed run reproduces the uninterrupted run
+    bit-for-bit (PRNG keys live in the checkpointed chain state)."""
+    from flipcomplexityempirical_tpu.experiments import driver as drv
+
+    kw = dict(family="frank", alignment=0, base=0.3, pop_tol=0.5,
+              total_steps=240, n_chains=2)
+    # the baseline is a genuinely uninterrupted, unsegmented run
+    clean = ex.run_config(ex.ExperimentConfig(**kw), str(tmp_path / "a"))
+
+    # interrupted run: crash after the first 100-step segment...
+    cfg = ex.ExperimentConfig(**kw, checkpoint_every=100)
+    ck_b = str(tmp_path / "ckb")
+    g, plan = drv.build_graph_and_plan(cfg)
+    with pytest.raises(drv._SegmentStop):
+        drv._run_jax(cfg, g, plan, checkpoint_dir=ck_b,
+                     _stop_after_segments=1)
+    partial = ex.load_checkpoint(ck_b, cfg)
+    assert int(partial["meta_done"]) == 100
+    # ...then resume through the public entry point
+    out_b = str(tmp_path / "b")
+    resumed = ex.run_config(cfg, out_b, checkpoint_dir=ck_b)
+
+    for k in clean["history"]:
+        np.testing.assert_array_equal(clean["history"][k],
+                                      resumed["history"][k], err_msg=k)
+    np.testing.assert_array_equal(np.asarray(clean["state"].assignment),
+                                  np.asarray(resumed["state"].assignment))
+    np.testing.assert_allclose(clean["waits_all"], resumed["waits_all"])
+    np.testing.assert_array_equal(clean["part_sum"], resumed["part_sum"])
+
+
+def test_checkpoint_mismatch_and_stale_formats_ignored(tmp_path):
+    """Resume must never crash on, or silently reuse, incompatible
+    checkpoints: wrong config identity, old formats, too-long runs."""
+    ck = str(tmp_path / "ck")
+    cfg = ex.ExperimentConfig(family="frank", alignment=1, base=0.3,
+                              pop_tol=0.5, total_steps=120, n_chains=2)
+    data = ex.run_config(cfg, str(tmp_path / "o1"), checkpoint_dir=ck)
+    assert ex.load_checkpoint(ck, cfg) is not None
+
+    # different seed => identity mismatch => fresh start, not stale chains
+    cfg2 = ex.ExperimentConfig(family="frank", alignment=1, base=0.3,
+                               pop_tol=0.5, total_steps=120, n_chains=2,
+                               seed=9)
+    assert ex.load_checkpoint(ck, cfg2) is None
+    # shorter rerun than the checkpoint => ignored
+    cfg3 = ex.ExperimentConfig(family="frank", alignment=1, base=0.3,
+                               pop_tol=0.5, total_steps=60, n_chains=2)
+    assert ex.load_checkpoint(ck, cfg3) is None
+    # pre-versioned format (bare field names) => ignored, no KeyError
+    np.savez(os.path.join(ck, cfg.tag + ".npz"),
+             assignment=np.asarray(data["state"].assignment))
+    assert ex.load_checkpoint(ck, cfg) is None
+    run2 = ex.run_config(cfg, str(tmp_path / "o2"), checkpoint_dir=ck)
+    np.testing.assert_array_equal(np.asarray(run2["state"].assignment),
+                                  np.asarray(data["state"].assignment))
