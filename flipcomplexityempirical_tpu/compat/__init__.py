@@ -7,8 +7,9 @@ against, (b) the ``backend="python"`` path of the experiment driver, and
 Partition/MarkovChain."""
 
 from .partition import (
-    Partition, Tally, cut_edges, b_nodes_bi, b_nodes_pairs,
-    make_geom_wait, make_boundary_slope, step_num, bnodes_p,
+    Partition, Tally, Election, ElectionResults, cut_edges, b_nodes_bi,
+    b_nodes_pairs, make_geom_wait, make_boundary_slope, step_num, bnodes_p,
+    mean_median, efficiency_gap,
 )
 from .recom import make_recom, random_spanning_tree, bipartition_tree
 from .chain import (
@@ -22,7 +23,9 @@ from .chain import (
 )
 
 __all__ = [
-    "Partition", "Tally", "cut_edges", "b_nodes_bi", "b_nodes_pairs",
+    "Partition", "Tally", "Election", "ElectionResults",
+    "mean_median", "efficiency_gap",
+    "cut_edges", "b_nodes_bi", "b_nodes_pairs",
     "make_geom_wait", "make_boundary_slope", "step_num", "bnodes_p",
     "MarkovChain", "Validator", "within_percent_of_ideal_population",
     "single_flip_contiguous", "contiguous",

@@ -172,6 +172,104 @@ class Tally:
         return out
 
 
+class ElectionResults:
+    """Per-district vote totals for one election, with the gerrychain
+    score surface the reference imports (``mean_median``,
+    ``efficiency_gap`` at grid_chain_sec11.py:26-30) as methods. The
+    numeric conventions delegate to ``stats.partisan`` so the oracle and
+    the batched path share one definition."""
+
+    def __init__(self, name: str, parties: tuple, tallies: np.ndarray,
+                 districts: tuple = ()):
+        self.election = name
+        self.parties = tuple(parties)
+        self.tallies = np.asarray(tallies, dtype=np.int64)  # (K, P)
+        self.districts = tuple(districts)  # district label per tally row
+
+    def counts(self, party) -> tuple:
+        return tuple(self.tallies[:, self.parties.index(party)])
+
+    def percents(self, party) -> tuple:
+        from ..stats import partisan
+        return tuple(partisan._shares(self._party0_first(party)[None])[0])
+
+    def wins(self, party) -> int:
+        from ..stats import partisan
+        t = self._party0_first(party)
+        return int(partisan.seats_won(t[None])[0])
+
+    def mean_median(self) -> float:
+        from ..stats import partisan
+        return float(partisan.mean_median(self.tallies[None])[0])
+
+    def efficiency_gap(self) -> float:
+        from ..stats import partisan
+        return float(partisan.efficiency_gap(self.tallies[None])[0])
+
+    def _party0_first(self, party) -> np.ndarray:
+        j = self.parties.index(party)
+        order = [j] + [i for i in range(len(self.parties)) if i != j]
+        return self.tallies[:, order]
+
+
+class Election:
+    """gerrychain.updaters.Election('Pink-Purple', {'Pink': 'pink',
+    'Purple': 'purple'}) — the updater the reference wires (commented) at
+    grid_chain_sec11.py:307, over the Bernoulli(1/2) vote attributes of
+    lines 223-228. ``columns`` maps attribute name -> (N,) vote array
+    (graphs.votes.seed_votes provides the reference pair); tallies update
+    incrementally on single flips like Tally."""
+
+    def __init__(self, name: str, parties_to_columns: Dict[str, str],
+                 columns: Dict[str, np.ndarray]):
+        self.name = name
+        self.parties = tuple(parties_to_columns)
+        self.cols = [np.asarray(columns[attr], dtype=np.int64)
+                     for attr in parties_to_columns.values()]
+
+    def __call__(self, partition: Partition) -> ElectionResults:
+        """Tally rows are indexed by SORTED district label, so the signed
+        +1/-1 labels the reference loop uses (and 0..k-1 indices alike)
+        tally correctly — a raw label-as-row-index scheme would alias -1
+        onto the last row. All downstream scores are district-order
+        invariant."""
+        key = "_election_" + self.name
+        if partition.parent is not None and partition.flips and \
+                key in partition.parent._cache:
+            districts, ptallies = partition.parent._cache[key]
+            tallies = ptallies.copy()
+            row = {d: r for r, d in enumerate(districts)}
+            for lab in partition.flips:
+                i = partition.graph.index[lab]
+                old = int(partition.parent.assignment_array[i])
+                new = int(partition.assignment_array[i])
+                if old != new:
+                    for j, col in enumerate(self.cols):
+                        tallies[row[old], j] -= col[i]
+                        tallies[row[new], j] += col[i]
+        else:
+            a = partition.assignment_array
+            districts, inv = np.unique(a, return_inverse=True)
+            districts = tuple(int(d) for d in districts)
+            tallies = np.zeros((len(districts), len(self.cols)), np.int64)
+            for j, col in enumerate(self.cols):
+                np.add.at(tallies[:, j], inv, col)
+        partition._cache[key] = (districts, tallies)
+        return ElectionResults(self.name, self.parties, tallies,
+                               districts=districts)
+
+
+def mean_median(election_results: ElectionResults) -> float:
+    """gerrychain.scores surface (imported by the reference at
+    grid_chain_sec11.py:29)."""
+    return election_results.mean_median()
+
+
+def efficiency_gap(election_results: ElectionResults) -> float:
+    """gerrychain.scores surface (grid_chain_sec11.py:30)."""
+    return election_results.efficiency_gap()
+
+
 def b_nodes_bi(partition: Partition):
     """Boundary-node set: all endpoints of cut edges
     (grid_chain_sec11.py:155-156)."""

@@ -25,7 +25,8 @@ import numpy as np
 
 from .. import compat
 from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
-                      PARITY_LABELS)
+                      seed_votes, PARITY_LABELS)
+from ..stats import partisan
 from ..kernel.step import Spec, finalize_host
 from ..sampling import init_batch, run_chains
 from .artifacts import ARTIFACT_KINDS, render_all, render_start
@@ -63,6 +64,7 @@ def run_config(cfg: ExperimentConfig, outdir: str,
     else:
         raise ValueError(f"backend {cfg.backend!r}")
     data["seconds"] = time.time() - t0
+    data["partisan"] = _partisan_summary(cfg, g, data)
     render_all(g, cfg.family, outdir, cfg.tag,
                end_signed=data["end_signed"], cut_times=data["cut_times"],
                part_sum=data["part_sum"], num_flips=data["num_flips"],
@@ -141,6 +143,24 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         "history": history,
         "waits_all": waits_total,
         "state": s,
+    }
+
+
+def _partisan_summary(cfg: ExperimentConfig, g, data) -> dict:
+    """Election scores over the run's final plans, from the reference's
+    Bernoulli(1/2) pink/purple vote attributes (grid_chain_sec11.py:
+    223-228; Election wiring of line 307). Batched: every chain's final
+    plan is scored in one pass; the reference's single chain is row 0."""
+    votes = seed_votes(g, cfg.seed)
+    if data["state"] is not None:               # jax backend: (C, N) batch
+        assign = np.asarray(data["state"].assignment)
+    else:                                       # python backend: final plan
+        assign = (np.asarray(data["end_signed"]) < 0).astype(np.int64)[None]
+    tallies = partisan.district_vote_tallies(assign, votes, k=2)
+    return {
+        "mean_median": partisan.mean_median(tallies),
+        "efficiency_gap": partisan.efficiency_gap(tallies),
+        "seats_pink": partisan.seats_won(tallies),
     }
 
 

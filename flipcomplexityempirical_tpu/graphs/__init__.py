@@ -6,6 +6,7 @@ from .builders import (
 from .dualgraph import (
     GeoAttributes, from_geojson, from_shapefile, synthetic_precincts,
 )
+from .votes import seed_votes, PARTIES
 
 __all__ = [
     "LatticeGraph", "DeviceGraph", "build_lattice", "from_networkx",
@@ -14,4 +15,5 @@ __all__ = [
     "PARITY_LABELS",
     "GeoAttributes", "from_geojson", "from_shapefile",
     "synthetic_precincts",
+    "seed_votes", "PARTIES",
 ]

@@ -1,0 +1,24 @@
+"""Random vote attributes: the reference's pink/purple node columns.
+
+grid_chain_sec11.py:223-228 seeds every node with Bernoulli(1/2) party
+membership (``pink``/``purple``, exactly one of the two set to 1) for the
+commented-out ``Election("Pink-Purple", ...)`` updater (line 307). Here the
+columns are a dense (N, 2) array aligned with LatticeGraph node order, the
+shape ``stats.partisan`` consumes directly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .lattice import LatticeGraph
+
+PARTIES = ("pink", "purple")
+
+
+def seed_votes(graph: LatticeGraph, seed: int, p: float = 0.5) -> np.ndarray:
+    """(N, 2) int8: column 0 = pink, column 1 = purple; one vote per node
+    (the reference's one-person-one-party attribute pair)."""
+    rng = np.random.default_rng(seed)
+    pink = (rng.random(graph.n_nodes) < p).astype(np.int8)
+    return np.stack([pink, 1 - pink], axis=1)

@@ -19,10 +19,12 @@ fixed-shape array passes:
   state's incremental fields (a recom move touches O(N) nodes, so a full
   O(E) re-derive is the right cost model, unlike the O(deg) flip commit).
 
-A chain whose bipartition attempt finds no balanced tree edge keeps its
-current partition for that round (the host path's node_repeats retry
-becomes "retry next round": with batched chains, per-chain retry loops
-would straggle the whole batch).
+A chain whose bipartition finds no balanced tree edge draws fresh trees
+up to a total of ``tree_retries`` attempts inside the move (the bounded
+analogue of the host path's unbounded ``bipartition_tree`` retry), then
+keeps its current partition for the round — the bound keeps one unlucky
+chain from straggling the whole vmapped batch. ``tests/test_recom.py``
+compares the batched and host-oracle chains' stationary statistics.
 """
 
 from __future__ import annotations
@@ -166,7 +168,8 @@ def mark_subtree(dg: DeviceGraph, parent, depth, cut_child):
 
 
 def recom_move(dg: DeviceGraph, spec: Spec, state: ChainState,
-               epsilon: float = 0.05, pop_target=None, label_values=None):
+               epsilon: float = 0.05, pop_target=None, label_values=None,
+               tree_retries: int = 4):
     """One ReCom move for one chain (vmap over chains): merge the two
     districts straddling a random cut edge, tree-bipartition, commit if a
     balanced cut exists. Returns the new ChainState (unchanged assignment
@@ -177,12 +180,20 @@ def recom_move(dg: DeviceGraph, spec: Spec, state: ChainState,
     grid_chain_sec11.py:330-335); default = half the merged pair's total
     (exact only while district populations haven't drifted).
 
+    ``tree_retries`` is the TOTAL number of spanning-tree attempts per
+    move (1 = single draw, no re-draws) when no balanced edge exists —
+    the batched analogue of gerrychain's ``node_repeats``/retry loop (the
+    reference passes node_repeats=1, grid_chain_sec11.py:334; the host
+    oracle retries unboundedly inside ``bipartition_tree``). Bounded so
+    one unlucky chain cannot straggle the whole vmapped batch; a chain
+    that exhausts its attempts keeps its partition for the round.
+
     ``label_values`` (i32[K] district -> +1/-1 label, as in StepParams) is
     required to keep the reference part_sum/num_flips parity metrics
     consistent when interleaving recom with flip chains; None skips the
     settlement (fine when parity metrics are unused)."""
     n = dg.n_nodes
-    key, k_edge, k_tree, k_cut, k_wait = jax.random.split(state.key, 5)
+    key, k_edge, k_draw, k_wait = jax.random.split(state.key, 4)
     a = state.assignment.astype(jnp.int32)
 
     # 1. random cut edge -> merged district pair
@@ -193,23 +204,44 @@ def recom_move(dg: DeviceGraph, spec: Spec, state: ChainState,
     d1 = a[dg.edges[e_star, 0]]
     d2 = a[dg.edges[e_star, 1]]
     member = (a == d1) | (a == d2)
-
-    # 2. random spanning tree of the merged region
-    in_tree = spanning_forest(dg, member, k_tree)
     root = dg.edges[e_star, 0]
-    parent, depth = tree_structure(dg, in_tree, member, root)
 
-    # 3. balanced tree edge via masked Gumbel-max
-    sub = subtree_populations(dg, parent, depth)
-    total = sub[root]
-    target = total / 2.0 if pop_target is None else jnp.float32(pop_target)
-    lo, hi = target * (1 - epsilon), target * (1 + epsilon)
-    is_tree_child = (depth > 0)  # every non-root member cuts its parent edge
-    ok = is_tree_child & (sub >= lo) & (sub <= hi) \
-        & (total - sub >= lo) & (total - sub <= hi)
-    g = jax.random.gumbel(k_cut, (n,))
-    cut_child = jnp.argmax(jnp.where(ok, g, -jnp.inf))
-    found = ok.any() & any_cut
+    # 2+3. spanning tree -> balanced tree edge (masked Gumbel-max), with
+    # bounded tree re-draws when no tree edge balances
+    if pop_target is not None:
+        target_s = jnp.float32(pop_target)
+
+    def attempt(k):
+        k_tree, k_cut = jax.random.split(k)
+        in_tree = spanning_forest(dg, member, k_tree)
+        parent, depth = tree_structure(dg, in_tree, member, root)
+        sub = subtree_populations(dg, parent, depth)
+        total = sub[root]
+        target = total / 2.0 if pop_target is None else target_s
+        lo, hi = target * (1 - epsilon), target * (1 + epsilon)
+        is_tree_child = (depth > 0)  # every non-root member cuts its
+        ok = is_tree_child & (sub >= lo) & (sub <= hi) \
+            & (total - sub >= lo) & (total - sub <= hi)
+        g = jax.random.gumbel(k_cut, (n,))
+        cut_child = jnp.argmax(jnp.where(ok, g, -jnp.inf))
+        return parent, depth, cut_child, ok.any()
+
+    def retry_cond(carry):
+        k, _, _, _, ok, tries = carry
+        return (~ok) & (tries < tree_retries)
+
+    def retry_body(carry):
+        k, *_ , tries = carry
+        k, ka = jax.random.split(k)
+        parent, depth, cut_child, ok = attempt(ka)
+        return (k, parent, depth, cut_child, ok, tries + 1)
+
+    k0, ka = jax.random.split(k_draw)
+    parent, depth, cut_child, ok0 = attempt(ka)
+    _, parent, depth, cut_child, found_tree, _ = jax.lax.while_loop(
+        retry_cond, retry_body,
+        (k0, parent, depth, cut_child, ok0, jnp.int32(1)))
+    found = found_tree & any_cut
 
     # 4. commit: subtree -> d1, rest of merged region -> d2
     side = mark_subtree(dg, parent, depth, cut_child)

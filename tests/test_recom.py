@@ -285,3 +285,75 @@ def test_host_bipartition_infeasible_total_fast_none():
                                    np.random.default_rng(0),
                                    max_attempts=10**9)
     assert side is None
+
+
+def test_cross_backend_stationary_statistics():
+    """VERDICT item: host-oracle vs batched recom chains on the same tiny
+    graph must agree on stationary trajectory statistics (cut-count
+    distribution and balance occupancy), catching distribution divergence
+    between the unbounded host retry and the bounded in-kernel retry."""
+    from test_parity import ks_stat
+
+    lat = fce.graphs.square_grid(6, 6)
+    plan = fce.graphs.stripes_plan(lat, 2)
+    eps, steps, burn = 0.34, 400, 50
+
+    # host oracle chain (recom proposal, always accept)
+    rng = np.random.default_rng(11)
+    part = compat.Partition(
+        lat, plan, {"population": compat.Tally("population"),
+                    "cut_edges": compat.cut_edges})
+    proposal = compat.make_recom(rng, pop_target=lat.n_nodes / 2,
+                                 epsilon=eps, node_repeats=3)
+    host_cuts, host_p0 = [], []
+    for _ in range(steps):
+        part = proposal(part)
+        host_cuts.append(int(part.cut_edge_mask().sum()))
+        host_p0.append(int((part.assignment_array == 0).sum()))
+
+    # batched kernel chains
+    chains = 12
+    spec = fce.Spec(n_districts=2)
+    dg, st, params = fce.init_batch(lat, plan, n_chains=chains, seed=5,
+                                    spec=spec, base=1.0, pop_tol=eps)
+    move = jax.jit(jax.vmap(
+        lambda s: jrecom.recom_move(dg, spec, s, epsilon=eps,
+                                    pop_target=lat.n_nodes / 2)))
+    jcuts, jp0 = [], []
+    for _ in range(steps // 4):
+        st = move(st)
+        jcuts.append(np.asarray(st.cut_count))
+        jp0.append(np.asarray(st.dist_pop)[:, 0])
+    jcuts = np.stack(jcuts)[burn // 4:].ravel()
+    jp0 = np.stack(jp0)[burn // 4:].ravel()
+    host_cuts = np.asarray(host_cuts[burn:], float)
+    host_p0 = np.asarray(host_p0[burn:], float)
+
+    ks_c = ks_stat(host_cuts, jcuts.astype(float))
+    ks_p = ks_stat(host_p0, jp0.astype(float))
+    assert ks_c < 0.12, f"cut-count KS {ks_c:.3f}"
+    assert ks_p < 0.12, f"district-0 size KS {ks_p:.3f}"
+    assert abs(host_cuts.mean() - jcuts.mean()) / host_cuts.mean() < 0.05
+
+
+def test_tree_retries_recover_tight_epsilon():
+    """At a tight tolerance a single tree often has no balanced edge; the
+    bounded in-move retry must lift the per-move success rate well above
+    the single-attempt baseline."""
+    lat = fce.graphs.square_grid(6, 6)
+    plan = fce.graphs.stripes_plan(lat, 2)
+    spec = fce.Spec(n_districts=2)
+    eps = 0.06
+    rates = {}
+    for retries in (1, 6):
+        dg, st, params = fce.init_batch(lat, plan, n_chains=64, seed=9,
+                                        spec=spec, base=1.0, pop_tol=eps)
+        move = jax.jit(jax.vmap(
+            lambda s: jrecom.recom_move(dg, spec, s, epsilon=eps,
+                                        pop_target=lat.n_nodes / 2,
+                                        tree_retries=retries)))
+        for _ in range(6):
+            st = move(st)
+        rates[retries] = float(np.asarray(st.accept_count).mean()) / 6
+    assert rates[6] > rates[1], rates
+    assert rates[6] > 0.7, rates

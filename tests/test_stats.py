@@ -132,3 +132,105 @@ def test_kernel_history_integration():
     phi, r = stats.bottleneck_ratio(cuts)
     assert 0 < phi <= 1.0
     assert stats.gelman_rubin(cuts) < 1.5
+
+
+def test_seed_votes_reference_semantics():
+    """grid_chain_sec11.py:223-228: Bernoulli(1/2), exactly one of
+    pink/purple per node, deterministic under the seed."""
+    g = fce.graphs.square_grid(10, 10)
+    v1 = fce.graphs.seed_votes(g, seed=4)
+    v2 = fce.graphs.seed_votes(g, seed=4)
+    np.testing.assert_array_equal(v1, v2)
+    assert v1.shape == (100, 2)
+    assert (v1.sum(axis=1) == 1).all()
+    assert 20 < v1[:, 0].sum() < 80  # p=1/2, not degenerate
+    assert (fce.graphs.seed_votes(g, seed=5) != v1).any()
+
+
+def test_election_updater_through_chain_matches_batched_stats(rng):
+    """The compat Election updater (incremental) agrees with the batched
+    stats.partisan scoring on every yielded plan — the vote subsystem is
+    reachable end-to-end from a chain."""
+    from flipcomplexityempirical_tpu import compat
+
+    g = fce.graphs.square_grid(6, 6)
+    votes = fce.graphs.seed_votes(g, seed=7)
+    plan = fce.graphs.stripes_plan(g, 2)
+    nprng = np.random.default_rng(0)
+    elect = compat.Election(
+        "Pink-Purple", {"Pink": "pink", "Purple": "purple"},
+        columns={"pink": votes[:, 0], "purple": votes[:, 1]})
+    updaters = {"population": compat.Tally("population"),
+                "cut_edges": compat.cut_edges,
+                "b_nodes": compat.b_nodes_bi,
+                "base": lambda p: 1.0,
+                "Pink-Purple": elect}
+    part = compat.Partition(g, {lab: int(plan[i])
+                                for i, lab in enumerate(g.labels)}, updaters)
+    popbound = compat.within_percent_of_ideal_population(part, 0.5)
+    chain = compat.MarkovChain(
+        compat.make_reversible_propose_bi(nprng),
+        compat.Validator([compat.single_flip_contiguous, popbound]),
+        compat.make_cut_accept(nprng), part, 120)
+
+    assigns, mms, egs, wins = [], [], [], []
+    for p in chain:
+        r = p["Pink-Purple"]
+        # incremental tallies == recompute from scratch
+        fresh = compat.Election(
+            "X", {"Pink": "pink", "Purple": "purple"},
+            columns={"pink": votes[:, 0], "purple": votes[:, 1]})(
+                compat.Partition(g, p.assignment_array.copy(),
+                                 {}))
+        np.testing.assert_array_equal(r.tallies, fresh.tallies)
+        assigns.append(p.assignment_array.copy())
+        mms.append(compat.mean_median(r))
+        egs.append(compat.efficiency_gap(r))
+        wins.append(r.wins("Pink"))
+
+    tallies = stats.district_vote_tallies(np.stack(assigns), votes, k=2)
+    np.testing.assert_allclose(stats.mean_median(tallies), mms)
+    np.testing.assert_allclose(stats.efficiency_gap(tallies), egs)
+    np.testing.assert_array_equal(stats.seats_won(tallies), wins)
+
+
+def test_driver_emits_partisan_summary(tmp_path):
+    from flipcomplexityempirical_tpu import experiments as ex
+
+    cfg = ex.ExperimentConfig(family="frank", alignment=0, base=0.3,
+                              pop_tol=0.5, total_steps=120, n_chains=3)
+    data = ex.run_config(cfg, str(tmp_path / "p"))
+    ps = data["partisan"]
+    assert ps["mean_median"].shape == (3,)
+    assert ps["efficiency_gap"].shape == (3,)
+    assert set(np.asarray(ps["seats_pink"]).tolist()) <= {0, 1, 2}
+
+
+def test_election_with_signed_labels():
+    """The reference loop assigns districts +1/-1, not 0/1
+    (grid_chain_sec11.py:194-214): Election must tally those correctly
+    rather than aliasing label -1 onto a row index."""
+    from flipcomplexityempirical_tpu import compat
+
+    g = fce.graphs.square_grid(4, 4)
+    votes = fce.graphs.seed_votes(g, seed=3)
+    signed = np.where(np.arange(16) < 8, 1, -1)
+    el = compat.Election(
+        "PP", {"Pink": "pink", "Purple": "purple"},
+        columns={"pink": votes[:, 0], "purple": votes[:, 1]})
+    r = el(compat.Partition(g, signed, {}))
+    assert r.districts == (-1, 1)
+    np.testing.assert_array_equal(
+        r.tallies[0], votes[8:].sum(axis=0))
+    np.testing.assert_array_equal(
+        r.tallies[1], votes[:8].sum(axis=0))
+    # incremental path preserves the label->row map
+    part = compat.Partition(g, signed, {"PP": el})
+    part["PP"]
+    child = part.flip({g.labels[0]: -1})
+    r2 = el(child)
+    fresh = compat.Election(
+        "F", {"Pink": "pink", "Purple": "purple"},
+        columns={"pink": votes[:, 0], "purple": votes[:, 1]})(
+            compat.Partition(g, child.assignment_array.copy(), {}))
+    np.testing.assert_array_equal(r2.tallies, fresh.tallies)
