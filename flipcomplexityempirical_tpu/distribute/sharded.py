@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from ..graphs.lattice import DeviceGraph
+from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
 from ..state.chain_state import ChainState
@@ -32,6 +33,43 @@ def _params_spec(sharded: bool):
     return StepParams(log_base=p, beta=p, pop_lo=p, pop_hi=p,
                       label_values=P(), anneal_t0=P(), anneal_ramp=P(),
                       anneal_beta_max=P())
+
+
+def _even_odd_perms(n_dev: int):
+    perms = []
+    for parity in (0, 1):
+        perm = []
+        for i in range(n_dev):
+            j = i + 1 if i % 2 == parity else i - 1
+            if 0 <= j < n_dev:
+                perm.append((i, j))
+        perms.append(tuple(perm))
+    return perms
+
+
+def _swap_round(key, params, cut_count, parity, n_dev, perms):
+    """One even-odd replica-exchange round along the device axis: exchange
+    (cut_count, beta) with the ppermute neighbor, Metropolis-accept the
+    beta swap per chain slot from a shared replicated key, return the
+    updated params and the per-slot accept mask's sum."""
+    idx = jax.lax.axis_index(CHAINS_AXIS)
+    partner_exists = jnp.where(
+        idx % 2 == parity, idx + 1 < n_dev, idx - 1 >= 0)
+    cut = cut_count.astype(jnp.float32)
+    beta = params.beta
+    cut_p = jax.lax.ppermute(cut, CHAINS_AXIS, perms[parity])
+    beta_p = jax.lax.ppermute(beta, CHAINS_AXIS, perms[parity])
+    log_a = params.log_base * (beta - beta_p) * (cut - cut_p)
+    # shared uniform per unordered pair (pair id = lower device index),
+    # computed identically on both partners from the replicated key
+    pair_id = jnp.where(idx % 2 == parity, idx, idx - 1)
+    k = jax.random.fold_in(key, parity)
+    u = jax.vmap(lambda i: jax.random.uniform(
+        jax.random.fold_in(k, pair_id * beta.shape[0] + i)))(
+        jnp.arange(beta.shape[0]))
+    accept = partner_exists & (jnp.log(jnp.maximum(u, 1e-12)) < log_a)
+    new_beta = jnp.where(accept, beta_p, beta)
+    return params.replace(beta=new_beta), accept.sum()
 
 
 def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
@@ -51,14 +89,7 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
                          "beta, which the annealed kernel ignores")
     n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     paxes = StepParams.vmap_axes()
-    perms = []
-    for parity in (0, 1):
-        perm = []
-        for i in range(n_dev):
-            j = i + 1 if i % 2 == parity else i - 1
-            if 0 <= j < n_dev:
-                perm.append((i, j))
-        perms.append(tuple(perm))
+    perms = _even_odd_perms(n_dev)
 
     def local_advance(params, states):
         def body(states, _):
@@ -72,27 +103,6 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
         states, _ = jax.lax.scan(body, states, None, length=inner_steps)
         return states
 
-    def swap_round(key, params, states, parity):
-        """Exchange betas with the neighbor device (ladder = device axis)."""
-        idx = jax.lax.axis_index(CHAINS_AXIS)
-        partner_exists = jnp.where(
-            idx % 2 == parity, idx + 1 < n_dev, idx - 1 >= 0)
-        cut = states.cut_count.astype(jnp.float32)
-        beta = params.beta
-        cut_p = jax.lax.ppermute(cut, CHAINS_AXIS, perms[parity])
-        beta_p = jax.lax.ppermute(beta, CHAINS_AXIS, perms[parity])
-        log_a = params.log_base * (beta - beta_p) * (cut - cut_p)
-        # shared uniform per unordered pair (pair id = lower device index),
-        # computed identically on both partners from the replicated key
-        pair_id = jnp.where(idx % 2 == parity, idx, idx - 1)
-        k = jax.random.fold_in(key, parity)
-        u = jax.vmap(lambda i: jax.random.uniform(
-            jax.random.fold_in(k, pair_id * beta.shape[0] + i)))(
-            jnp.arange(beta.shape[0]))
-        accept = partner_exists & (jnp.log(jnp.maximum(u, 1e-12)) < log_a)
-        new_beta = jnp.where(accept, beta_p, beta)
-        return params.replace(beta=new_beta), accept.sum()
-
     pspec = _params_spec(sharded=True)
     state_spec = jax.tree.map(lambda _: P(CHAINS_AXIS), states_struct())
 
@@ -105,8 +115,52 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
         states = local_advance(params, states)
         swaps = jnp.int32(0)
         if exchange and n_dev > 1:
-            params, s0 = swap_round(key, params, states, 0)
-            params, s1 = swap_round(key, params, states, 1)
+            params, s0 = _swap_round(key, params, states.cut_count, 0,
+                                     n_dev, perms)
+            params, s1 = _swap_round(key, params, states.cut_count, 1,
+                                     n_dev, perms)
+            swaps = s0 + s1
+        info = {
+            "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),
+            "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
+        }
+        return params, states, info
+
+    return jax.jit(train_step)
+
+
+def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
+                          inner_steps: int, exchange: bool = True):
+    """The board fast path's sharded train step: advance every chain
+    ``inner_steps`` yields locally with the stencil kernel (zero
+    communication), then the same even-odd beta-exchange ladder along the
+    device axis as ``make_train_step``. This is the multi-chip form of the
+    headline benchmark workload."""
+    if exchange and spec.anneal != "none":
+        raise ValueError("replica exchange is incompatible with "
+                         "Spec.anneal != 'none'")
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    perms = _even_odd_perms(n_dev)
+    pspec = _params_spec(sharded=True)
+    state_spec = jax.tree.map(lambda _: P(CHAINS_AXIS),
+                              board_states_struct())
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), pspec, state_spec),
+        out_specs=(pspec, state_spec, P()),
+        check_vma=False)
+    def train_step(key, params, states):
+        states, _ = kboard.run_board_chunk(bg, spec, params, states,
+                                           inner_steps, collect=False)
+        swaps = jnp.int32(0)
+        if exchange and n_dev > 1:
+            # BoardState.cut_count is refreshed at record time, one
+            # transition behind after a chunk — recount so the swap
+            # Metropolis test sees the current energy
+            cuts = kboard.recount_cuts(bg, states.board)
+            params, s0 = _swap_round(key, params, cuts, 0, n_dev, perms)
+            params, s1 = _swap_round(key, params, cuts, 1, n_dev, perms)
             swaps = s0 + s1
         info = {
             "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),
@@ -124,3 +178,12 @@ def states_struct():
         b_count=0, cur_wait=0, cur_flip_node=0, t_yield=0, part_sum=0,
         last_flipped=0, num_flips=0, cut_times=0, waits_sum=0,
         move_clock=0, accept_count=0, tries_sum=0, exhausted_count=0)
+
+
+def board_states_struct():
+    """BoardState leaf placeholders for building PartitionSpec trees."""
+    return kboard.BoardState(
+        key=0, board=0, dist_pop=0, cut_count=0, cur_wait=0, wait_pending=0,
+        cur_flip=0, t_yield=0, move_clock=0, part_sum=0, last_flipped=0,
+        num_flips=0, cut_times_e=0, cut_times_s=0, waits_sum=0,
+        accept_count=0, tries_sum=0, exhausted_count=0)

@@ -278,10 +278,11 @@ def _run_python(cfg: ExperimentConfig, g, plan) -> dict:
 
 
 def _ckpt_identity(cfg: ExperimentConfig) -> str:
-    """Everything the tag does NOT encode but resume correctness needs."""
+    """Everything the tag does NOT encode (or encodes lossily — the tag
+    truncates base/pop_tol to int(100*x)) but resume correctness needs."""
     return (f"{cfg.family}|steps={cfg.total_steps}|chains={cfg.n_chains}|"
             f"seed={cfg.seed}|contiguity={cfg.contiguity}|"
-            f"accept={cfg.accept}")
+            f"accept={cfg.accept}|base={cfg.base!r}|pop={cfg.pop_tol!r}")
 
 
 def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,

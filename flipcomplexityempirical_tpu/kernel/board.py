@@ -208,6 +208,20 @@ def same_planes(bg: BoardGraph, board):
             sh(-1) & wk, sh(-w - 1) & wk, sh(-w), sh(-w + 1) & e]
 
 
+def recount_cuts(bg: BoardGraph, board) -> jnp.ndarray:
+    """i32[C] cut-edge count recomputed from the board. cut_count in
+    BoardState is refreshed at record time (before each transition), so
+    callers needing the CURRENT energy mid-loop — e.g. replica-exchange
+    acceptance — recount here."""
+    w = bg.w
+    south_ok = jnp.arange(bg.n) < (bg.h - 1) * bg.w
+    p = jnp.pad(board, ((0, 0), (0, w)), constant_values=-1)
+    cut_e = bg.east_ok[None] & (p[:, 1:1 + bg.n] != board)
+    cut_s = south_ok[None] & (p[:, w:w + bg.n] != board)
+    return (cut_e.sum(axis=1, dtype=jnp.int32)
+            + cut_s.sum(axis=1, dtype=jnp.int32))
+
+
 def ring_contig_ok(same):
     """The ring criterion (== patch_connected on plain rook grids; see
     module docstring). ok iff <=1 same-district rook neighbor, or all

@@ -354,3 +354,14 @@ def test_board_matches_general_path():
     psb = np.asarray(res_b.state.part_sum).mean(axis=0)
     corr_ps = np.corrcoef(psg, psb)[0, 1]
     assert corr_ps > 0.9, f"part_sum profile corr {corr_ps:.3f}"
+
+
+def test_recount_cuts_matches_recompute(rng):
+    g = fce.graphs.square_grid(7, 5)
+    bg = kb.make_board_graph(g)
+    boards = (rng.random((16, 35)) < 0.5).astype(np.int8)
+    got = np.asarray(kb.recount_cuts(bg, jnp.asarray(boards)))
+    b2 = boards.reshape(16, 7, 5)
+    want = ((b2[:, :, :-1] != b2[:, :, 1:]).sum((1, 2))
+            + (b2[:, :-1, :] != b2[:, 1:, :]).sum((1, 2)))
+    np.testing.assert_array_equal(got, want)
