@@ -1,0 +1,30 @@
+"""Reference-scale replication check (SURVEY.md section 4.4): run one
+100,000-step FRANK config end-to-end through the sweep driver and compare
+the wait.txt scalar against the reference's shipped ground truth
+(plots/FRANK/*wait.txt; tables in BASELINE.md / REPLICATION.md).
+
+The B30 cells are the tight regime: the reference's 12 cells all fall in
+[8.255e7, 8.451e7] (2.4% spread), so a single run is a sharp test. The
+asserted band is that spread widened by ~2% on each side for single-run
+sampling noise.
+"""
+
+import os
+
+import numpy as np
+
+from flipcomplexityempirical_tpu import experiments as ex
+
+
+def test_frank_b30_full_scale_wait_sum(tmp_path):
+    cfg = ex.ExperimentConfig(family="frank", alignment=2, base=0.3,
+                              pop_tol=0.5, total_steps=100_000, n_chains=2)
+    out = str(tmp_path / "rep")
+    data = ex.run_config(cfg, out)
+    wait = float(open(os.path.join(out, cfg.tag + "wait.txt")).read())
+    assert 8.0e7 < wait < 8.7e7, wait
+    # every chain in the batch lands in the same band
+    assert np.all(data["waits_all"] > 8.0e7)
+    assert np.all(data["waits_all"] < 8.7e7)
+    # yields accounted exactly: 100k cut-count records per chain
+    assert data["history"]["cut_count"].shape == (2, 100_000)
