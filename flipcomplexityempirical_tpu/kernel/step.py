@@ -56,6 +56,16 @@ class Spec:
                                   # geometric compactness target for real
                                   # precinct dual graphs (BASELINE config 5)
     max_tries: int = 256          # re-propose cap per step
+    propose_parallel: int = 1     # candidates drawn per re-propose round:
+                                  # the state is fixed across retries, so
+                                  # "first valid of K iid boundary draws"
+                                  # IS re-propose semantics, and K > 1
+                                  # makes the (batch-synchronized)
+                                  # while_loop fire only when all K miss
+                                  # (~p_invalid^K per chain-step). K=1 is
+                                  # best on CPU (throughput-bound); larger
+                                  # K trades duplicate draw work for fewer
+                                  # whole-batch loop iterations on TPU
     record_interface: bool = False  # slope/angle wall metrics
     parity_metrics: bool = True   # reference-exact accumulator quirks
     geom_waits: bool = True       # sample geometric waiting times
@@ -236,6 +246,27 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
         v, d_to, valid = draw(key)
         return v, d_to, valid, jnp.int32(1)
 
+    # round 1 (propose_parallel > 1): K iid candidates validated in
+    # parallel, first valid wins. Correctness: the state is constant
+    # across re-proposals, so this is exactly "re-propose until valid"
+    # with the loop unrolled K-wide; the while_loop below only fires when
+    # all K candidates are invalid. propose_parallel == 1 keeps the
+    # plain loop (single draw() instantiation, unchanged PRNG stream).
+    kp = spec.propose_parallel
+    if not 1 <= kp <= spec.max_tries:
+        raise ValueError(f"propose_parallel {kp} must be in "
+                         f"[1, max_tries={spec.max_tries}]")
+    if kp > 1:
+        key, kdraw = jax.random.split(key)
+        vs, d_tos, valids = jax.vmap(draw)(jax.random.split(kdraw, kp))
+        first = jnp.argmax(valids).astype(jnp.int32)
+        any_valid = valids.any()
+        init = (key, vs[first], d_tos[first], any_valid,
+                jnp.where(any_valid, first + 1, kp))
+    else:
+        init = (key, jnp.int32(0), jnp.int32(0), jnp.bool_(False),
+                jnp.int32(0))
+
     def cond(carry):
         _, _, _, valid, tries = carry
         return (~valid) & (tries < spec.max_tries)
@@ -246,7 +277,6 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
         v, d_to, valid = draw(kd)
         return key, v, d_to, valid, tries + 1
 
-    init = (key, jnp.int32(0), jnp.int32(0), jnp.bool_(False), jnp.int32(0))
     _, v, d_to, valid, tries = jax.lax.while_loop(cond, body, init)
     return v, d_to, valid, tries
 
