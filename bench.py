@@ -41,6 +41,11 @@ def main():
     ap.add_argument("--general", action="store_true",
                     help="force the general (gather) path even when the "
                          "board fast path supports the workload")
+    ap.add_argument("--pallas", action="store_true",
+                    help="route through the Pallas VMEM-resident kernel "
+                         "(kernel/pallas_board.py) instead of the XLA "
+                         "board path")
+    ap.add_argument("--block-chains", type=int, default=128)
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="wrap the timed region in a jax.profiler trace "
                          "written to DIR (SURVEY.md section 5 tracing)")
@@ -71,10 +76,17 @@ def main():
             g, plan, n_chains=args.chains, seed=0, spec=spec,
             base=args.base, pop_tol=args.pop_tol)
 
-        def run(states, n_steps):
-            return fce.sampling.run_board(
-                bg, spec, params, states, n_steps=n_steps,
-                record_history=False, chunk=args.chunk)
+        if args.pallas:
+            def run(states, n_steps):
+                return fce.sampling.run_board_pallas(
+                    bg, spec, params, states, n_steps=n_steps,
+                    record_history=False, chunk=args.chunk,
+                    block_chains=args.block_chains)
+        else:
+            def run(states, n_steps):
+                return fce.sampling.run_board(
+                    bg, spec, params, states, n_steps=n_steps,
+                    record_history=False, chunk=args.chunk)
     else:
         dg, states, params = fce.init_batch(
             g, plan, n_chains=args.chains, seed=0, spec=spec,
@@ -109,7 +121,8 @@ def main():
     s = res.host_state()
     meta = {
         "device": str(jax.devices()[0]),
-        "path": "board" if use_board else "general",
+        "path": ("pallas" if use_board and args.pallas
+                 else "board" if use_board else "general"),
         "chains": args.chains,
         "steps": args.steps,
         "grid": args.grid,

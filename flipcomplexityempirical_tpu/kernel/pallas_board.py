@@ -1,0 +1,442 @@
+"""Pallas TPU kernel for the board flip chain: chain-blocked, VMEM-resident.
+
+The XLA board kernel (kernel/board.py) streams every (C, N) plane through
+HBM once per step — ~0.6 ms/step at C=4096, bandwidth/ALU bound. This
+kernel removes the HBM round-trips: a block of ``block_chains`` chains
+stays resident in VMEM for a whole ``t_inner``-step chunk, so per-chunk
+HBM traffic is one board read + accumulator/log writes instead of
+per-step plane materialization.
+
+Design (per grid step = one chain block):
+
+- the board lives in the output ref (VMEM) and is updated in place across
+  ``t_inner`` sequential steps of a ``fori_loop``;
+- neighbor planes are ``pltpu.roll`` lane rotations of the flat (BC, N)
+  board with static existence masks (roll wrap-arounds land exactly on
+  masked-off positions — asserted against the XLA planes in tests);
+- proposal selection is "argmax of random bits masked to the valid set":
+  iid uint32 draws make the argmax uniform over valid nodes, which equals
+  re-propose-until-valid exactly (kernel/board.py module docstring); one
+  random plane + one row argmax replaces the two-level prefix selection;
+- per-chain gathers (district / degree / diff-degree at the selected
+  node) become ONE masked reduction of a packed code plane
+  (board*64 + deg*8 + diff_deg);
+- cut_times accumulates into int16 output refs (the runner folds them
+  into the int32 state, as the XLA chunk runner does);
+- the flip-bookkeeping log (pointer, sign) writes one (BC,) row per step;
+  ``kernel.board.apply_flip_log`` replays it outside, unchanged.
+
+RNG: ``pltpu.prng_random_bits`` seeded per (block, chunk). The interpret
+path (CPU tests) has no TPU PRNG, so ``host_rng=True`` reads the same
+bits from input refs instead — which also makes the whole chunk a
+deterministic function of known bits, letting tests assert BIT-EXACT
+equality against a pure-numpy simulator (test_pallas_board.py).
+
+Semantics are the board kernel's (record yield t, then transition), same
+quirk set as kernel/step.py; geometric waits use the literal ``n**k - 1``
+denominator (grid_chain_sec11.py:147-148). Districts are 2 with the
+reference's +1/-1 labels (sign = 1 - 2*district).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..graphs.lattice import LatticeGraph
+from .board import BoardGraph, BoardState, board_shape, supports as _board_supports
+from .step import Spec, StepParams
+
+
+def supports(graph: LatticeGraph, spec: Spec, params: StepParams,
+             n_chains: int, block_chains: int = 128) -> bool:
+    """The pallas path serves the benchmark family: everything the board
+    path supports, with reference +1/-1 labels and a block-divisible
+    batch."""
+    lv = np.asarray(params.label_values)
+    return (_board_supports(graph, spec)
+            and spec.accept == "cut"
+            and lv.shape == (2,) and lv[0] == 1 and lv[1] == -1
+            and n_chains % block_chains == 0)
+
+
+def _masks(h: int, w: int):
+    """Existence masks per ring direction, flat (N,). Roll wrap-arounds
+    land only on masked positions (see module docstring)."""
+    i = np.arange(h * w)
+    x, y = i // w, i % w
+    e = y < w - 1
+    wk = y > 0
+    s = x < h - 1
+    n = x > 0
+    return {
+        "e": e, "w": wk, "s": s, "n": n,
+        "se": s & e, "sw": s & wk, "ne": n & e, "nw": n & wk,
+    }
+
+
+def _u01(bits):
+    """uint32 -> f32 uniform in (0, 1): 24-bit mantissa, never 0."""
+    return (jnp.right_shift(bits, jnp.uint32(8)).astype(jnp.float32)
+            + 1.0) * jnp.float32(1.0 / 16777218.0)
+
+
+def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
+            # refs (order mirrors pallas_call wiring below)
+            seed_ref,
+            board_in, pop_ref, deg_ref, mask_refs,
+            dist_pop_in, scal_in, ints_in,
+            bits_plane_ref, bits_scal_ref,
+            # outputs
+            board_out, dist_pop_out, scal_out, ints_out,
+            log_f_ref, log_s_ref,
+            hist_cut_ref, hist_b_ref, hist_wait_ref, hist_acc_ref,
+            cut_e16_ref, cut_s16_ref):
+    n = h * w
+    bc = board_in.shape[0]
+    f32 = jnp.float32
+
+    if not host_rng:
+        pltpu.prng_seed(seed_ref[0])
+
+    board_out[:] = board_in[:]
+    cut_e16_ref[:] = jnp.zeros_like(cut_e16_ref)
+    cut_s16_ref[:] = jnp.zeros_like(cut_s16_ref)
+
+    m_e = mask_refs[0][:]      # (1, N) int8 each
+    m_w = mask_refs[1][:]
+    m_s = mask_refs[2][:]
+    m_n = mask_refs[3][:]
+    m_se = mask_refs[4][:]
+    m_sw = mask_refs[5][:]
+    m_ne = mask_refs[6][:]
+    m_nw = mask_refs[7][:]
+    pop = pop_ref[:]           # (1, N) int32
+    deg = deg_ref[:]           # (1, N) int32
+    code_plane = deg * 8       # + board*64 + diff_deg, built per step
+
+    # per-chain scalar params, (BC,) f32 / int32 rows
+    log_base = scal_in[0]
+    beta = scal_in[1]
+    pop_lo = scal_in[2]
+    pop_hi = scal_in[3]
+    denom = f32(float(n) ** 2 - 1.0)
+
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (bc, n), 1)
+
+    def step(t, carry):
+        (dp0, dp1, cur_wait, pending, cur_flip, cur_sign, tyield,
+         move_clock, acc_cnt, exh_cnt, waits_sum) = carry
+        board = board_out[:]                    # (BC, N) int8
+        b32 = board.astype(jnp.int32)
+
+        def rolled_same(shift, mask):
+            # value[i] = board[i + shift]  (pltpu.roll needs shift >= 0)
+            return jnp.where(
+                mask != 0,
+                (pltpu.roll(board, (-shift) % n, 1) == board), False)
+
+        s_e = rolled_same(1, m_e)
+        s_w = rolled_same(-1, m_w)
+        s_s = rolled_same(w, m_s)
+        s_n = rolled_same(-w, m_n)
+        s_se = rolled_same(w + 1, m_se)
+        s_sw = rolled_same(w - 1, m_sw)
+        s_ne = rolled_same(-w + 1, m_ne)
+        s_nw = rolled_same(-w - 1, m_nw)
+
+        same_deg = (s_e.astype(jnp.int32) + s_w + s_s + s_n)
+        diff_deg = deg - same_deg
+        b_mask = diff_deg > 0
+        cut_e = jnp.where(m_e != 0, ~s_e, False)
+        cut_s = jnp.where(m_s != 0, ~s_s, False)
+
+        if spec.contiguity == "patch":
+            # ring criterion: rook runs not linked through their diagonal
+            runs = ((s_e & ~(s_ne & s_n)).astype(jnp.int32)
+                    + (s_s & ~(s_se & s_e))
+                    + (s_w & ~(s_sw & s_s))
+                    + (s_n & ~(s_nw & s_w)))
+            contig = (same_deg <= 1) | (runs <= 1)
+        else:
+            contig = jnp.ones_like(b_mask)
+
+        popn = pop.astype(f32)
+        pop_of = jnp.where(board == 1, dp1[:, None], dp0[:, None])
+        pop_to = jnp.where(board == 1, dp0[:, None], dp1[:, None])
+        pop_ok = ((pop_of.astype(f32) - popn >= pop_lo[:, None])
+                  & (pop_to.astype(f32) + popn <= pop_hi[:, None]))
+        valid = b_mask & contig & pop_ok
+
+        b_count = b_mask.astype(jnp.int32).sum(axis=1)
+        cut_count = (cut_e.astype(jnp.int32).sum(axis=1)
+                     + cut_s.astype(jnp.int32).sum(axis=1))
+
+        # ---- complete the pending wait from this state's boundary count
+        if host_rng:
+            u_wait = _u01(bits_scal_ref[t, 0])
+        else:
+            u_wait = _u01(pltpu.bitcast(
+                pltpu.prng_random_bits((1, bc)), jnp.uint32)[0])
+        if spec.geom_waits:
+            p = b_count.astype(f32) / denom
+            wnew = jnp.maximum(
+                jnp.floor(jnp.log(jnp.maximum(u_wait, f32(1e-12)))
+                          / jnp.log1p(-p)), 0.0)
+            cur_wait = jnp.where(pending != 0, wnew, cur_wait)
+
+        # ---- record yield t
+        hist_cut_ref[t, :] = cut_count
+        hist_b_ref[t, :] = b_count
+        hist_wait_ref[t, :] = cur_wait
+        hist_acc_ref[t, :] = acc_cnt
+        log_f_ref[t, :] = cur_flip
+        log_s_ref[t, :] = cur_sign
+        cut_e16_ref[:] = cut_e16_ref[:] + cut_e.astype(jnp.int16)
+        cut_s16_ref[:] = cut_s16_ref[:] + cut_s.astype(jnp.int16)
+        waits_sum = waits_sum + cur_wait
+        tyield = tyield + 1
+
+        # ---- propose: argmax of random bits over the valid set
+        if host_rng:
+            sel_bits = bits_plane_ref[t]
+        else:
+            sel_bits = pltpu.bitcast(
+                pltpu.prng_random_bits((bc, n)), jnp.uint32)
+        score = jnp.where(valid, jnp.bitwise_or(sel_bits, jnp.uint32(1)),
+                          jnp.uint32(0))
+        idx = jnp.argmax(score, axis=1).astype(jnp.int32)
+        any_valid = score.max(axis=1) > 0
+
+        sel = iota_n == idx[:, None]
+        codes = code_plane + b32 * 64 + diff_deg
+        code_at = jnp.where(sel, codes, 0).sum(axis=1)
+        pop_at = jnp.where(sel, pop, 0).sum(axis=1)
+        d_from = code_at // 64
+        deg_at = (code_at // 8) % 8
+        dd_at = code_at % 8
+        dcut = deg_at - 2 * dd_at
+
+        if host_rng:
+            u_acc = _u01(bits_scal_ref[t, 1])
+        else:
+            u_acc = _u01(pltpu.bitcast(
+                pltpu.prng_random_bits((1, bc)), jnp.uint32)[0])
+        log_bound = (-beta * dcut.astype(f32) * log_base)
+        logu = jnp.log(jnp.maximum(u_acc, f32(1e-12)))
+        accept = any_valid & (logu < log_bound)
+
+        # ---- commit
+        d_to = 1 - d_from
+        board_out[:] = jnp.where(
+            sel & accept[:, None], d_to[:, None].astype(board.dtype), board)
+        popv = jnp.where(accept, pop_at, 0)
+        dp0 = dp0 + jnp.where(d_from == 0, -popv, popv)
+        dp1 = dp1 + jnp.where(d_from == 0, popv, -popv)
+        cur_flip = jnp.where(accept, idx, cur_flip)
+        cur_sign = jnp.where(accept, 1 - 2 * d_to, cur_sign)
+        pending = accept.astype(jnp.int32)
+        move_clock = move_clock + accept.astype(jnp.int32)
+        acc_cnt = acc_cnt + accept.astype(jnp.int32)
+        exh_cnt = exh_cnt + (~any_valid).astype(jnp.int32)
+        return (dp0, dp1, cur_wait, pending, cur_flip, cur_sign, tyield,
+                move_clock, acc_cnt, exh_cnt, waits_sum)
+
+    init = (dist_pop_in[0], dist_pop_in[1], scal_in[4],
+            ints_in[0], ints_in[1], ints_in[2], ints_in[3], ints_in[4],
+            ints_in[5], ints_in[6],
+            jnp.zeros_like(scal_in[4]))
+    out = jax.lax.fori_loop(0, t_inner, step, init)
+    (dp0, dp1, cur_wait, pending, cur_flip, cur_sign, tyield,
+     move_clock, acc_cnt, exh_cnt, waits_sum) = out
+    dist_pop_out[0] = dp0
+    dist_pop_out[1] = dp1
+    scal_out[0] = cur_wait
+    scal_out[1] = waits_sum
+    ints_out[0] = pending
+    ints_out[1] = cur_flip
+    ints_out[2] = cur_sign
+    ints_out[3] = tyield
+    ints_out[4] = move_clock
+    ints_out[5] = acc_cnt
+    ints_out[6] = exh_cnt
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("spec", "h", "w", "t_inner", "block_chains",
+                     "host_rng", "interpret"))
+def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
+                     block_chains: int,
+                     seeds, board, pop_plane, deg_plane, masks8,
+                     dist_pop, scal_in, ints_in, bits_plane, bits_scal,
+                     host_rng: bool = False, interpret: bool = False):
+    """One chunk: t_inner yields + transitions for all chains, blocked
+    over ``block_chains``-sized groups. Returns the kernel outputs; the
+    runner stitches them back into a BoardState."""
+    if t_inner > 32767:
+        raise ValueError("t_inner must be <= 32767 (int16 cut planes)")
+    c, n = board.shape
+    bc = block_chains
+    nb = c // bc
+    grid = (nb,)
+
+    def cdim(shape):  # block over the chains axis (axis 0)
+        return pl.BlockSpec((bc, *shape[1:]),
+                            lambda b: (b, *([0] * (len(shape) - 1))))
+
+    def rep(shape):   # replicated across blocks
+        return pl.BlockSpec(shape, lambda b: tuple([0] * len(shape)))
+
+    def tdim(shape):  # (T, ...) outputs, chains as the minor axis
+        return pl.BlockSpec(shape[:1] + (bc, *shape[2:]),
+                            lambda b: (0, b, *([0] * (len(shape) - 2))))
+
+    in_specs = [
+        pl.BlockSpec((1,), lambda b: (b,), memory_space=pltpu.SMEM),  # seed
+        cdim(board.shape),                       # board
+        rep(pop_plane.shape),                    # pop (1, N)
+        rep(deg_plane.shape),                    # deg (1, N)
+        *[rep(m.shape) for m in masks8],         # 8 masks (1, N)
+        pl.BlockSpec((2, bc), lambda b: (0, b)),  # dist_pop (2, C)
+        pl.BlockSpec((5, bc), lambda b: (0, b)),  # f32 scalars (5, C)
+        pl.BlockSpec((7, bc), lambda b: (0, b)),  # i32 counters (7, C)
+        (tdim(bits_plane.shape) if host_rng
+         else rep((1, 1))),                      # bits plane (T, C, N)
+        (pl.BlockSpec((t_inner, 2, bc), lambda b: (0, 0, b)) if host_rng
+         else rep((1, 1))),                      # bits scal (T, 2, C)
+    ]
+    out_shape = (
+        jax.ShapeDtypeStruct((c, n), jnp.int8),          # board
+        jax.ShapeDtypeStruct((2, c), jnp.int32),         # dist_pop
+        jax.ShapeDtypeStruct((2, c), jnp.float32),       # scalars out
+        jax.ShapeDtypeStruct((7, c), jnp.int32),         # counters out
+        jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # log_f
+        jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # log_s
+        jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # hist cut
+        jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # hist b
+        jax.ShapeDtypeStruct((t_inner, c), jnp.float32),  # hist wait
+        jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # hist accepts
+        jax.ShapeDtypeStruct((c, n), jnp.int16),         # cut_e16
+        jax.ShapeDtypeStruct((c, n), jnp.int16),         # cut_s16
+    )
+    out_specs = (
+        cdim((c, n)),
+        pl.BlockSpec((2, bc), lambda b: (0, b)),
+        pl.BlockSpec((2, bc), lambda b: (0, b)),
+        pl.BlockSpec((7, bc), lambda b: (0, b)),
+        tdim((t_inner, c)),
+        tdim((t_inner, c)),
+        tdim((t_inner, c)),
+        tdim((t_inner, c)),
+        tdim((t_inner, c)),
+        tdim((t_inner, c)),
+        cdim((c, n)),
+        cdim((c, n)),
+    )
+
+    if not host_rng:
+        bits_plane = jnp.zeros((1, 1), jnp.uint32)
+        bits_scal = jnp.zeros((1, 1), jnp.uint32)
+
+    def kern(seed_ref, board_in, pop_ref, deg_ref,
+             m0, m1, m2, m3, m4, m5, m6, m7,
+             dist_pop_in, scal_in_ref, ints_in_ref, bp_ref, bs_ref, *outs):
+        _kernel(spec, h, w, t_inner, host_rng,
+                seed_ref, board_in, pop_ref, deg_ref,
+                (m0, m1, m2, m3, m4, m5, m6, m7),
+                dist_pop_in, scal_in_ref, ints_in_ref, bp_ref, bs_ref,
+                *outs)
+
+    return pl.pallas_call(
+        kern, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret,
+    )(seeds, board, pop_plane, deg_plane, *masks8, dist_pop, scal_in,
+      ints_in, bits_plane, bits_scal)
+
+
+def make_static_inputs(bg: BoardGraph):
+    h, w = bg.h, bg.w
+    masks = _masks(h, w)
+    order = ("e", "w", "s", "n", "se", "sw", "ne", "nw")
+    masks8 = tuple(jnp.asarray(masks[k][None, :], jnp.int8) for k in order)
+    pop_plane = jnp.asarray(np.asarray(bg.pop)[None, :], jnp.int32)
+    deg_plane = jnp.asarray(np.asarray(bg.deg)[None, :], jnp.int32)
+    return pop_plane, deg_plane, masks8
+
+
+def pack_state(state: BoardState, params: StepParams):
+    """BoardState + params -> (dist_pop (2,C) i32, scalars (5,C) f32,
+    counters (7,C) i32)."""
+    dist_pop = jnp.stack([state.dist_pop[:, 0], state.dist_pop[:, 1]])
+    f32 = jnp.float32
+    scal = jnp.stack([
+        params.log_base.astype(f32), params.beta.astype(f32),
+        params.pop_lo.astype(f32), params.pop_hi.astype(f32),
+        state.cur_wait.astype(f32),
+    ])
+    i32 = jnp.int32
+    ints = jnp.stack([
+        state.wait_pending.astype(i32),
+        state.cur_flip.astype(i32),
+        _cur_sign(state).astype(i32),
+        state.t_yield.astype(i32),
+        state.move_clock.astype(i32),
+        state.accept_count.astype(i32),
+        state.exhausted_count.astype(i32),
+    ])
+    return dist_pop, scal, ints
+
+
+def _cur_sign(state: BoardState):
+    """Label of the current flip pointer's district (+1/-1); +1 when no
+    pointer yet (value unused while cur_flip < 0)."""
+    c = state.board.shape[0]
+    fi = jnp.maximum(state.cur_flip, 0)
+    d = state.board[jnp.arange(c), fi].astype(jnp.int32)
+    return 1 - 2 * d
+
+
+def unpack_state(state: BoardState, outs, t_inner: int) -> BoardState:
+    """Merge kernel outputs back into a BoardState (tries_sum counts one
+    draw per yield, as the board path does)."""
+    (board, dist_pop, scal, ints, log_f, log_s, h_cut, h_b, h_wait, h_acc,
+     cut_e16, cut_s16) = outs
+    return state.replace(
+        board=board,
+        dist_pop=jnp.stack([dist_pop[0], dist_pop[1]], axis=1),
+        cut_count=h_cut[t_inner - 1],  # refreshed at next record/epilogue
+        cur_wait=scal[0],
+        wait_pending=ints[0] > 0,
+        cur_flip=ints[1],
+        t_yield=ints[3],
+        move_clock=ints[4],
+        accept_count=ints[5],
+        exhausted_count=ints[6],
+        waits_sum=state.waits_sum + scal[1],
+        tries_sum=state.tries_sum + t_inner,
+        cut_times_e=state.cut_times_e + cut_e16,
+        cut_times_s=state.cut_times_s + cut_s16,
+    )
+
+
+def check(spec: Spec, params: StepParams, n_chains: int,
+          block_chains: int) -> None:
+    """Raise unless this kernel reproduces the requested semantics —
+    the Pallas path hardcodes the cut-Metropolis acceptance and the
+    reference +1/-1 labels, a strict subset of board.supports()."""
+    if spec.accept != "cut":
+        raise ValueError(f"pallas path requires accept='cut', "
+                         f"got {spec.accept!r}")
+    lv = np.asarray(params.label_values)
+    if lv.shape != (2,) or lv[0] != 1 or lv[1] != -1:
+        raise ValueError(f"pallas path requires label_values [1, -1], "
+                         f"got {lv.tolist()}")
+    if n_chains % block_chains:
+        raise ValueError(f"n_chains {n_chains} must be a multiple of "
+                         f"block_chains {block_chains}")

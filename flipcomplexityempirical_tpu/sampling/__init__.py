@@ -1,6 +1,7 @@
 from .runner import RunResult, run_chains, init_batch, pop_bounds
 from .board_runner import run_board, init_board
+from .pallas_runner import run_board_pallas
 from .recom import recom_move
 
 __all__ = ["RunResult", "run_chains", "init_batch", "pop_bounds",
-           "run_board", "init_board", "recom_move"]
+           "run_board", "init_board", "run_board_pallas", "recom_move"]

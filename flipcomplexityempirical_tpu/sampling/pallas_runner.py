@@ -1,0 +1,104 @@
+"""Runner for the Pallas board kernel: chunked VMEM-resident execution.
+
+Same contract as ``board_runner.run_board`` (RunResult, history keys, f64
+wait accumulation, record-final epilogue); per chunk the kernel returns
+its flip log and int16 cut planes, and the shared XLA pieces
+(``kernel.board.apply_flip_log``, ``kernel.board.record_final``) finish
+the bookkeeping. On TPU the kernel draws its own random bits
+(``pltpu.prng_*``), seeded per (block, chunk) from the run seed — an
+independent stream from the XLA paths, so cross-path comparisons are
+statistical (as with the oracle)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graphs.lattice import LatticeGraph
+from ..kernel import board as kboard
+from ..kernel import pallas_board as pboard
+from ..kernel.step import Spec, StepParams
+from .board_runner import init_board
+from .runner import RunResult, pick_chunk
+
+
+def run_board_pallas(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
+                     state: kboard.BoardState, n_steps: int,
+                     record_history: bool = True,
+                     chunk: Optional[int] = None,
+                     block_chains: int = 128,
+                     seed: int = 0,
+                     interpret: bool = False,
+                     _host_bits=None) -> RunResult:
+    """Run ``n_steps`` yields via the Pallas kernel. ``block_chains`` must
+    divide the batch; ``seed`` scopes the kernel's PRNG streams.
+
+    ``_host_bits(chunk_idx, t, c, n) -> (bits_plane, bits_scal)`` replaces
+    the in-kernel PRNG with caller-supplied uint32 bits — the interpret
+    (CPU) test path, where ``pltpu.prng_*`` is unavailable."""
+    c = state.board.shape[0]
+    pboard.check(spec, params, c, block_chains)
+    if chunk is None:
+        chunk = pick_chunk(n_steps, 512)
+    nb = c // block_chains
+    n = bg.n
+    pop_plane, deg_plane, masks8 = pboard.make_static_inputs(bg)
+    dummy_bits = jnp.zeros((1, 1), jnp.uint32)
+
+    hist_parts: dict = {}
+    waits_total = np.asarray(state.waits_sum, np.float64).copy()
+    state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+
+    done = 0
+    chunk_idx = 0
+    transitions = n_steps - 1
+    while done < transitions:
+        this = min(chunk, transitions - done)
+        # well-mixed independent per-(run, chunk, block) streams
+        seeds = jnp.asarray(
+            np.random.SeedSequence(entropy=(seed, chunk_idx))
+            .generate_state(nb).view(np.int32))
+        dist_pop, scal, ints = pboard.pack_state(state, params)
+        t0 = state.t_yield
+        if _host_bits is not None:
+            bits_plane, bits_scal = _host_bits(chunk_idx, this, c, n)
+            host_rng = True
+        else:
+            bits_plane = bits_scal = dummy_bits
+            host_rng = False
+        outs = pboard.run_pallas_chunk(
+            spec, bg.h, bg.w, this, block_chains, seeds, state.board,
+            pop_plane, deg_plane, masks8, dist_pop, scal, ints,
+            bits_plane, bits_scal, host_rng=host_rng, interpret=interpret)
+        state = pboard.unpack_state(state, outs, this)
+        if spec.parity_metrics:
+            ps, lf, nf = kboard.apply_flip_log(
+                state.part_sum, state.last_flipped, state.num_flips,
+                outs[4], outs[5], t0)
+            state = state.replace(part_sum=ps, last_flipped=lf,
+                                  num_flips=nf)
+        if record_history:
+            for k, v in zip(("cut_count", "b_count", "wait", "accepts"),
+                            outs[6:10]):
+                hist_parts.setdefault(k, []).append(np.asarray(v).T)
+        waits_total += np.asarray(state.waits_sum, np.float64)
+        state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+        done += this
+        chunk_idx += 1
+
+    # final yield through the shared XLA epilogue
+    state, out_last = kboard.record_final(bg, spec, params, state)
+    if record_history:
+        out_last = jax.tree.map(np.asarray, out_last)
+        for k, v in out_last.items():
+            hist_parts.setdefault(k, []).append(v[:, None])
+    waits_total += np.asarray(state.waits_sum, np.float64)
+    state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+
+    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+               if record_history else {})
+    return RunResult(state=state, history=history,
+                     waits_total=waits_total, n_yields=n_steps)

@@ -1,0 +1,335 @@
+"""Pallas board kernel verification (interpret mode on CPU).
+
+The kernel's host_rng mode reads its random bits from input refs, making a
+chunk a deterministic function of known bits — so the primary test is
+BIT-EXACT equality against a transparent simulator that replays the same
+per-step logic (numpy control flow; jnp float32 for the transcendental
+bits so the numerics match XLA's). On top: chain invariants (contiguity,
+population, derived-field consistency) and log replay through
+kernel.board.apply_flip_log.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.kernel import board as kb
+from flipcomplexityempirical_tpu.kernel import pallas_board as pb
+
+
+H, W = 8, 16
+N = H * W
+
+
+def _setup(chains=8, base=1.4, tol=0.3, seed=0):
+    g = fce.graphs.square_grid(H, W)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=seed, spec=spec, base=base,
+        pop_tol=tol)
+    return g, spec, bg, st, params
+
+
+def _bits(rng, t, c, n):
+    plane = rng.integers(0, 2**32, size=(t, c, n), dtype=np.uint32)
+    scal = rng.integers(0, 2**32, size=(t, 2, c), dtype=np.uint32)
+    return plane, scal
+
+
+def _u01(bits):
+    return np.asarray(
+        (jnp.right_shift(jnp.asarray(bits), jnp.uint32(8))
+         .astype(jnp.float32) + 1.0) * jnp.float32(1.0 / 16777218.0))
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def simulate(bg, spec, board0, dist_pop0, params, st, bits_plane,
+             bits_scal):
+    """Transparent replay of the kernel's per-step semantics."""
+    t_len, c, n = bits_plane.shape
+    h, w = bg.h, bg.w
+    board = np.asarray(board0, np.int8).copy()
+    dp = np.asarray(dist_pop0, np.int64).copy()        # (C, 2)
+    deg = np.asarray(bg.deg)
+    pop = np.asarray(bg.pop)
+    log_base = np.asarray(params.log_base, np.float32)
+    beta = np.asarray(params.beta, np.float32)
+    pop_lo = np.asarray(params.pop_lo, np.float32)
+    pop_hi = np.asarray(params.pop_hi, np.float32)
+    cur_wait = np.asarray(st.cur_wait, np.float32).copy()
+    pending = np.asarray(st.wait_pending).copy()
+    cur_flip = np.asarray(st.cur_flip).copy()
+    fi = np.maximum(cur_flip, 0)
+    cur_sign = 1 - 2 * board[np.arange(c), fi].astype(np.int64)
+    acc_cnt = np.asarray(st.accept_count).copy()
+    denom = np.float32(float(n) ** 2 - 1.0)
+
+    hist = {k: np.zeros((t_len, c), np.int64)
+            for k in ("cut", "b", "accepts")}
+    hist["wait"] = np.zeros((t_len, c), np.float32)
+    log_f = np.zeros((t_len, c), np.int64)
+    log_s = np.zeros((t_len, c), np.int64)
+    cut_e16 = np.zeros((c, n), np.int64)
+    cut_s16 = np.zeros((c, n), np.int64)
+    waits_sum = np.zeros(c, np.float32)
+
+    b2 = lambda a: a.reshape(c, h, w)
+    for t in range(t_len):
+        bb = b2(board)
+        same = {}
+        pad = np.pad(bb, ((0, 0), (1, 1), (1, 1)), constant_values=-1)
+        for name, (dx, dy) in dict(
+                e=(0, 1), w=(0, -1), s=(1, 0), n=(-1, 0),
+                se=(1, 1), sw=(1, -1), ne=(-1, 1), nw=(-1, -1)).items():
+            same[name] = (pad[:, 1 + dx:1 + dx + h, 1 + dy:1 + dy + w]
+                          == bb).reshape(c, n)
+        same_deg = sum(same[k].astype(np.int64) for k in "eswn")
+        diff_deg = deg[None] - same_deg
+        b_mask = diff_deg > 0
+        ys = np.arange(n) % w
+        cut_e = (ys < w - 1)[None] & ~same["e"]
+        cut_s = (np.arange(n) < (h - 1) * w)[None] & ~same["s"]
+        runs = ((same["e"] & ~(same["ne"] & same["n"])).astype(np.int64)
+                + (same["s"] & ~(same["se"] & same["e"]))
+                + (same["w"] & ~(same["sw"] & same["s"]))
+                + (same["n"] & ~(same["nw"] & same["w"])))
+        contig = (same_deg <= 1) | (runs <= 1)
+        pop_of = np.where(board == 1, dp[:, 1, None], dp[:, 0, None])
+        pop_to = np.where(board == 1, dp[:, 0, None], dp[:, 1, None])
+        pop_ok = ((pop_of - pop[None] >= pop_lo[:, None])
+                  & (pop_to + pop[None] <= pop_hi[:, None]))
+        valid = b_mask & contig & pop_ok
+        b_count = b_mask.sum(1)
+        cut_count = cut_e.sum(1) + cut_s.sum(1)
+
+        u_wait = _u01(bits_scal[t, 0])
+        p = np.asarray(_f32(b_count) / denom)
+        wnew = np.asarray(jnp.maximum(jnp.floor(
+            jnp.log(jnp.maximum(_f32(u_wait), 1e-12))
+            / jnp.log1p(-_f32(p))), 0.0))
+        cur_wait = np.where(pending, wnew, cur_wait).astype(np.float32)
+
+        hist["cut"][t] = cut_count
+        hist["b"][t] = b_count
+        hist["wait"][t] = cur_wait
+        hist["accepts"][t] = acc_cnt
+        log_f[t] = cur_flip
+        log_s[t] = cur_sign
+        cut_e16 += cut_e
+        cut_s16 += cut_s
+        waits_sum = np.asarray(_f32(waits_sum) + _f32(cur_wait))
+
+        score = np.where(valid, bits_plane[t] | np.uint32(1), 0)
+        idx = score.argmax(axis=1)
+        any_valid = score.max(axis=1) > 0
+        d_from = board[np.arange(c), idx].astype(np.int64)
+        dcut = deg[idx] - 2 * diff_deg[np.arange(c), idx]
+        u_acc = _u01(bits_scal[t, 1])
+        log_bound = np.asarray(
+            -_f32(beta) * _f32(dcut) * _f32(log_base))
+        logu = np.asarray(jnp.log(jnp.maximum(_f32(u_acc), 1e-12)))
+        accept = any_valid & (logu < log_bound)
+
+        d_to = 1 - d_from
+        sel = accept
+        board[np.arange(c)[sel], idx[sel]] = d_to[sel].astype(np.int8)
+        popv = np.where(sel, pop[idx], 0)
+        sgn = np.where(d_from == 0, 1, -1)
+        dp[:, 0] -= popv * sgn
+        dp[:, 1] += popv * sgn
+        cur_flip = np.where(sel, idx, cur_flip)
+        cur_sign = np.where(sel, 1 - 2 * d_to, cur_sign)
+        pending = sel.copy()
+        acc_cnt = acc_cnt + sel
+
+    return dict(board=board, dist_pop=dp, hist=hist, log_f=log_f,
+                log_s=log_s, cut_e16=cut_e16, cut_s16=cut_s16,
+                cur_wait=cur_wait, pending=pending, cur_flip=cur_flip,
+                acc_cnt=acc_cnt, waits_sum=waits_sum)
+
+
+def _run_kernel(spec, bg, st, params, bits_plane, bits_scal, bc=8):
+    t_len, c, n = bits_plane.shape
+    pop_plane, deg_plane, masks8 = pb.make_static_inputs(bg)
+    dist_pop, scal, ints = pb.pack_state(st, params)
+    seeds = jnp.zeros(c // bc, jnp.int32)
+    return pb.run_pallas_chunk(
+        spec, bg.h, bg.w, t_len, bc, seeds, st.board, pop_plane,
+        deg_plane, masks8, dist_pop, scal, ints, jnp.asarray(bits_plane),
+        jnp.asarray(bits_scal), host_rng=True, interpret=True)
+
+
+def test_kernel_bit_exact_vs_simulator(rng):
+    g, spec, bg, st, params = _setup(chains=16)
+    bits_plane, bits_scal = _bits(rng, 40, 16, N)
+    outs = _run_kernel(spec, bg, st, params, bits_plane, bits_scal, bc=8)
+    sim = simulate(bg, spec, st.board, st.dist_pop, params, st,
+                   bits_plane, bits_scal)
+
+    (board, dist_pop, scal, ints, log_f, log_s, h_cut, h_b, h_wait, h_acc,
+     cut_e16, cut_s16) = outs
+    np.testing.assert_array_equal(np.asarray(board), sim["board"])
+    np.testing.assert_array_equal(np.asarray(dist_pop).T, sim["dist_pop"])
+    np.testing.assert_array_equal(np.asarray(log_f), sim["log_f"])
+    np.testing.assert_array_equal(np.asarray(log_s), sim["log_s"])
+    np.testing.assert_array_equal(np.asarray(h_cut), sim["hist"]["cut"])
+    np.testing.assert_array_equal(np.asarray(h_b), sim["hist"]["b"])
+    np.testing.assert_array_equal(np.asarray(h_acc),
+                                  sim["hist"]["accepts"])
+    np.testing.assert_allclose(np.asarray(h_wait), sim["hist"]["wait"],
+                               rtol=0, atol=0)
+    np.testing.assert_array_equal(np.asarray(cut_e16), sim["cut_e16"])
+    np.testing.assert_array_equal(np.asarray(cut_s16), sim["cut_s16"])
+    np.testing.assert_array_equal(np.asarray(ints[1]), sim["cur_flip"])
+    np.testing.assert_array_equal(np.asarray(ints[5]), sim["acc_cnt"])
+    np.testing.assert_allclose(np.asarray(scal[1]), sim["waits_sum"])
+
+
+def test_kernel_invariants_and_log_replay(rng):
+    g, spec, bg, st, params = _setup(chains=8, tol=0.1)
+    bits_plane, bits_scal = _bits(rng, 60, 8, N)
+    outs = _run_kernel(spec, bg, st, params, bits_plane, bits_scal)
+    st2 = pb.unpack_state(st, outs, 60)
+    b = np.asarray(st2.board).reshape(-1, H, W)
+
+    from scipy.ndimage import label as cc_label
+    for c in range(b.shape[0]):
+        for d in (0, 1):
+            assert cc_label(b[c] == d)[1] == 1
+    ideal = N / 2
+    dp = np.asarray(st2.dist_pop)
+    assert (dp >= 0.9 * ideal - 1e-6).all() and (dp <= 1.1 * ideal).all()
+    assert (dp.sum(axis=1) == N).all()
+    accepts_hist = np.asarray(outs[9])
+    assert (np.asarray(st2.accept_count) >= accepts_hist[-1]).all()
+
+    # flip log replays through the shared apply_flip_log
+    log_f, log_s = outs[4], outs[5]
+    ps, lf, nf = kb.apply_flip_log(
+        st.part_sum, st.last_flipped, st.num_flips, log_f, log_s,
+        st.t_yield)
+    nf = np.asarray(nf)
+    first = (np.asarray(log_f) >= 0).argmax(axis=0)
+    active = (np.asarray(log_f) >= 0).any(axis=0)
+    expect = np.where(active, 60 - first, 0)
+    np.testing.assert_array_equal(nf.sum(axis=1), expect)
+
+
+def test_multi_block_grid_matches_single_block(rng):
+    """Blocking over chains is invisible: bc=4 (4 blocks) == bc=16."""
+    g, spec, bg, st, params = _setup(chains=16)
+    bits_plane, bits_scal = _bits(rng, 25, 16, N)
+    a = _run_kernel(spec, bg, st, params, bits_plane, bits_scal, bc=4)
+    b = _run_kernel(spec, bg, st, params, bits_plane, bits_scal, bc=16)
+    for xa, xb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_selection_is_uniform_over_valid():
+    """argmax of iid masked bits == uniform over the valid set (the
+    re-propose-until-valid equivalence this kernel relies on)."""
+    rng = np.random.default_rng(3)
+    n, draws = 24, 40000
+    valid = np.zeros(n, bool)
+    valid[[2, 5, 6, 11, 17, 23]] = True
+    bits = rng.integers(0, 2**32, size=(draws, n), dtype=np.uint32)
+    score = np.where(valid[None], bits | np.uint32(1), 0)
+    idx = score.argmax(axis=1)
+    counts = np.bincount(idx, minlength=n)
+    assert (counts[~valid] == 0).all()
+    expect = draws / valid.sum()
+    assert np.abs(counts[valid] - expect).max() < 5 * np.sqrt(expect)
+
+
+def test_simulator_matches_xla_board_distribution(rng):
+    """Transitive distribution check: the kernel is bit-exact to the
+    simulator (above), and the simulator's trajectory statistics match
+    the XLA board path — so kernel == board path in distribution."""
+    from test_parity import ks_stat
+
+    chains, steps, burn = 32, 2500, 400
+    g, spec, bg, st, params = _setup(chains=chains, base=1.3, tol=0.3)
+    bits_plane, bits_scal = _bits(rng, steps, chains, N)
+    sim = simulate(bg, spec, st.board, st.dist_pop, params, st,
+                   bits_plane, bits_scal)
+
+    bg2, st2, par2 = fce.sampling.init_board(
+        fce.graphs.square_grid(H, W), fce.graphs.stripes_plan(
+            fce.graphs.square_grid(H, W), 2),
+        n_chains=chains, seed=9, spec=spec, base=1.3, pop_tol=0.3)
+    res = fce.sampling.run_board(bg2, spec, par2, st2, n_steps=steps)
+
+    sub = slice(burn, None, 20)
+    for sim_key, xla_key, tol in (("cut", "cut_count", 0.08),
+                                  ("b", "b_count", 0.08)):
+        a = sim["hist"][sim_key][sub].ravel().astype(float)
+        b = res.history[xla_key][:, sub].ravel().astype(float)
+        ks = ks_stat(a, b)
+        assert ks < tol, f"{sim_key} KS {ks:.4f}"
+        assert abs(a.mean() - b.mean()) / b.mean() < 0.03, (
+            sim_key, a.mean(), b.mean())
+    # accept rates agree
+    aa = sim["acc_cnt"].mean() / steps
+    ab = np.asarray(res.state.accept_count).mean() / steps
+    assert abs(aa - ab) < 0.03, (aa, ab)
+
+
+def test_pallas_runner_end_to_end_interpret(rng):
+    """run_board_pallas's chunk stitching, t0-offset log replay, pending
+    wait handoff across chunks, waits draining, and record_final merge —
+    exercised with host-supplied bits in interpret mode, checked via the
+    same exact invariants the XLA board runner satisfies."""
+    chains, steps = 8, 121
+    g, spec, bg, st, params = _setup(chains=chains, tol=0.2)
+
+    def host_bits(chunk_idx, t, c, n):
+        r = np.random.default_rng(1000 + chunk_idx)
+        return (jnp.asarray(r.integers(0, 2**32, (t, c, n),
+                                       dtype=np.uint32)),
+                jnp.asarray(r.integers(0, 2**32, (t, 2, c),
+                                       dtype=np.uint32)))
+
+    res = fce.sampling.run_board_pallas(
+        bg, spec, params, st, n_steps=steps, chunk=40, block_chains=8,
+        interpret=True, _host_bits=host_bits)
+    s = jax.tree.map(np.asarray, res.state)
+
+    # history shapes and exact accumulator tie-outs
+    assert res.history["cut_count"].shape == (chains, steps)
+    cut_t = kb.edge_cut_times(g, res.state)
+    np.testing.assert_array_equal(cut_t.sum(axis=1),
+                                  res.history["cut_count"].sum(axis=1))
+    np.testing.assert_allclose(
+        res.waits_total, res.history["wait"].sum(axis=1, dtype=float),
+        rtol=1e-6)
+    first = (res.history["accepts"] > 0).argmax(axis=1)
+    expect = np.where(res.history["accepts"][:, -1] > 0, steps - first, 0)
+    np.testing.assert_array_equal(s.num_flips.sum(axis=1), expect)
+
+    # derived fields consistent; contiguity preserved through chunks
+    b = s.board.reshape(chains, H, W)
+    pop0 = (b == 0).sum(axis=(1, 2))
+    np.testing.assert_array_equal(s.dist_pop[:, 0], pop0)
+    from scipy.ndimage import label as cc_label
+    for c in range(chains):
+        for d in (0, 1):
+            assert cc_label(b[c] == d)[1] == 1
+    assert (s.t_yield == steps).all()
+
+
+def test_pallas_runner_validates_config():
+    g, spec, bg, st, params = _setup(chains=8)
+    with pytest.raises(ValueError):
+        fce.sampling.run_board_pallas(bg, spec, params, st, n_steps=10,
+                                      block_chains=3)
+    spec_bad = fce.Spec(contiguity="patch", accept="always")
+    with pytest.raises(ValueError):
+        fce.sampling.run_board_pallas(bg, spec_bad, params, st, n_steps=10,
+                                      block_chains=8)
