@@ -37,6 +37,9 @@ class ExperimentConfig:
     checkpoint_every: int = 0  # steps between mid-config checkpoints
                                # (0 = only at completion); resume picks up
                                # from the last saved segment
+    propose_parallel: int = 1  # kernel/step.py Spec.propose_parallel:
+                               # candidates per re-propose round (batch
+                               # accelerators benefit from >1)
 
     @property
     def tag(self) -> str:

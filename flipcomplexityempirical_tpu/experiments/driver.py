@@ -86,7 +86,8 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     ``_stop_after_segments`` simulates an interruption for tests."""
     spec = Spec(n_districts=2, proposal="bi", contiguity=cfg.contiguity,
                 invalid="repropose", accept=cfg.accept,
-                record_interface=True, parity_metrics=True, geom_waits=True)
+                record_interface=True, parity_metrics=True, geom_waits=True,
+                propose_parallel=cfg.propose_parallel)
     dg, states, params = init_batch(
         g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
         base=cfg.base, pop_tol=cfg.pop_tol)
@@ -282,7 +283,8 @@ def _ckpt_identity(cfg: ExperimentConfig) -> str:
     truncates base/pop_tol to int(100*x)) but resume correctness needs."""
     return (f"{cfg.family}|steps={cfg.total_steps}|chains={cfg.n_chains}|"
             f"seed={cfg.seed}|contiguity={cfg.contiguity}|"
-            f"accept={cfg.accept}|base={cfg.base!r}|pop={cfg.pop_tol!r}")
+            f"accept={cfg.accept}|base={cfg.base!r}|pop={cfg.pop_tol!r}|"
+            f"kp={cfg.propose_parallel}")
 
 
 def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,
