@@ -365,3 +365,21 @@ def test_recount_cuts_matches_recompute(rng):
     want = ((b2[:, :, :-1] != b2[:, :, 1:]).sum((1, 2))
             + (b2[:, :-1, :] != b2[:, 1:, :]).sum((1, 2)))
     np.testing.assert_array_equal(got, want)
+
+
+def test_empty_valid_set_self_loops_forever():
+    """pop_tol=0 with an exactly balanced plan makes every flip invalid:
+    the single masked draw must self-loop (exhausted), never commit."""
+    g = fce.graphs.square_grid(6, 6)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=4, seed=2, spec=spec, base=1.0, pop_tol=0.0)
+    res = fce.sampling.run_board(bg, spec, params, st, n_steps=51)
+    s = res.host_state()
+    np.testing.assert_array_equal(np.asarray(s.board),
+                                  np.broadcast_to(plan, (4, 36)))
+    assert (np.asarray(s.accept_count) == 0).all()
+    assert (np.asarray(s.exhausted_count) == 50).all()
+    # histories are constant at the initial values
+    assert (res.history["cut_count"] == res.history["cut_count"][:, :1]).all()
