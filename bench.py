@@ -57,6 +57,31 @@ def main():
                  f"warmup-1 must be >= chunk, so the warmup actually "
                  "compiles the chunk-length kernel the timed region reuses")
 
+    if not args.cpu:
+        # fail fast when the accelerator backend is unreachable (a hung
+        # device claim otherwise stalls the caller for its full timeout);
+        # probe in a subprocess so this process's backend stays untouched
+        import subprocess
+        err = b""
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, sys; "
+                 "sys.exit(jax.devices()[0].platform == 'cpu')"],
+                timeout=120, capture_output=True)
+            ok = probe.returncode == 0
+            err = probe.stderr
+        except subprocess.TimeoutExpired:
+            ok = False
+        if not ok:
+            tail = err.decode(errors="replace").strip().splitlines()[-3:]
+            for line in tail:
+                print(f"bench probe: {line}", file=sys.stderr)
+            print("bench: accelerator backend unreachable or fell back "
+                  "to CPU (device probe); rerun with --cpu for an "
+                  "explicit CPU measurement", file=sys.stderr)
+            sys.exit(3)
+
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
