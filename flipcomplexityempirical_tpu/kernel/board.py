@@ -163,8 +163,8 @@ def supports(graph: LatticeGraph, spec: Spec) -> bool:
         and spec.proposal == "bi"
         and spec.contiguity in ("patch", "none")
         and spec.invalid == "repropose"
-        and spec.accept in ("cut", "always")
-        and spec.anneal == "none"
+        and spec.accept in ("cut", "corrected", "always")
+        and spec.anneal in ("none", "linear")
         and not spec.frame_interface
         and not spec.weighted_cut
         and not spec.record_interface
@@ -365,8 +365,49 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     if spec.accept == "always":
         accept = any_valid
     else:
-        log_bound = (-params.beta * dcut.astype(jnp.float32)
-                     * params.log_base)
+        if spec.anneal == "linear":
+            # the reference's piecewise schedule on the accepted-move
+            # clock (kernel/step.py effective_beta)
+            t = (state.move_clock + 1).astype(jnp.float32)
+            beta = jnp.clip((t - params.anneal_t0) / params.anneal_ramp,
+                            0.0, params.anneal_beta_max)
+        else:
+            beta = params.beta
+        log_bound = (-beta * dcut.astype(jnp.float32) * params.log_base)
+        if spec.accept == "corrected":
+            # reversibility correction log(|b|/|b'|): the post-flip
+            # boundary count follows from v's local neighborhood —
+            # a neighbor u enters the boundary iff its only relation
+            # changed (same -> cut with diff_deg 0), leaves iff its only
+            # cut edge was to v; v itself leaves iff all neighbors
+            # differed (annealing_cut_accept_backwards's ratio,
+            # grid_chain_sec11.py:99; kernel/step.py accept='corrected')
+            diff_deg_p = planes["diff_deg"].astype(jnp.int32)
+            board_i = state.board.astype(jnp.int32)
+
+            def nbr_delta(off, ok_mask):
+                u = flat + off
+                exists = ok_mask[flat]
+                uc = jnp.clip(u, 0, n - 1)
+                same_u = board_i[cidx, uc] == d_from
+                dd_u = diff_deg_p[cidx, uc]
+                return jnp.where(
+                    exists,
+                    jnp.where(same_u & (dd_u == 0), 1,
+                              jnp.where(~same_u & (dd_u == 1), -1, 0)),
+                    0)
+
+            south_ok = jnp.arange(n) < (bg.h - 1) * bg.w
+            north_ok = jnp.arange(n) >= bg.w
+            delta = (nbr_delta(1, bg.east_ok)
+                     + nbr_delta(-1, bg.west_ok)
+                     + nbr_delta(w, south_ok)
+                     + nbr_delta(-w, north_ok))
+            b_new = (planes["b_count"] + delta
+                     - (dd == bg.deg[flat]).astype(jnp.int32))
+            log_bound = log_bound + (
+                jnp.log(planes["b_count"].astype(jnp.float32))
+                - jnp.log(jnp.maximum(b_new, 1).astype(jnp.float32)))
         logu = jnp.log(jnp.maximum(_uniform(kacc), jnp.float32(1e-12)))
         accept = any_valid & (logu < log_bound)
 

@@ -61,6 +61,7 @@ def supports(graph: LatticeGraph, spec: Spec, params: StepParams,
     lv = np.asarray(params.label_values)
     return (_board_supports(graph, spec)
             and spec.accept == "cut"
+            and spec.anneal == "none"
             and lv.shape == (2,) and lv[0] == 1 and lv[1] == -1
             and n_chains % block_chains == 0)
 
@@ -433,6 +434,9 @@ def check(spec: Spec, params: StepParams, n_chains: int,
     if spec.accept != "cut":
         raise ValueError(f"pallas path requires accept='cut', "
                          f"got {spec.accept!r}")
+    if spec.anneal != "none":
+        raise ValueError(f"pallas path requires anneal='none', "
+                         f"got {spec.anneal!r}")
     lv = np.asarray(params.label_values)
     if lv.shape != (2,) or lv[0] != 1 or lv[1] != -1:
         raise ValueError(f"pallas path requires label_values [1, -1], "

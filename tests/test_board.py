@@ -293,9 +293,9 @@ def test_supports_gates():
     assert not kb.supports(g, fce.Spec(contiguity="exact"))
     assert not kb.supports(g, fce.Spec(proposal="pair"))
     assert not kb.supports(g, fce.Spec(invalid="selfloop"))
-    assert not kb.supports(g, fce.Spec(accept="corrected"))
-    assert not kb.supports(g, fce.Spec(anneal="linear"))
     assert not kb.supports(g, fce.Spec(record_interface=True))
+    assert kb.supports(g, fce.Spec(accept="corrected"))
+    assert kb.supports(g, fce.Spec(anneal="linear"))
 
 
 # ---------------------------------------------------------------------------
@@ -383,3 +383,49 @@ def test_empty_valid_set_self_loops_forever():
     assert (np.asarray(s.exhausted_count) == 50).all()
     # histories are constant at the initial values
     assert (res.history["cut_count"] == res.history["cut_count"][:, :1]).all()
+
+
+@pytest.mark.parametrize("mode", ["corrected", "anneal"])
+def test_board_matches_general_path_extended_modes(mode):
+    """Corrected (reversibility-ratio) acceptance and the reference's
+    linear annealing schedule agree across paths."""
+    grid, chains, steps = 8, 48, 2501
+    g = fce.graphs.square_grid(grid, grid)
+    plan = fce.graphs.stripes_plan(g, 2)
+    if mode == "corrected":
+        spec = fce.Spec(contiguity="patch", accept="corrected")
+        kw = dict(base=1.4, pop_tol=0.2)
+        mk = dict()
+    else:
+        spec = fce.Spec(contiguity="patch", anneal="linear")
+        kw = dict(base=2.0, pop_tol=0.3)
+        mk = dict()
+
+    def params_for(p):
+        if mode == "anneal":
+            # schedule ramps within the run so the annealing is active
+            return p.replace(anneal_t0=jnp.float32(200.0),
+                             anneal_ramp=jnp.float32(400.0),
+                             anneal_beta_max=jnp.float32(2.0))
+        return p
+
+    dg, st_g, par_g = fce.init_batch(g, plan, n_chains=chains, seed=21,
+                                     spec=spec, **kw)
+    res_g = fce.run_chains(dg, spec, params_for(par_g), st_g,
+                           n_steps=steps)
+    bg, st_b, par_b = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=31, spec=spec, **kw)
+    res_b = fce.sampling.run_board(bg, spec, params_for(par_b), st_b,
+                                   n_steps=steps)
+
+    sub = slice(800, None, 25)
+    for key in ("cut_count", "b_count"):
+        a = res_g.history[key][:, sub].ravel().astype(float)
+        b = res_b.history[key][:, sub].ravel().astype(float)
+        ks = ks_stat(a, b)
+        assert ks < 0.08, f"{mode}/{key} KS {ks:.4f}"
+        assert abs(a.mean() - b.mean()) / a.mean() < 0.04, (
+            mode, key, a.mean(), b.mean())
+    aa = np.asarray(res_g.state.accept_count).mean()
+    ab = np.asarray(res_b.state.accept_count).mean()
+    assert abs(aa - ab) / aa < 0.06, (mode, aa, ab)
