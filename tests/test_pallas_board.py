@@ -248,12 +248,17 @@ def test_selection_is_uniform_over_valid():
     assert np.abs(counts[valid] - expect).max() < 5 * np.sqrt(expect)
 
 
-def test_simulator_matches_xla_board_distribution(rng):
+def test_simulator_matches_xla_board_distribution():
     """Transitive distribution check: the kernel is bit-exact to the
     simulator (above), and the simulator's trajectory statistics match
-    the XLA board path — so kernel == board path in distribution."""
+    the XLA board path — so kernel == board path in distribution.
+
+    Uses a local fixed rng (not the shared session fixture) so its
+    draws — and therefore the KS statistic — do not shift when other
+    tests are added or reordered."""
     from test_parity import ks_stat
 
+    rng = np.random.default_rng(42)
     chains, steps, burn = 32, 2500, 400
     g, spec, bg, st, params = _setup(chains=chains, base=1.3, tol=0.3)
     bits_plane, bits_scal = _bits(rng, steps, chains, N)
