@@ -208,16 +208,23 @@ def same_planes(bg: BoardGraph, board):
             sh(-1) & wk, sh(-w - 1) & wk, sh(-w), sh(-w + 1) & e]
 
 
+def cut_planes(bg: BoardGraph, board):
+    """(cut_e, cut_s) bool[C, N]: cut indicators for the east (i, i+1)
+    and south (i, i+W) edges of each node."""
+    w, n = bg.w, bg.n
+    south_ok = jnp.arange(n) < (bg.h - 1) * w
+    p = jnp.pad(board, ((0, 0), (0, w)), constant_values=-1)
+    cut_e = bg.east_ok[None] & (p[:, 1:1 + n] != board)
+    cut_s = south_ok[None] & (p[:, w:w + n] != board)
+    return cut_e, cut_s
+
+
 def recount_cuts(bg: BoardGraph, board) -> jnp.ndarray:
     """i32[C] cut-edge count recomputed from the board. cut_count in
     BoardState is refreshed at record time (before each transition), so
     callers needing the CURRENT energy mid-loop — e.g. replica-exchange
     acceptance — recount here."""
-    w = bg.w
-    south_ok = jnp.arange(bg.n) < (bg.h - 1) * bg.w
-    p = jnp.pad(board, ((0, 0), (0, w)), constant_values=-1)
-    cut_e = bg.east_ok[None] & (p[:, 1:1 + bg.n] != board)
-    cut_s = south_ok[None] & (p[:, w:w + bg.n] != board)
+    cut_e, cut_s = cut_planes(bg, board)
     return (cut_e.sum(axis=1, dtype=jnp.int32)
             + cut_s.sum(axis=1, dtype=jnp.int32))
 

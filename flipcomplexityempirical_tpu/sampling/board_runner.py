@@ -40,6 +40,28 @@ def init_board(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
     return bg, state, params
 
 
+def drain_waits(state, waits_total):
+    """Move the device f32 chunk-local wait sum into the host f64 total."""
+    waits_total += np.asarray(state.waits_sum, np.float64)
+    return state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+
+
+def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
+                       record_history, n_steps) -> RunResult:
+    """Shared run epilogue for the board-path runners: record the final
+    yield (no trailing transition), drain waits, assemble the RunResult."""
+    state, out_last = kboard.record_final(bg, spec, params, state)
+    if record_history:
+        out_last = jax.tree.map(np.asarray, out_last)
+        for k, v in out_last.items():
+            hist_parts.setdefault(k, []).append(v[:, None])
+    state = drain_waits(state, waits_total)
+    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+               if record_history else {})
+    return RunResult(state=state, history=history,
+                     waits_total=waits_total, n_yields=n_steps)
+
+
 def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
               state: kboard.BoardState, n_steps: int,
               record_history: bool = True,
@@ -49,7 +71,7 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
     if chunk is None:
         chunk = pick_chunk(n_steps, 2048)
 
-    hist_parts = {} if record_history else None
+    hist_parts: dict = {}
     waits_total = np.asarray(state.waits_sum, np.float64).copy()
     state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
 
@@ -63,20 +85,8 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
             outs = jax.tree.map(np.asarray, outs)
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (T, C) -> (C, T)
-        waits_total += np.asarray(state.waits_sum, np.float64)
-        state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+        state = drain_waits(state, waits_total)
         done += this
 
-    # final yield (no trailing transition)
-    state, out_last = kboard.record_final(bg, spec, params, state)
-    if record_history:
-        out_last = jax.tree.map(np.asarray, out_last)
-        for k, v in out_last.items():
-            hist_parts.setdefault(k, []).append(v[:, None])
-    waits_total += np.asarray(state.waits_sum, np.float64)
-    state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
-
-    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
-               if record_history else {})
-    return RunResult(state=state, history=history,
-                     waits_total=waits_total, n_yields=n_steps)
+    return finalize_board_run(bg, spec, params, state, hist_parts,
+                              waits_total, record_history, n_steps)

@@ -13,15 +13,13 @@ from __future__ import annotations
 
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..graphs.lattice import LatticeGraph
 from ..kernel import board as kboard
 from ..kernel import pallas_board as pboard
 from ..kernel.step import Spec, StepParams
-from .board_runner import init_board
+from .board_runner import drain_waits, finalize_board_run
 from .runner import RunResult, pick_chunk
 
 
@@ -84,21 +82,9 @@ def run_board_pallas(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
             for k, v in zip(("cut_count", "b_count", "wait", "accepts"),
                             outs[6:10]):
                 hist_parts.setdefault(k, []).append(np.asarray(v).T)
-        waits_total += np.asarray(state.waits_sum, np.float64)
-        state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+        state = drain_waits(state, waits_total)
         done += this
         chunk_idx += 1
 
-    # final yield through the shared XLA epilogue
-    state, out_last = kboard.record_final(bg, spec, params, state)
-    if record_history:
-        out_last = jax.tree.map(np.asarray, out_last)
-        for k, v in out_last.items():
-            hist_parts.setdefault(k, []).append(v[:, None])
-    waits_total += np.asarray(state.waits_sum, np.float64)
-    state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
-
-    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
-               if record_history else {})
-    return RunResult(state=state, history=history,
-                     waits_total=waits_total, n_yields=n_steps)
+    return finalize_board_run(bg, spec, params, state, hist_parts,
+                              waits_total, record_history, n_steps)
