@@ -338,3 +338,27 @@ def test_pallas_runner_validates_config():
     with pytest.raises(ValueError):
         fce.sampling.run_board_pallas(bg, spec_bad, params, st, n_steps=10,
                                       block_chains=8)
+
+
+def test_pallas_empty_valid_set_self_loops():
+    """pop_tol=0 with a balanced plan: every draw invalid, board frozen."""
+    g = fce.graphs.square_grid(H, W)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=8, seed=1, spec=spec, base=1.0, pop_tol=0.0)
+
+    def host_bits(chunk_idx, t, c, n):
+        r = np.random.default_rng(chunk_idx)
+        return (jnp.asarray(r.integers(0, 2**32, (t, c, n),
+                                       dtype=np.uint32)),
+                jnp.asarray(r.integers(0, 2**32, (t, 2, c),
+                                       dtype=np.uint32)))
+
+    res = fce.sampling.run_board_pallas(
+        bg, spec, params, st, n_steps=31, chunk=10, block_chains=8,
+        interpret=True, _host_bits=host_bits)
+    s = jax.tree.map(np.asarray, res.state)
+    np.testing.assert_array_equal(s.board, np.broadcast_to(plan, (8, N)))
+    assert (s.accept_count == 0).all()
+    assert (s.exhausted_count == 30).all()
