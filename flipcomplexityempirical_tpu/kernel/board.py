@@ -168,7 +168,7 @@ def supports(graph: LatticeGraph, spec: Spec) -> bool:
         and not spec.frame_interface
         and not spec.weighted_cut
         and not spec.record_interface
-        and not spec.record_assignment_bits
+        and (not spec.record_assignment_bits or graph.n_nodes <= 32)
     )
 
 
@@ -313,6 +313,13 @@ def _record(bg: BoardGraph, spec: Spec, params: StepParams,
         "wait": cur_wait,
         "accepts": state.accept_count,
     }
+    if spec.record_assignment_bits:
+        if bg.n > 32:
+            raise ValueError("record_assignment_bits needs n_nodes <= 32")
+        shifts = jnp.arange(bg.n, dtype=jnp.uint32)[None, :]
+        out["abits"] = jnp.sum(
+            state.board.astype(jnp.uint32) << shifts, axis=1,
+            dtype=jnp.uint32)
     ct_e16 = ct_e16 + planes["cut_e"].astype(jnp.int16)
     ct_s16 = ct_s16 + planes["cut_s"].astype(jnp.int16)
     waits_sum = state.waits_sum + cur_wait

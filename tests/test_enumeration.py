@@ -170,3 +170,36 @@ def test_corrected_accept_matches_reversible_target():
     emp /= emp.sum()
     tv = 0.5 * np.abs(emp - target).sum()
     assert tv < 0.06, f"TV distance {tv:.4f}"
+
+
+@pytest.mark.parametrize("base", [0.5, 2.0])
+def test_board_path_matches_exact_stationary(base):
+    """The board (stencil) fast path faces the same exact-enumeration bar
+    as the general kernel: empirical occupancy vs the power-iterated
+    stationary distribution of the specified transition matrix."""
+    g, nbrmask = build_masks()
+    states = enumerate_states(nbrmask)
+    P, cuts = build_transition(states, g, base)
+    pi = stationary(P)
+
+    spec = fce.Spec(contiguity="patch", record_assignment_bits=True,
+                    geom_waits=False, parity_metrics=False)
+    plan = fce.graphs.stripes_plan(g, 2)
+    chains, steps, burn = 48, 12000, 2000
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=13, spec=spec, base=base,
+        pop_tol=EPS)
+    res = fce.sampling.run_board(bg, spec, params, st, n_steps=steps)
+    abits = res.history["abits"][:, burn:].ravel()
+
+    index = {m: i for i, m in enumerate(states)}
+    idx = np.array([index[int(m)] for m in abits])  # KeyError => invalid
+    emp = np.bincount(idx, minlength=len(states)).astype(float)
+    emp /= emp.sum()
+
+    tv = 0.5 * np.abs(emp - pi).sum()
+    assert tv < 0.06, f"TV distance {tv:.4f} (|S|={len(states)})"
+    e_cut_exact = float((pi * cuts).sum())
+    e_cut_emp = float((emp * cuts).sum())
+    assert abs(e_cut_emp - e_cut_exact) / e_cut_exact < 0.02, \
+        (e_cut_emp, e_cut_exact)
