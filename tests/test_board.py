@@ -296,6 +296,11 @@ def test_supports_gates():
     assert not kb.supports(g, fce.Spec(record_interface=True))
     assert kb.supports(g, fce.Spec(accept="corrected"))
     assert kb.supports(g, fce.Spec(anneal="linear"))
+    # packed-assignment recording only fits graphs with <= 32 nodes
+    small = fce.graphs.square_grid(4, 8)
+    assert kb.supports(small, fce.Spec(record_assignment_bits=True))
+    assert not kb.supports(fce.graphs.square_grid(8, 8),
+                           fce.Spec(record_assignment_bits=True))
 
 
 # ---------------------------------------------------------------------------
