@@ -223,3 +223,44 @@ def test_frame_interface_constraint_holds():
     for c in range(s.assignment.shape[0]):
         vals = np.unique(np.asarray(s.assignment)[c][frame])
         assert len(vals) == 2
+
+
+def test_invariants_pair_k8():
+    """BASELINE config 2 at k=8: districts all alive, connected, balanced."""
+    spec = fce.Spec(n_districts=8, proposal="pair", contiguity="patch")
+    g, dg, res = run_small(spec, n=12, k=8, steps=300, tol=0.5, base=1.0)
+    s = res.host_state()
+    check_invariants(dg, s, 8)
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    ideal = g.n_nodes / 8
+    for c in range(s.assignment.shape[0]):
+        a = np.asarray(s.assignment[c])
+        for d in range(8):
+            nodes = np.nonzero(a == d)[0].tolist()
+            assert nodes, f"district {d} vanished in chain {c}"
+            assert nx.is_connected(gx.subgraph(nodes))
+            assert 0.5 * ideal <= len(nodes) <= 1.5 * ideal
+
+
+@pytest.mark.parametrize("make", [
+    lambda: fce.graphs.triangular_lattice(5, 8),
+    lambda: fce.graphs.hex_lattice(3, 3),
+])
+def test_chain_runs_on_non_grid_lattices(make):
+    """BASELINE config 3: flip walks on triangular/hex adjacency keep the
+    districts connected (hex uses patch radius 3)."""
+    g = make()
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    dg, st, params = fce.init_batch(g, plan, n_chains=8, seed=4, spec=spec,
+                                    base=1.0, pop_tol=0.5)
+    res = fce.run_chains(dg, spec, params, st, n_steps=300)
+    s = res.host_state()
+    check_invariants(dg, s, 2)
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    for c in range(s.assignment.shape[0]):
+        a = np.asarray(s.assignment[c])
+        for d in (0, 1):
+            nodes = np.nonzero(a == d)[0].tolist()
+            assert nodes and nx.is_connected(gx.subgraph(nodes))
+    assert int(np.asarray(s.accept_count).sum()) > 0
