@@ -22,6 +22,20 @@ def run_small(spec, n=8, k=2, steps=400, chains=8, base=0.8, tol=0.3, seed=0):
     return g, dg, res
 
 
+def assert_districts_connected(g, s, k, lo=None, hi=None):
+    """Every chain's districts are alive, connected, and (optionally)
+    size-bounded."""
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    for c in range(s.assignment.shape[0]):
+        a = np.asarray(s.assignment[c])
+        for d in range(k):
+            nodes = np.nonzero(a == d)[0].tolist()
+            assert nodes, f"district {d} vanished in chain {c}"
+            assert nx.is_connected(gx.subgraph(nodes))
+            if lo is not None:
+                assert lo <= len(nodes) <= hi, (d, len(nodes))
+
+
 def check_invariants(dg, s, k):
     c = s.assignment.shape[0]
     cut, cdeg, dpop, cc, bc = jax.vmap(lambda a: derive(dg, a, k))(
@@ -45,14 +59,7 @@ def test_invariants_pair_k4():
     g, dg, res = run_small(spec, n=10, k=4, steps=300, tol=0.5)
     s = res.host_state()
     check_invariants(dg, s, 4)
-    # all 4 districts alive and connected in every chain
-    gx = nx.Graph(list(map(tuple, g.edges)))
-    for c in range(s.assignment.shape[0]):
-        a = np.asarray(s.assignment[c])
-        for d in range(4):
-            nodes = np.nonzero(a == d)[0].tolist()
-            assert nodes, f"district {d} vanished in chain {c}"
-            assert nx.is_connected(gx.subgraph(nodes))
+    assert_districts_connected(g, s, 4)
 
 
 def test_districts_stay_connected_and_balanced():
@@ -60,14 +67,9 @@ def test_districts_stay_connected_and_balanced():
     tol = 0.1
     g, dg, res = run_small(spec, n=8, steps=600, tol=tol, base=1.0)
     s = res.host_state()
-    gx = nx.Graph(list(map(tuple, g.edges)))
     ideal = g.n_nodes / 2
-    for c in range(s.assignment.shape[0]):
-        a = np.asarray(s.assignment[c])
-        for d in (0, 1):
-            nodes = np.nonzero(a == d)[0].tolist()
-            assert nx.is_connected(gx.subgraph(nodes))
-            assert (1 - tol) * ideal <= len(nodes) <= (1 + tol) * ideal
+    assert_districts_connected(g, s, 2, lo=(1 - tol) * ideal,
+                               hi=(1 + tol) * ideal)
 
 
 def test_accept_always_moves_every_step():
@@ -231,15 +233,8 @@ def test_invariants_pair_k8():
     g, dg, res = run_small(spec, n=12, k=8, steps=300, tol=0.5, base=1.0)
     s = res.host_state()
     check_invariants(dg, s, 8)
-    gx = nx.Graph(list(map(tuple, g.edges)))
     ideal = g.n_nodes / 8
-    for c in range(s.assignment.shape[0]):
-        a = np.asarray(s.assignment[c])
-        for d in range(8):
-            nodes = np.nonzero(a == d)[0].tolist()
-            assert nodes, f"district {d} vanished in chain {c}"
-            assert nx.is_connected(gx.subgraph(nodes))
-            assert 0.5 * ideal <= len(nodes) <= 1.5 * ideal
+    assert_districts_connected(g, s, 8, lo=0.5 * ideal, hi=1.5 * ideal)
 
 
 @pytest.mark.parametrize("make", [
@@ -257,10 +252,5 @@ def test_chain_runs_on_non_grid_lattices(make):
     res = fce.run_chains(dg, spec, params, st, n_steps=300)
     s = res.host_state()
     check_invariants(dg, s, 2)
-    gx = nx.Graph(list(map(tuple, g.edges)))
-    for c in range(s.assignment.shape[0]):
-        a = np.asarray(s.assignment[c])
-        for d in (0, 1):
-            nodes = np.nonzero(a == d)[0].tolist()
-            assert nodes and nx.is_connected(gx.subgraph(nodes))
+    assert_districts_connected(g, s, 2)
     assert int(np.asarray(s.accept_count).sum()) > 0
