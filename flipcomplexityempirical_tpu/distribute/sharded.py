@@ -184,6 +184,7 @@ def board_states_struct():
     """BoardState leaf placeholders for building PartitionSpec trees."""
     return kboard.BoardState(
         key=0, board=0, dist_pop=0, cut_count=0, cur_wait=0, wait_pending=0,
-        cur_flip=0, t_yield=0, move_clock=0, part_sum=0, last_flipped=0,
+        cur_flip=0, cur_sign=0, t_yield=0, move_clock=0, part_sum=0,
+        last_flipped=0,
         num_flips=0, cut_times_e=0, cut_times_s=0, waits_sum=0,
         accept_count=0, tries_sum=0, exhausted_count=0)

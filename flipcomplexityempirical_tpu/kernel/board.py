@@ -112,6 +112,10 @@ class BoardState:
     cur_wait: jnp.ndarray      # f32[C] memoized geometric wait
     wait_pending: jnp.ndarray  # bool[C] accepted move awaits its wait sample
     cur_flip: jnp.ndarray      # int32[C] flat node of last accepted flip; -1
+    cur_sign: jnp.ndarray      # int32[C] label of cur_flip's district (the
+                               # board never changes under cur_flip between
+                               # accepts, so carrying the label at accept
+                               # time replaces a per-record board gather)
     t_yield: jnp.ndarray       # int32[C]
     move_clock: jnp.ndarray    # int32[C] accepted moves (reference step_num)
     part_sum: jnp.ndarray      # int32[C, N]
@@ -306,7 +310,6 @@ def _record(bg: BoardGraph, spec: Spec, params: StepParams,
     """The measurement yield (grid_chain_sec11.py:366-402), batched.
     Bookkeeping for part_sum/last_flipped/num_flips is deferred: this
     emits the (flip pointer, sign) log row instead."""
-    c = state.board.shape[0]
     out = {
         "cut_count": planes["cut_count"],
         "b_count": planes["b_count"],
@@ -324,11 +327,7 @@ def _record(bg: BoardGraph, spec: Spec, params: StepParams,
     ct_s16 = ct_s16 + planes["cut_s"].astype(jnp.int16)
     waits_sum = state.waits_sum + cur_wait
 
-    f = state.cur_flip
-    fi = jnp.maximum(f, 0)
-    sign = params.label_values[
-        state.board[jnp.arange(c), fi].astype(jnp.int32)]
-    log = {"f": f, "s": sign.astype(jnp.int32)}
+    log = {"f": state.cur_flip, "s": state.cur_sign}
 
     state = state.replace(
         cur_wait=cur_wait, wait_pending=jnp.zeros_like(state.wait_pending),
@@ -346,13 +345,22 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     cidx = jnp.arange(c)
     valid = planes["valid"]
 
-    # two-level prefix selection of the (m+1)-th valid cell. Row counts
-    # ride the MXU: (C, N) x (N, H) block matmul in bf16 (counts <= W
-    # stay exact) instead of reshaping to (C, H, W), whose tiled layout
-    # forces a full-plane copy on TPU.
+    # Two-level prefix selection of the (m+1)-th valid cell (row-major
+    # order), with BOTH levels on the MXU so the hot loop has no big
+    # gather and no big cumsum:
+    #   1. rowcnt[c, x] = valid @ block-indicator  (bf16, counts <= W
+    #      exact), tiny (C, H) cumsum picks the row;
+    #   2. vrow[c, y]  = (valid & onehot-row) @ column-indicator — with
+    #      exactly one row unmasked the column sums ARE that row's cells,
+    #      so this doubles as the row extraction. (jnp.take_along_axis
+    #      here lowered to a kCustom gather that ran ~3 ms/step; a flat
+    #      (C, N) cumsum lowered to ~0.9 ms of reduce-window passes.)
     block = (jnp.arange(n)[:, None] // w
-              == jnp.arange(h)[None, :]).astype(jnp.bfloat16)
-    rowcnt = jnp.dot(valid.astype(jnp.bfloat16), block,
+             == jnp.arange(h)[None, :]).astype(jnp.bfloat16)
+    colsel = (jnp.arange(n)[:, None] % w
+              == jnp.arange(w)[None, :]).astype(jnp.bfloat16)
+    valid_bf = valid.astype(jnp.bfloat16)
+    rowcnt = jnp.dot(valid_bf, block,
                      preferred_element_type=jnp.float32).astype(jnp.int32)
     rowcum = jnp.cumsum(rowcnt, axis=1)                    # (C, H)
     total = rowcum[:, -1]                                  # (C,)
@@ -364,8 +372,9 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     before = jnp.where(row > 0,
                        rowcum[cidx, jnp.maximum(row - 1, 0)], 0)
     m_in_row = m - before
-    row_cols = row[:, None] * w + jnp.arange(w)[None, :]
-    vrow = jnp.take_along_axis(valid, row_cols, axis=1)    # (C, W)
+    rowmask = ((jnp.arange(n) // w)[None, :] == row[:, None])
+    vrow = jnp.dot(jnp.where(rowmask, valid_bf, jnp.bfloat16(0)), colsel,
+                   preferred_element_type=jnp.float32) > 0.5   # (C, W)
     colcum = jnp.cumsum(vrow.astype(jnp.int32), axis=1)
     col = jnp.argmax(colcum > m_in_row[:, None], axis=1).astype(jnp.int32)
     flat = row * w + col
@@ -441,6 +450,8 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
         # cut_count is refreshed from recomputed planes at every record —
         # the single maintenance path
         cur_flip=jnp.where(accept, flat, state.cur_flip),
+        cur_sign=jnp.where(accept, params.label_values[d_to],
+                           state.cur_sign),
         wait_pending=accept,
         move_clock=state.move_clock + accept.astype(jnp.int32),
         accept_count=state.accept_count + accept.astype(jnp.int32),
@@ -624,6 +635,7 @@ def init_board_state(graph: LatticeGraph, bg: BoardGraph,
         # pending mechanism, matching init_state's sample_initial_wait
         wait_pending=jnp.full(n_chains, bool(spec.geom_waits)),
         cur_flip=jnp.full(n_chains, -1, jnp.int32),
+        cur_sign=jnp.zeros(n_chains, jnp.int32),
         t_yield=jnp.zeros(n_chains, jnp.int32),
         move_clock=jnp.zeros(n_chains, jnp.int32),
         part_sum=jnp.broadcast_to(jnp.asarray(part0), (n_chains, n)),

@@ -385,22 +385,13 @@ def pack_state(state: BoardState, params: StepParams):
     ints = jnp.stack([
         state.wait_pending.astype(i32),
         state.cur_flip.astype(i32),
-        _cur_sign(state).astype(i32),
+        state.cur_sign.astype(i32),
         state.t_yield.astype(i32),
         state.move_clock.astype(i32),
         state.accept_count.astype(i32),
         state.exhausted_count.astype(i32),
     ])
     return dist_pop, scal, ints
-
-
-def _cur_sign(state: BoardState):
-    """Label of the current flip pointer's district (+1/-1); +1 when no
-    pointer yet (value unused while cur_flip < 0)."""
-    c = state.board.shape[0]
-    fi = jnp.maximum(state.cur_flip, 0)
-    d = state.board[jnp.arange(c), fi].astype(jnp.int32)
-    return 1 - 2 * d
 
 
 def unpack_state(state: BoardState, outs, t_inner: int) -> BoardState:
@@ -415,6 +406,7 @@ def unpack_state(state: BoardState, outs, t_inner: int) -> BoardState:
         cur_wait=scal[0],
         wait_pending=ints[0] > 0,
         cur_flip=ints[1],
+        cur_sign=ints[2],
         t_yield=ints[3],
         move_clock=ints[4],
         accept_count=ints[5],

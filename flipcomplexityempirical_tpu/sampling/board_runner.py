@@ -40,14 +40,24 @@ def init_board(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
     return bg, state, params
 
 
-def drain_waits(state, waits_total):
-    """Move the device f32 chunk-local wait sum into the host f64 total."""
-    waits_total += np.asarray(state.waits_sum, np.float64)
+def drain_waits(state, pending_waits):
+    """Stash the device f32 chunk-local wait sum and zero it. The stash is
+    a list of (C,) DEVICE arrays summed in f64 on host only at run end —
+    keeping the f64 accumulation per chunk (a 100k-step chain's wait sum
+    overflows f32 precision) WITHOUT a per-chunk host sync, so the runner
+    enqueues chunks back-to-back and dispatch pipelines."""
+    pending_waits.append(state.waits_sum)
     return state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
 
 
+def _sum_pending(waits_total, pending_waits):
+    for w in pending_waits:
+        waits_total += np.asarray(w, np.float64)
+    return waits_total
+
+
 def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
-                       record_history, n_steps) -> RunResult:
+                       pending_waits, record_history, n_steps) -> RunResult:
     """Shared run epilogue for the board-path runners: record the final
     yield (no trailing transition), drain waits, assemble the RunResult."""
     state, out_last = kboard.record_final(bg, spec, params, state)
@@ -55,7 +65,8 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
         out_last = jax.tree.map(np.asarray, out_last)
         for k, v in out_last.items():
             hist_parts.setdefault(k, []).append(v[:, None])
-    state = drain_waits(state, waits_total)
+    state = drain_waits(state, pending_waits)
+    waits_total = _sum_pending(waits_total, pending_waits)
     history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
                if record_history else {})
     return RunResult(state=state, history=history,
@@ -74,6 +85,7 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
     hist_parts: dict = {}
     waits_total = np.asarray(state.waits_sum, np.float64).copy()
     state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+    pending_waits: list = []
 
     done = 0                      # yields recorded so far
     transitions = n_steps - 1
@@ -85,8 +97,9 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
             outs = jax.tree.map(np.asarray, outs)
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (T, C) -> (C, T)
-        state = drain_waits(state, waits_total)
+        state = drain_waits(state, pending_waits)
         done += this
 
     return finalize_board_run(bg, spec, params, state, hist_parts,
-                              waits_total, record_history, n_steps)
+                              waits_total, pending_waits, record_history,
+                              n_steps)

@@ -49,6 +49,7 @@ def run_board_pallas(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
     hist_parts: dict = {}
     waits_total = np.asarray(state.waits_sum, np.float64).copy()
     state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
+    pending_waits: list = []
 
     done = 0
     chunk_idx = 0
@@ -82,9 +83,10 @@ def run_board_pallas(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
             for k, v in zip(("cut_count", "b_count", "wait", "accepts"),
                             outs[6:10]):
                 hist_parts.setdefault(k, []).append(np.asarray(v).T)
-        state = drain_waits(state, waits_total)
+        state = drain_waits(state, pending_waits)
         done += this
         chunk_idx += 1
 
     return finalize_board_run(bg, spec, params, state, hist_parts,
-                              waits_total, record_history, n_steps)
+                              waits_total, pending_waits, record_history,
+                              n_steps)

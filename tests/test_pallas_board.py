@@ -66,8 +66,7 @@ def simulate(bg, spec, board0, dist_pop0, params, st, bits_plane,
     cur_wait = np.asarray(st.cur_wait, np.float32).copy()
     pending = np.asarray(st.wait_pending).copy()
     cur_flip = np.asarray(st.cur_flip).copy()
-    fi = np.maximum(cur_flip, 0)
-    cur_sign = 1 - 2 * board[np.arange(c), fi].astype(np.int64)
+    cur_sign = np.asarray(st.cur_sign, np.int64).copy()
     acc_cnt = np.asarray(st.accept_count).copy()
     denom = np.float32(float(n) ** 2 - 1.0)
 
