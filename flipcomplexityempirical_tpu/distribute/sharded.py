@@ -155,10 +155,9 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
                                            inner_steps, collect=False)
         swaps = jnp.int32(0)
         if exchange and n_dev > 1:
-            # BoardState.cut_count is refreshed at record time, one
-            # transition behind after a chunk — recount so the swap
-            # Metropolis test sees the current energy
-            cuts = kboard.recount_cuts(bg, states.board)
+            # the board loop carries cut_count incrementally, so it is the
+            # current energy right after a chunk
+            cuts = states.cut_count
             params, s0 = _swap_round(key, params, cuts, 0, n_dev, perms)
             params, s1 = _swap_round(key, params, cuts, 1, n_dev, perms)
             swaps = s0 + s1

@@ -35,11 +35,12 @@ and the memory system:
   read-modify-write passes per step as in-loop accumulators — the
   dominant cost by far. Instead the scan emits a 2-word-per-chain log
   (flip pointer, sign) per yield, and ``apply_flip_log`` reconstructs all
-  three arrays once per chunk: a stable per-chain sort of the log by
-  pointer node makes each yield's ``last_flipped`` read adjacent, turning
-  the whole replay into one gather + three scatters (see its docstring).
-  ``tests/test_board.py`` checks the reconstruction against a sequential
-  replay, including mid-run chunk splits.
+  three arrays once per chunk: one composite-key sort groups each chain's
+  log by pointer node, per-group telescoping turns the recurrence into
+  per-entry weights, and a batched MATMUL histogram accumulates the
+  weights into (C, N) planes — no dynamic gather or scatter anywhere
+  (see its docstring). ``tests/test_board.py`` checks the reconstruction
+  against a sequential replay, including mid-run chunk splits.
 - cut_times accumulates in chunk-local int16 planes (chunk <= 32767
   asserted) folded into the int32 state once per chunk — half the HBM
   traffic of the per-step int32 read-modify-write.
@@ -224,10 +225,10 @@ def cut_planes(bg: BoardGraph, board):
 
 
 def recount_cuts(bg: BoardGraph, board) -> jnp.ndarray:
-    """i32[C] cut-edge count recomputed from the board. cut_count in
-    BoardState is refreshed at record time (before each transition), so
-    callers needing the CURRENT energy mid-loop — e.g. replica-exchange
-    acceptance — recount here."""
+    """i32[C] cut-edge count recomputed from the board. The chunk loop
+    carries BoardState.cut_count incrementally (+dcut on accept); this
+    from-scratch recount serves out-of-loop callers (replica-exchange
+    acceptance over a freshly permuted board) and drift tests."""
     cut_e, cut_s = cut_planes(bg, board)
     return (cut_e.sum(axis=1, dtype=jnp.int32)
             + cut_s.sum(axis=1, dtype=jnp.int32))
@@ -260,8 +261,9 @@ def _planes(bg: BoardGraph, spec: Spec, params: StepParams,
     south_ok = jnp.arange(bg.n) < (bg.h - 1) * bg.w
     cut_e = bg.east_ok[None] & ~same[0]      # edge (i, i+1)
     cut_s = south_ok[None] & ~same[2]        # edge (i, i+W)
-    cut_count = (cut_e.sum(axis=1, dtype=jnp.int32)
-                 + cut_s.sum(axis=1, dtype=jnp.int32))
+    # cut_count is NOT reduced here: the loop carries it incrementally
+    # (+dcut on accept) — one fewer (C, E)-scale reduction per step.
+    # recount_cuts() recomputes from scratch for out-of-loop callers.
 
     if spec.contiguity == "patch":
         contig = ring_contig_ok(same)
@@ -269,18 +271,20 @@ def _planes(bg: BoardGraph, spec: Spec, params: StepParams,
         contig = jnp.ones_like(b_mask)
 
     # population bounds for flipping each node OUT of its current district
-    popn = bg.pop[None].astype(jnp.float32)
+    # collapse to one per-chain threshold per side (flipping out of d must
+    # keep d >= pop_lo and the other side <= pop_hi), so the plane test is
+    # a single broadcast compare instead of two (C, N) f32 constructions
+    p0 = state.dist_pop[:, 0].astype(jnp.float32)
+    p1 = state.dist_pop[:, 1].astype(jnp.float32)
+    thr0 = jnp.minimum(p0 - params.pop_lo, params.pop_hi - p1)  # leaving 0
+    thr1 = jnp.minimum(p1 - params.pop_lo, params.pop_hi - p0)  # leaving 1
     is1 = board == 1
-    pop_of = jnp.where(is1, state.dist_pop[:, 1, None],
-                       state.dist_pop[:, 0, None]).astype(jnp.float32)
-    pop_to = jnp.where(is1, state.dist_pop[:, 0, None],
-                       state.dist_pop[:, 1, None]).astype(jnp.float32)
-    pop_ok = ((pop_of - popn >= params.pop_lo[:, None])
-              & (pop_to + popn <= params.pop_hi[:, None]))
+    popn = bg.pop[None].astype(jnp.float32)
+    pop_ok = popn <= jnp.where(is1, thr1[:, None], thr0[:, None])
 
     valid = b_mask & contig & pop_ok
     return dict(valid=valid, b_count=b_count, diff_deg=diff_deg,
-                cut_e=cut_e, cut_s=cut_s, cut_count=cut_count)
+                cut_e=cut_e, cut_s=cut_s)
 
 
 # ---------------------------------------------------------------------------
@@ -311,7 +315,7 @@ def _record(bg: BoardGraph, spec: Spec, params: StepParams,
     Bookkeeping for part_sum/last_flipped/num_flips is deferred: this
     emits the (flip pointer, sign) log row instead."""
     out = {
-        "cut_count": planes["cut_count"],
+        "cut_count": state.cut_count,
         "b_count": planes["b_count"],
         "wait": cur_wait,
         "accepts": state.accept_count,
@@ -331,8 +335,7 @@ def _record(bg: BoardGraph, spec: Spec, params: StepParams,
 
     state = state.replace(
         cur_wait=cur_wait, wait_pending=jnp.zeros_like(state.wait_pending),
-        waits_sum=waits_sum, t_yield=state.t_yield + 1,
-        cut_count=planes["cut_count"])
+        waits_sum=waits_sum, t_yield=state.t_yield + 1)
     return state, ct_e16, ct_s16, out, log
 
 
@@ -447,8 +450,7 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     return state.replace(
         board=board,
         dist_pop=dist_pop,
-        # cut_count is refreshed from recomputed planes at every record —
-        # the single maintenance path
+        cut_count=state.cut_count + dcut * accept.astype(jnp.int32),
         cur_flip=jnp.where(accept, flat, state.cur_flip),
         cur_sign=jnp.where(accept, params.label_values[d_to],
                            state.cur_sign),
@@ -468,8 +470,8 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
 def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0):
     """Replay the reference's per-yield flip bookkeeping
     (grid_chain_sec11.py:396-400) from a chunk's (T, C) log with
-    order-independent scatters. ``t0[c]`` is the absolute yield index of
-    log row 0.
+    order-independent dense algebra. ``t0[c]`` is the absolute yield index
+    of log row 0.
 
     Sequential semantics reproduced exactly, per yield t with pointer f
     (f >= 0) and sign s = label of f's current district:
@@ -477,55 +479,85 @@ def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0):
         last_flipped[f]  = t
         num_flips[f]    += 1
 
-    The only sequential dependence is ``last_flipped[f]`` at each yield,
-    which equals the PREVIOUS yield whose pointer was f (or the carry-in
-    value). A stable per-chain sort of the log by pointer node makes every
-    entry's previous occurrence adjacent, so all T*C contributions reduce
-    to one gather + one scatter-add. Chunk boundaries compose exactly
-    through the carried last_flipped (asserted by
-    tests/test_board.py::test_apply_flip_log_chunked_composition)."""
+    Implementation, built for a TPU whose dynamic gather/scatter emitter
+    runs ~10 ns per element (a 2M-element scatter = ~20 ms):
+
+    1. ONE sort of the composite key ``f*T + t_rel`` (sign as the only
+       payload) groups each chain's entries by pointer node with yield
+       order preserved inside groups; f and t_rel are recovered
+       arithmetically. Per-group telescoping turns the part_sum
+       recurrence into per-entry weights: interior entries contribute
+       ``-s*(t - prev_t)``, each group's first entry ``-s*t_rel`` plus a
+       carry term ``s*(last_flipped[f] - t0)`` resolved densely in step 3.
+    2. The per-entry weights are accumulated into (C, N) planes by a
+       batched MATMUL histogram instead of scatters: factor
+       ``n = x*WF + y``, build one-hot row/column indicator operands, and
+       contract ``einsum('ctx,cty->cxy')`` with the four weight streams
+       (part_sum delta, first-entry sign, flip count, last yield+1)
+       stacked along the column operand. All weights are chunk-relative
+       (<= 2*T), so f32 accumulation with Precision.HIGHEST is
+       integer-exact.
+    3. Dense elementwise combine: the first-entry sign plane multiplies
+       the CARRIED last_flipped plane (resolving step 1's carry term with
+       no gather), and the last-yield plane overwrites last_flipped where
+       the chunk touched the node.
+
+    Chunk boundaries compose exactly through the carried last_flipped
+    (asserted by tests/test_board.py::test_apply_flip_log_chunked_composition).
+    """
     tlen, c = log_f.shape
     n = part_sum.shape[1]
-    # chain-major orientation: after the per-chain sort the flat scatter
-    # index (c * n + f) is globally non-decreasing, unlocking the sorted
-    # scatter path (no index hashing/serialization on TPU)
+    if n * tlen >= 2 ** 31:
+        raise ValueError(
+            f"composite sort key n*chunk = {n}*{tlen} overflows int32; "
+            "use a smaller chunk for this graph")
+    f32 = jnp.float32
     f_cm = log_f.T                                       # (C, T)
     s_cm = log_s.T
-    t_cm = t0[:, None] + jnp.arange(tlen, dtype=jnp.int32)[None, :]
-    base = (jnp.arange(c, dtype=jnp.int32) * n)[:, None]
 
-    # group each chain's entries by pointer node, original order preserved
-    # within groups (=> ascending yield time); inactive (-1) entries sort
-    # first within their chain and scatter to its node-0 slot with no-op
-    # values, keeping the flat index globally non-decreasing
-    order = jnp.argsort(f_cm, axis=1, stable=True)
-    f_s = jnp.take_along_axis(f_cm, order, axis=1)
-    t_s = jnp.take_along_axis(t_cm, order, axis=1)
-    s_s = jnp.take_along_axis(s_cm, order, axis=1)
-    act_s = f_s >= 0
-    idx_s = (jnp.maximum(f_s, 0) + base).reshape(-1)
-
-    ps = part_sum.reshape(-1)
-    lf = last_flipped.reshape(-1)
-    nf = num_flips.reshape(-1)
+    key = f_cm * tlen + jnp.arange(tlen, dtype=jnp.int32)[None, :]
+    key_s, s_s = jax.lax.sort((key, s_cm), dimension=1, num_keys=1)
+    f_s = jnp.floor_divide(key_s, tlen)                  # -1 preserved
+    t_rel = jnp.remainder(key_s, tlen)                   # chunk-relative
+    act = f_s >= 0
 
     prev_same = jnp.concatenate(
         [jnp.zeros((c, 1), bool), f_s[:, 1:] == f_s[:, :-1]], axis=1)
     prev_t = jnp.concatenate(
-        [jnp.zeros((c, 1), t_s.dtype), t_s[:, :-1]], axis=1)
-    lf_carry = lf[idx_s].reshape(c, tlen)
-    dt = t_s - jnp.where(prev_same, prev_t, lf_carry)
-    contrib = jnp.where(act_s, -s_s * dt, 0)
+        [jnp.zeros((c, 1), t_rel.dtype), t_rel[:, :-1]], axis=1)
+    is_last = jnp.concatenate(
+        [f_s[:, :-1] != f_s[:, 1:], jnp.ones((c, 1), bool)], axis=1)
 
-    ps_new = ps.at[idx_s].add(contrib.reshape(-1),
-                              indices_are_sorted=True)
-    lf_new = lf.at[idx_s].max(jnp.where(act_s, t_s, -1).reshape(-1),
-                              indices_are_sorted=True)
-    nf_new = nf.at[idx_s].add(act_s.astype(jnp.int32).reshape(-1),
-                              indices_are_sorted=True)
+    s_f = s_s.astype(f32)
+    w_ps = jnp.where(
+        act, -s_f * (t_rel - jnp.where(prev_same, prev_t, 0)).astype(f32),
+        0.0)
+    w_s1 = jnp.where(act & ~prev_same, s_f, 0.0)
+    w_nf = act.astype(f32)
+    w_lf = jnp.where(act & is_last, (t_rel + 1).astype(f32), 0.0)
 
-    return (ps_new.reshape(-1, n), lf_new.reshape(-1, n),
-            nf_new.reshape(-1, n))
+    wf = n if n < 128 else 128                           # full lane width
+    hf = -(-n // wf)
+    fr = jnp.floor_divide(f_s, wf)                       # -1 matches no x
+    fc = jnp.remainder(f_s, wf)
+    a_ind = (fr[:, :, None] == jnp.arange(hf)[None, None, :]).astype(f32)
+    c_ind = (fc[:, :, None] == jnp.arange(wf)[None, None, :]).astype(f32)
+    b_all = jnp.concatenate(
+        [c_ind * w[:, :, None] for w in (w_ps, w_s1, w_nf, w_lf)], axis=2)
+    out = jnp.einsum('ctx,cty->cxy', a_ind, b_all,
+                     precision=jax.lax.Precision.HIGHEST)
+    out = out.reshape(c, hf, 4, wf).astype(jnp.int32)
+
+    def plane(k):
+        return out[:, :, k, :].reshape(c, hf * wf)[:, :n]
+
+    t0c = t0[:, None]
+    ps_new = (part_sum + plane(0)
+              + plane(1) * (last_flipped - t0c))
+    nf_new = num_flips + plane(2)
+    lf_d = plane(3)
+    lf_new = jnp.where(lf_d > 0, t0c + lf_d - 1, last_flipped)
+    return ps_new, lf_new, nf_new
 
 
 # ---------------------------------------------------------------------------

@@ -49,7 +49,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..graphs.lattice import LatticeGraph
-from .board import BoardGraph, BoardState, board_shape, supports as _board_supports
+from .board import (BoardGraph, BoardState, board_shape, recount_cuts,
+                    supports as _board_supports)
 from .step import Spec, StepParams
 
 
@@ -394,7 +395,7 @@ def pack_state(state: BoardState, params: StepParams):
     return dist_pop, scal, ints
 
 
-def unpack_state(state: BoardState, outs, t_inner: int) -> BoardState:
+def unpack_state(state: BoardState, bg, outs, t_inner: int) -> BoardState:
     """Merge kernel outputs back into a BoardState (tries_sum counts one
     draw per yield, as the board path does)."""
     (board, dist_pop, scal, ints, log_f, log_s, h_cut, h_b, h_wait, h_acc,
@@ -402,7 +403,9 @@ def unpack_state(state: BoardState, outs, t_inner: int) -> BoardState:
     return state.replace(
         board=board,
         dist_pop=jnp.stack([dist_pop[0], dist_pop[1]], axis=1),
-        cut_count=h_cut[t_inner - 1],  # refreshed at next record/epilogue
+        # the board loop CARRIES cut_count (current board's count), while
+        # h_cut[-1] is the last record's pre-transition value — recount
+        cut_count=recount_cuts(bg, board),
         cur_wait=scal[0],
         wait_pending=ints[0] > 0,
         cur_flip=ints[1],

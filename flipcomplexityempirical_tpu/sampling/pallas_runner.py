@@ -72,7 +72,7 @@ def run_board_pallas(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
             spec, bg.h, bg.w, this, block_chains, seeds, state.board,
             pop_plane, deg_plane, masks8, dist_pop, scal, ints,
             bits_plane, bits_scal, host_rng=host_rng, interpret=interpret)
-        state = pboard.unpack_state(state, outs, this)
+        state = pboard.unpack_state(state, bg, outs, this)
         if spec.parity_metrics:
             ps, lf, nf = kboard.apply_flip_log(
                 state.part_sum, state.last_flipped, state.num_flips,

@@ -195,7 +195,7 @@ def test_kernel_invariants_and_log_replay(rng):
     g, spec, bg, st, params = _setup(chains=8, tol=0.1)
     bits_plane, bits_scal = _bits(rng, 60, 8, N)
     outs = _run_kernel(spec, bg, st, params, bits_plane, bits_scal)
-    st2 = pb.unpack_state(st, outs, 60)
+    st2 = pb.unpack_state(st, bg, outs, 60)
     b = np.asarray(st2.board).reshape(-1, H, W)
 
     from scipy.ndimage import label as cc_label
