@@ -49,6 +49,12 @@ def main():
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="wrap the timed region in a jax.profiler trace "
                          "written to DIR (SURVEY.md section 5 tracing)")
+    ap.add_argument("--repeats", type=int, default=None,
+                    help="timed-region repetitions; the reported rate is "
+                         "the best (throughput benchmarks should not be "
+                         "charged for transient device/tunnel stalls). "
+                         "Default 2, or 1 under --profile so the trace "
+                         "holds exactly the timed region")
     args = ap.parse_args()
     if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
             or args.warmup - 1 < args.chunk):
@@ -135,11 +141,14 @@ def main():
 
     prof = (jax.profiler.trace(args.profile) if args.profile
             else contextlib.nullcontext())
-    t0 = time.perf_counter()
+    repeats = args.repeats if args.repeats else (1 if args.profile else 2)
+    dt = float("inf")
     with prof:
-        res = run(states, args.steps)
-        jax.block_until_ready(jax.tree.leaves(res.state)[0])
-    dt = time.perf_counter() - t0
+        for _ in range(max(repeats, 1)):
+            t0 = time.perf_counter()
+            res = run(states, args.steps)
+            jax.block_until_ready(jax.tree.leaves(res.state)[0])
+            dt = min(dt, time.perf_counter() - t0)
 
     flips = args.chains * (args.steps - 1)  # yields minus the initial record
     fps = flips / dt

@@ -1,0 +1,239 @@
+"""Bit-board backend for the board kernel's hot loop.
+
+The int8 board path (kernel/board.py) streams ~100 MB of (C, N) planes
+per step: 8 stencil compares, ring criterion, validity, int16 cut_times
+read-modify-write. At one byte per cell almost all of that traffic is
+redundant — every plane is boolean. This backend packs the board and
+every derived plane into uint32 words (32 cells per lane element), so
+the same per-step dataflow touches ~1/8th the bytes:
+
+- stencil neighbor reads are funnel shifts across the word array
+  (``shift_down``/``shift_up``), with row-wrap and frame masks packed
+  once per chunk (loop-invariant, hoisted by XLA);
+- the ring contiguity criterion's two "count <= 1" tests become
+  carry-save popcount logic (``_at_most_one``) — pure AND/OR/XOR;
+- boundary and valid counts come from ``lax.population_count``;
+- the two-level proposal selection reads per-row popcounts
+  (words-per-row is static), and extracts the chosen row's cells by a
+  one-hot masked sum — no dynamic gather anywhere;
+- cut_times accumulates into ``ceil(log2(chunk+1))`` bit-sliced counter
+  planes via ripple-carry adds (3 bitwise ops per slice on (C, NW)
+  words), folded into the int32 totals once per chunk — replacing the
+  ~100 MB/step int16 read-modify-write with ~1 MB/step of bitwise ops.
+
+Semantics are IDENTICAL to the int8 path: the same PRNG stream drives
+the same uniform draws, the selection picks the same m-th valid cell in
+flat row-major order, and the acceptance formula is unchanged — so
+trajectories are bit-identical (asserted by tests/test_bitboard.py).
+``supported()`` gates the backend to the workloads where the packing is
+clean and exact: uniform node population (the population test collapses
+to one boolean per chain per side; true of every reference config,
+grid_chain_sec11.py:221), W a multiple of 32 (rows align to words),
+accept in ('cut', 'always') (the 'corrected' boundary-ratio correction
+needs per-node degree counts the bit planes don't keep), and no
+record_assignment_bits. Everything else silently uses the int8 body.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .step import Spec, StepParams
+
+U32 = jnp.uint32
+
+
+def supported(bg, spec: Spec) -> bool:
+    """Static gate: may this chunk run on the bit-board body?"""
+    return (
+        bool(bg.uniform_pop)
+        and bg.w % 32 == 0
+        and spec.accept in ("cut", "always")
+        and spec.contiguity in ("patch", "none")
+        and not spec.record_assignment_bits
+    )
+
+
+def n_words(n: int) -> int:
+    return -(-n // 32)
+
+
+def pack_bits(plane) -> jnp.ndarray:
+    """(..., N) {0,1}/bool -> (..., NW) uint32, bit j of word k = cell
+    k*32+j (LSB first). Pad cells are zero."""
+    n = plane.shape[-1]
+    nw = n_words(n)
+    b = jnp.pad(plane.astype(U32), [(0, 0)] * (plane.ndim - 1)
+                + [(0, nw * 32 - n)])
+    b = b.reshape(*plane.shape[:-1], nw, 32)
+    return jnp.sum(b << jnp.arange(32, dtype=U32), axis=-1, dtype=U32)
+
+
+def unpack_bits(words, n: int) -> jnp.ndarray:
+    """(..., NW) uint32 -> (..., N) int8."""
+    nw = words.shape[-1]
+    bits = ((jnp.repeat(words, 32, axis=-1)
+             >> (jnp.arange(nw * 32, dtype=U32) % 32)) & U32(1))
+    return bits[..., :n].astype(jnp.int8)
+
+
+def shift_down(words, k: int):
+    """Bit n+k moves to position n (read the +k neighbor). k static."""
+    nw = words.shape[-1]
+    wo, bo = divmod(k, 32)
+    p = jnp.pad(words, [(0, 0)] * (words.ndim - 1) + [(0, wo + 1)])
+    a = p[..., wo:wo + nw]
+    if bo == 0:
+        return a
+    b = p[..., wo + 1:wo + 1 + nw]
+    return (a >> U32(bo)) | (b << U32(32 - bo))
+
+
+def shift_up(words, k: int):
+    """Bit n-k moves to position n (read the -k neighbor). k static."""
+    nw = words.shape[-1]
+    wo, bo = divmod(k, 32)
+    p = jnp.pad(words, [(0, 0)] * (words.ndim - 1) + [(wo + 1, 0)])
+    a = p[..., 1:1 + nw]
+    if bo == 0:
+        return a
+    b = p[..., 0:nw]
+    return (a << U32(bo)) | (b >> U32(32 - bo))
+
+
+def _at_most_one(a, b, c, d):
+    """Bitwise per-cell: at most one of the four bit-planes is set."""
+    return ~((a & b) | (c & d) | ((a | b) & (c | d)))
+
+
+def static_masks(bg):
+    """Existence masks per ring direction, packed. Loop-invariant —
+    computed inside the jitted chunk and hoisted by XLA."""
+    n, w, h = bg.n, bg.w, bg.h
+    idx = jnp.arange(n)
+    e = bg.east_ok
+    wk = bg.west_ok
+    s = idx < (h - 1) * w
+    nn = idx >= w
+    # ring order: E, SE, S, SW, W, NW, N, NE (board.same_planes)
+    dirs = [e, s & e, s, s & wk, wk, nn & wk, nn, nn & e]
+    return [pack_bits(m[None, :]) for m in dirs]
+
+
+def planes_bits(bg, spec: Spec, params: StepParams, board_w, dist_pop):
+    """Bit-plane analogue of board._planes: same[] ring planes, boundary
+    mask/count, contiguity, population gate, validity."""
+    masks = static_masks(bg)
+    w = bg.w
+    offs = [(shift_down, 1), (shift_down, w + 1), (shift_down, w),
+            (shift_down, w - 1), (shift_up, 1), (shift_up, w + 1),
+            (shift_up, w), (shift_up, w - 1)]
+    same = []
+    diff = []
+    for (fn, k), m in zip(offs, masks):
+        x = board_w ^ fn(board_w, k)
+        same.append(~x & m)
+        diff.append(x & m)
+
+    b_mask = diff[0] | diff[2] | diff[4] | diff[6]
+    b_count = jax.lax.population_count(b_mask).astype(jnp.int32).sum(1)
+
+    if spec.contiguity == "patch":
+        seeds_le1 = _at_most_one(same[0], same[2], same[4], same[6])
+        runs = [same[i] & ~(same[i - 1] & same[i - 2]) for i in
+                (0, 2, 4, 6)]
+        contig = seeds_le1 | _at_most_one(*runs)
+    else:
+        contig = ~jnp.zeros_like(b_mask)
+
+    # uniform population: the bound test collapses to one boolean per
+    # chain per side (board.supports gates non-uniform pop off this body)
+    unit = bg.pop[0].astype(jnp.float32)
+    p0 = dist_pop[:, 0].astype(jnp.float32)
+    p1 = dist_pop[:, 1].astype(jnp.float32)
+    ok0 = unit <= jnp.minimum(p0 - params.pop_lo, params.pop_hi - p1)
+    ok1 = unit <= jnp.minimum(p1 - params.pop_lo, params.pop_hi - p0)
+    full = U32(0xFFFFFFFF)
+    pop_ok = ((board_w & jnp.where(ok1, full, U32(0))[:, None])
+              | (~board_w & jnp.where(ok0, full, U32(0))[:, None]))
+
+    valid = b_mask & contig & pop_ok
+    cut_e = diff[0]                       # edge (i, i+1), masked to E
+    cut_s = diff[2]                       # edge (i, i+W), masked to S
+    return dict(valid=valid, b_count=b_count, diff=diff,
+                cut_e=cut_e, cut_s=cut_s)
+
+
+def _word_at(words, wi):
+    """words[c, wi[c]] without a dynamic gather: one-hot masked sum."""
+    nw = words.shape[1]
+    sel = jnp.arange(nw)[None, :] == wi[:, None]
+    return jnp.sum(jnp.where(sel, words, U32(0)), axis=1, dtype=U32)
+
+
+def bit_at(words, flat):
+    """Bit ``flat[c]`` of each chain's plane, as int32 0/1."""
+    wsel = _word_at(words, flat // 32)
+    return ((wsel >> (flat % 32).astype(U32)) & U32(1)).astype(jnp.int32)
+
+
+def select_flat(bg, valid, u):
+    """The (m+1)-th valid cell in flat row-major order — identical choice
+    to the int8 path's two-matmul selection, via popcounts.
+
+    Returns (flat, any_valid)."""
+    c = valid.shape[0]
+    h, w = bg.h, bg.w
+    wpr = w // 32                          # static; gated by supported()
+    pc = jax.lax.population_count(valid).astype(jnp.int32)
+    rowcnt = pc.reshape(c, h, wpr).sum(-1)
+    rowcum = jnp.cumsum(rowcnt, axis=1)
+    total = rowcum[:, -1]
+    any_valid = total > 0
+    m = jnp.minimum((u * total.astype(jnp.float32)).astype(jnp.int32),
+                    jnp.maximum(total - 1, 0))
+    row = jnp.argmax(rowcum > m[:, None], axis=1).astype(jnp.int32)
+    oh_prev = jnp.arange(h)[None, :] == (row - 1)[:, None]
+    before = jnp.sum(jnp.where(oh_prev, rowcum, 0), axis=1,
+                     dtype=jnp.int32)
+    m_in_row = m - before
+
+    oh_row = (jnp.arange(h)[None, :, None] == row[:, None, None])
+    rw = jnp.sum(jnp.where(oh_row, valid.reshape(c, h, wpr), U32(0)),
+                 axis=1, dtype=U32)        # (C, wpr): the chosen row
+    colcum = jnp.cumsum(unpack_bits(rw, w).astype(jnp.int32), axis=1)
+    col = jnp.argmax(colcum > m_in_row[:, None], axis=1).astype(jnp.int32)
+    return row * w + col, any_valid
+
+
+def flip_bit(board_w, flat, accept):
+    """XOR the chosen cell's bit where accepted (2 districts: flip)."""
+    nw = board_w.shape[1]
+    sel = ((jnp.arange(nw)[None, :] == (flat // 32)[:, None])
+           & accept[:, None])
+    val = (U32(1) << (flat % 32).astype(U32))[:, None]
+    return board_w ^ jnp.where(sel, val, U32(0))
+
+
+def counter_init(c: int, nw: int, slices: int):
+    return [jnp.zeros((c, nw), U32) for _ in range(slices)]
+
+
+def counter_add(slices, plane_w):
+    """Ripple-carry add of a 1-bit plane into bit-sliced counters."""
+    carry = plane_w
+    out = []
+    for s in slices:
+        out.append(s ^ carry)
+        carry = s & carry
+    return out
+
+
+def counter_fold(slices, n: int):
+    """Bit-sliced counters -> (C, N) int32 totals (once per chunk)."""
+    tot = 0
+    for k, s in enumerate(slices):
+        tot = tot + (unpack_bits(s, n).astype(jnp.int32) << k)
+    return tot

@@ -75,6 +75,7 @@ import numpy as np
 from flax import struct
 
 from ..graphs.lattice import LatticeGraph
+from . import bitboard
 from .step import Spec, StepParams, sample_geom_minus1
 
 @struct.dataclass
@@ -90,6 +91,8 @@ class BoardGraph:
     west_ok: jnp.ndarray  # bool[N] node has a west (-1 flat) neighbor
     h: int = struct.field(pytree_node=False, default=0)
     w: int = struct.field(pytree_node=False, default=0)
+    # static because the bit-board body is chosen at trace time
+    uniform_pop: bool = struct.field(pytree_node=False, default=False)
 
     @property
     def n(self) -> int:
@@ -185,12 +188,14 @@ def make_board_graph(graph: LatticeGraph) -> BoardGraph:
     deg[:, 0] -= 1
     deg[:, -1] -= 1
     ys = np.arange(h * w) % w
+    pop = np.asarray(graph.pop, np.int32)
     return BoardGraph(
-        pop=jnp.asarray(graph.pop, jnp.int32),
+        pop=jnp.asarray(pop),
         deg=jnp.asarray(deg.reshape(-1)),
         east_ok=jnp.asarray(ys != w - 1),
         west_ok=jnp.asarray(ys != 0),
-        h=h, w=w)
+        h=h, w=w,
+        uniform_pop=bool(pop.size) and bool((pop == pop[0]).all()))
 
 
 # ---------------------------------------------------------------------------
@@ -309,17 +314,70 @@ def _complete_wait(spec: Spec, state: BoardState, b_count, kwait,
     return jnp.where(state.wait_pending, w, state.cur_wait)
 
 
+def _accept_decision(spec: Spec, params: StepParams, move_clock, dcut,
+                     any_valid, kacc, corr_log=None):
+    """The Metropolis decision shared by the int8 and bit-board bodies:
+    literal ``base**(-dcut)`` bound (grid_chain_sec11.py:171-179), with
+    the optional linear annealing schedule on the accepted-move clock and
+    an optional reversibility-correction log term."""
+    if spec.accept == "always":
+        return any_valid
+    if spec.anneal == "linear":
+        t = (move_clock + 1).astype(jnp.float32)
+        beta = jnp.clip((t - params.anneal_t0) / params.anneal_ramp,
+                        0.0, params.anneal_beta_max)
+    else:
+        beta = params.beta
+    log_bound = -beta * dcut.astype(jnp.float32) * params.log_base
+    if corr_log is not None:
+        log_bound = log_bound + corr_log
+    logu = jnp.log(jnp.maximum(_uniform(kacc), jnp.float32(1e-12)))
+    return any_valid & (logu < log_bound)
+
+
+def _record_common(state: BoardState, b_count, cur_wait):
+    """The per-yield record shared by both bodies: history row, flip-log
+    row, wait bookkeeping, yield clock."""
+    out = {
+        "cut_count": state.cut_count,
+        "b_count": b_count,
+        "wait": cur_wait,
+        "accepts": state.accept_count,
+    }
+    log = {"f": state.cur_flip, "s": state.cur_sign}
+    state = state.replace(
+        cur_wait=cur_wait, wait_pending=jnp.zeros_like(state.wait_pending),
+        waits_sum=state.waits_sum + cur_wait, t_yield=state.t_yield + 1)
+    return state, out, log
+
+
+def _commit_transition(state: BoardState, params: StepParams, board,
+                       dist_pop, flat, d_to, dcut, accept, any_valid):
+    """The accept-commit shared by both bodies (board/dist_pop given in
+    the body's own representation)."""
+    acc_i = accept.astype(jnp.int32)
+    return state.replace(
+        board=board,
+        dist_pop=dist_pop,
+        cut_count=state.cut_count + dcut * acc_i,
+        cur_flip=jnp.where(accept, flat, state.cur_flip),
+        cur_sign=jnp.where(accept, params.label_values[d_to],
+                           state.cur_sign),
+        wait_pending=accept,
+        move_clock=state.move_clock + acc_i,
+        accept_count=state.accept_count + acc_i,
+        tries_sum=state.tries_sum + 1,
+        exhausted_count=state.exhausted_count
+        + (~any_valid).astype(jnp.int32),
+    )
+
+
 def _record(bg: BoardGraph, spec: Spec, params: StepParams,
             state: BoardState, ct_e16, ct_s16, planes, cur_wait):
     """The measurement yield (grid_chain_sec11.py:366-402), batched.
     Bookkeeping for part_sum/last_flipped/num_flips is deferred: this
     emits the (flip pointer, sign) log row instead."""
-    out = {
-        "cut_count": state.cut_count,
-        "b_count": planes["b_count"],
-        "wait": cur_wait,
-        "accepts": state.accept_count,
-    }
+    state, out, log = _record_common(state, planes["b_count"], cur_wait)
     if spec.record_assignment_bits:
         if bg.n > 32:
             raise ValueError("record_assignment_bits needs n_nodes <= 32")
@@ -329,13 +387,6 @@ def _record(bg: BoardGraph, spec: Spec, params: StepParams,
             dtype=jnp.uint32)
     ct_e16 = ct_e16 + planes["cut_e"].astype(jnp.int16)
     ct_s16 = ct_s16 + planes["cut_s"].astype(jnp.int16)
-    waits_sum = state.waits_sum + cur_wait
-
-    log = {"f": state.cur_flip, "s": state.cur_sign}
-
-    state = state.replace(
-        cur_wait=cur_wait, wait_pending=jnp.zeros_like(state.wait_pending),
-        waits_sum=waits_sum, t_yield=state.t_yield + 1)
     return state, ct_e16, ct_s16, out, log
 
 
@@ -388,54 +439,43 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     dd = planes["diff_deg"][cidx, flat].astype(jnp.int32)
     dcut = bg.deg[flat] - 2 * dd
 
-    if spec.accept == "always":
-        accept = any_valid
+    if spec.accept == "corrected":
+        # reversibility correction log(|b|/|b'|): the post-flip
+        # boundary count follows from v's local neighborhood —
+        # a neighbor u enters the boundary iff its only relation
+        # changed (same -> cut with diff_deg 0), leaves iff its only
+        # cut edge was to v; v itself leaves iff all neighbors
+        # differed (annealing_cut_accept_backwards's ratio,
+        # grid_chain_sec11.py:99; kernel/step.py accept='corrected')
+        diff_deg_p = planes["diff_deg"].astype(jnp.int32)
+        board_i = state.board.astype(jnp.int32)
+
+        def nbr_delta(off, ok_mask):
+            u = flat + off
+            exists = ok_mask[flat]
+            uc = jnp.clip(u, 0, n - 1)
+            same_u = board_i[cidx, uc] == d_from
+            dd_u = diff_deg_p[cidx, uc]
+            return jnp.where(
+                exists,
+                jnp.where(same_u & (dd_u == 0), 1,
+                          jnp.where(~same_u & (dd_u == 1), -1, 0)),
+                0)
+
+        south_ok = jnp.arange(n) < (bg.h - 1) * bg.w
+        north_ok = jnp.arange(n) >= bg.w
+        delta = (nbr_delta(1, bg.east_ok)
+                 + nbr_delta(-1, bg.west_ok)
+                 + nbr_delta(w, south_ok)
+                 + nbr_delta(-w, north_ok))
+        b_new = (planes["b_count"] + delta
+                 - (dd == bg.deg[flat]).astype(jnp.int32))
+        corr_log = (jnp.log(planes["b_count"].astype(jnp.float32))
+                    - jnp.log(jnp.maximum(b_new, 1).astype(jnp.float32)))
     else:
-        if spec.anneal == "linear":
-            # the reference's piecewise schedule on the accepted-move
-            # clock (kernel/step.py effective_beta)
-            t = (state.move_clock + 1).astype(jnp.float32)
-            beta = jnp.clip((t - params.anneal_t0) / params.anneal_ramp,
-                            0.0, params.anneal_beta_max)
-        else:
-            beta = params.beta
-        log_bound = (-beta * dcut.astype(jnp.float32) * params.log_base)
-        if spec.accept == "corrected":
-            # reversibility correction log(|b|/|b'|): the post-flip
-            # boundary count follows from v's local neighborhood —
-            # a neighbor u enters the boundary iff its only relation
-            # changed (same -> cut with diff_deg 0), leaves iff its only
-            # cut edge was to v; v itself leaves iff all neighbors
-            # differed (annealing_cut_accept_backwards's ratio,
-            # grid_chain_sec11.py:99; kernel/step.py accept='corrected')
-            diff_deg_p = planes["diff_deg"].astype(jnp.int32)
-            board_i = state.board.astype(jnp.int32)
-
-            def nbr_delta(off, ok_mask):
-                u = flat + off
-                exists = ok_mask[flat]
-                uc = jnp.clip(u, 0, n - 1)
-                same_u = board_i[cidx, uc] == d_from
-                dd_u = diff_deg_p[cidx, uc]
-                return jnp.where(
-                    exists,
-                    jnp.where(same_u & (dd_u == 0), 1,
-                              jnp.where(~same_u & (dd_u == 1), -1, 0)),
-                    0)
-
-            south_ok = jnp.arange(n) < (bg.h - 1) * bg.w
-            north_ok = jnp.arange(n) >= bg.w
-            delta = (nbr_delta(1, bg.east_ok)
-                     + nbr_delta(-1, bg.west_ok)
-                     + nbr_delta(w, south_ok)
-                     + nbr_delta(-w, north_ok))
-            b_new = (planes["b_count"] + delta
-                     - (dd == bg.deg[flat]).astype(jnp.int32))
-            log_bound = log_bound + (
-                jnp.log(planes["b_count"].astype(jnp.float32))
-                - jnp.log(jnp.maximum(b_new, 1).astype(jnp.float32)))
-        logu = jnp.log(jnp.maximum(_uniform(kacc), jnp.float32(1e-12)))
-        accept = any_valid & (logu < log_bound)
+        corr_log = None
+    accept = _accept_decision(spec, params, state.move_clock, dcut,
+                              any_valid, kacc, corr_log)
 
     # one-hot masked write: cheaper than a batched scatter on TPU (no
     # layout round-trip; fuses with the surrounding elementwise pass)
@@ -447,20 +487,8 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
     dist_pop = dist_pop.at[:, 1].add(popv * sgn)
 
-    return state.replace(
-        board=board,
-        dist_pop=dist_pop,
-        cut_count=state.cut_count + dcut * accept.astype(jnp.int32),
-        cur_flip=jnp.where(accept, flat, state.cur_flip),
-        cur_sign=jnp.where(accept, params.label_values[d_to],
-                           state.cur_sign),
-        wait_pending=accept,
-        move_clock=state.move_clock + accept.astype(jnp.int32),
-        accept_count=state.accept_count + accept.astype(jnp.int32),
-        tries_sum=state.tries_sum + 1,
-        exhausted_count=state.exhausted_count
-        + (~any_valid).astype(jnp.int32),
-    )
+    return _commit_transition(state, params, board, dist_pop, flat, d_to,
+                              dcut, accept, any_valid)
 
 
 # ---------------------------------------------------------------------------
@@ -568,6 +596,67 @@ _BOOKKEEPING = ("part_sum", "last_flipped", "num_flips",
                 "cut_times_e", "cut_times_s")
 
 
+def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
+               loop_state: BoardState, chunk: int, collect: bool):
+    """The chunk scan on the bit-board backend (kernel/bitboard.py): the
+    board and every derived plane live as packed uint32 words inside the
+    loop, cut_times accumulates in bit-sliced ripple-carry counters, and
+    the trajectory is bit-identical to the int8 body (same PRNG stream,
+    same m-th-valid selection, same acceptance arithmetic —
+    tests/test_bitboard.py asserts equality field-for-field)."""
+    n = bg.n
+    c = loop_state.board.shape[0]
+
+    def body(carry, _):
+        state, ct_e_sl, ct_s_sl = carry
+        key, kprop, kacc, kwait = _split4(state.key)
+        state = state.replace(key=key)
+        planes = bitboard.planes_bits(bg, spec, params, state.board,
+                                      state.dist_pop)
+        cur_wait = _complete_wait(spec, state, planes["b_count"], kwait, n)
+
+        # record (grid_chain_sec11.py:366-402)
+        state, out, log = _record_common(state, planes["b_count"],
+                                         cur_wait)
+        ct_e_sl = bitboard.counter_add(ct_e_sl, planes["cut_e"])
+        ct_s_sl = bitboard.counter_add(ct_s_sl, planes["cut_s"])
+
+        # transition: single masked draw, flip the chosen bit
+        u = _uniform(kprop)
+        flat, any_valid = bitboard.select_flat(bg, planes["valid"], u)
+        d_from = bitboard.bit_at(state.board, flat)
+        d_to = 1 - d_from
+        dd = (bitboard.bit_at(planes["diff"][0], flat)
+              + bitboard.bit_at(planes["diff"][2], flat)
+              + bitboard.bit_at(planes["diff"][4], flat)
+              + bitboard.bit_at(planes["diff"][6], flat))
+        dcut = bg.deg[flat] - 2 * dd
+        accept = _accept_decision(spec, params, state.move_clock, dcut,
+                                  any_valid, kacc)
+        popv = bg.pop[0] * accept.astype(jnp.int32)  # uniform pop (gated)
+        sgn = jnp.where(d_from == 0, 1, -1)
+        dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
+        dist_pop = dist_pop.at[:, 1].add(popv * sgn)
+        state = _commit_transition(
+            state, params, bitboard.flip_bit(state.board, flat, accept),
+            dist_pop, flat, d_to, dcut, accept, any_valid)
+        return (state, ct_e_sl, ct_s_sl), (out if collect else {}, log)
+
+    nw = bitboard.n_words(n)
+    slices = max(chunk.bit_length(), 1)
+    loop_state = loop_state.replace(
+        board=bitboard.pack_bits(loop_state.board))
+    ct0 = (bitboard.counter_init(c, nw, slices),
+           bitboard.counter_init(c, nw, slices))
+    (loop_state, ct_e_sl, ct_s_sl), (outs, logs) = jax.lax.scan(
+        body, (loop_state, *ct0), None, length=chunk)
+    loop_state = loop_state.replace(
+        board=bitboard.unpack_bits(loop_state.board, n))
+    return (loop_state, outs, logs,
+            bitboard.counter_fold(ct_e_sl, n),
+            bitboard.counter_fold(ct_s_sl, n))
+
+
 @functools.partial(jax.jit, static_argnames=("spec", "chunk", "collect"))
 def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
                     state: BoardState, chunk: int, collect: bool = True):
@@ -584,23 +673,31 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
     loop_state = state.replace(
         **{k: None for k in _BOOKKEEPING})
 
-    def body(carry, _):
-        state, ct_e16, ct_s16 = carry
-        key, kprop, kacc, kwait = _split4(state.key)
-        state = state.replace(key=key)
-        planes = _planes(bg, spec, params, state)
-        cur_wait = _complete_wait(spec, state, planes["b_count"], kwait, n)
-        state, ct_e16, ct_s16, out, log = _record(
-            bg, spec, params, state, ct_e16, ct_s16, planes, cur_wait)
-        state = _transition(bg, spec, params, state, planes, kprop, kacc)
-        return (state, ct_e16, ct_s16), (out if collect else {}, log)
+    if bitboard.supported(bg, spec):
+        (loop_state, outs, logs, cte, cts) = _scan_bits(
+            bg, spec, params, loop_state, chunk, collect)
+        big["cut_times_e"] = big["cut_times_e"] + cte
+        big["cut_times_s"] = big["cut_times_s"] + cts
+    else:
+        def body(carry, _):
+            state, ct_e16, ct_s16 = carry
+            key, kprop, kacc, kwait = _split4(state.key)
+            state = state.replace(key=key)
+            planes = _planes(bg, spec, params, state)
+            cur_wait = _complete_wait(spec, state, planes["b_count"],
+                                      kwait, n)
+            state, ct_e16, ct_s16, out, log = _record(
+                bg, spec, params, state, ct_e16, ct_s16, planes, cur_wait)
+            state = _transition(bg, spec, params, state, planes, kprop,
+                                kacc)
+            return (state, ct_e16, ct_s16), (out if collect else {}, log)
 
-    ct16 = (jnp.zeros((c, n), jnp.int16), jnp.zeros((c, n), jnp.int16))
-    (loop_state, ct_e16, ct_s16), (outs, logs) = jax.lax.scan(
-        body, (loop_state, *ct16), None, length=chunk)
+        ct16 = (jnp.zeros((c, n), jnp.int16), jnp.zeros((c, n), jnp.int16))
+        (loop_state, ct_e16, ct_s16), (outs, logs) = jax.lax.scan(
+            body, (loop_state, *ct16), None, length=chunk)
+        big["cut_times_e"] = big["cut_times_e"] + ct_e16
+        big["cut_times_s"] = big["cut_times_s"] + ct_s16
 
-    big["cut_times_e"] = big["cut_times_e"] + ct_e16
-    big["cut_times_s"] = big["cut_times_s"] + ct_s16
     if spec.parity_metrics:
         big["part_sum"], big["last_flipped"], big["num_flips"] = \
             apply_flip_log(big["part_sum"], big["last_flipped"],

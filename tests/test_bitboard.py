@@ -1,0 +1,132 @@
+"""Bit-board backend verification (kernel/bitboard.py).
+
+The backend promises BIT-IDENTICAL trajectories to the int8 board body —
+same PRNG stream, same m-th-valid selection, same acceptance arithmetic —
+so the primary test runs the same chunk through both bodies and asserts
+every state field, history row, and bookkeeping plane equal. Plus unit
+tests of the packing/shifting/counter primitives against numpy.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.kernel import bitboard as bb
+from flipcomplexityempirical_tpu.kernel import board as kb
+
+
+def test_pack_unpack_roundtrip(rng):
+    for n in (5, 32, 64, 100, 256):
+        plane = rng.integers(0, 2, size=(3, n)).astype(np.int8)
+        words = bb.pack_bits(jnp.asarray(plane))
+        assert words.shape == (3, bb.n_words(n))
+        back = bb.unpack_bits(words, n)
+        np.testing.assert_array_equal(np.asarray(back), plane)
+
+
+def test_shifts_match_numpy(rng):
+    n = 200
+    plane = rng.integers(0, 2, size=(2, n)).astype(np.int8)
+    words = bb.pack_bits(jnp.asarray(plane))
+    nw = bb.n_words(n)
+    padded = np.pad(plane, ((0, 0), (0, nw * 32 - n)))
+    for k in (1, 31, 32, 33, 63, 64, 65):
+        down = np.zeros_like(padded)
+        down[:, :padded.shape[1] - k] = padded[:, k:]
+        got = bb.unpack_bits(bb.shift_down(words, k), nw * 32)
+        np.testing.assert_array_equal(np.asarray(got), down, err_msg=f"down {k}")
+        up = np.zeros_like(padded)
+        up[:, k:] = padded[:, :padded.shape[1] - k]
+        got = bb.unpack_bits(bb.shift_up(words, k), nw * 32)
+        np.testing.assert_array_equal(np.asarray(got), up, err_msg=f"up {k}")
+
+
+def test_bit_sliced_counters(rng):
+    c, n, t = 3, 70, 37
+    planes = rng.integers(0, 2, size=(t, c, n)).astype(np.int8)
+    slices = bb.counter_init(c, bb.n_words(n), t.bit_length())
+    for r in range(t):
+        slices = bb.counter_add(slices, bb.pack_bits(jnp.asarray(planes[r])))
+    got = bb.counter_fold(slices, n)
+    np.testing.assert_array_equal(np.asarray(got), planes.sum(0))
+
+
+def test_select_flat_picks_mth_valid(rng):
+    g = fce.graphs.square_grid(6, 32)
+    bg = kb.make_board_graph(g)
+    c, n = 16, 192
+    valid = rng.integers(0, 2, size=(c, n)).astype(bool)
+    valid[0] = False                                   # exhausted chain
+    u = rng.random(c).astype(np.float32)
+    flat, any_valid = bb.select_flat(bg, bb.pack_bits(jnp.asarray(valid)),
+                                     jnp.asarray(u))
+    flat = np.asarray(flat)
+    for ci in range(c):
+        idxs = np.flatnonzero(valid[ci])
+        if len(idxs) == 0:
+            assert not bool(np.asarray(any_valid)[ci])
+            continue
+        m = min(int(np.float32(u[ci]) * np.float32(len(idxs))),
+                len(idxs) - 1)
+        assert flat[ci] == idxs[m], ci
+
+
+@pytest.mark.parametrize("hw,spec_kw", [
+    ((6, 32), {}),
+    ((4, 64), {}),
+    ((6, 32), dict(accept="always")),
+    ((6, 32), dict(contiguity="none")),
+    ((6, 32), dict(geom_waits=False, parity_metrics=False)),
+])
+def test_bit_identity_vs_int8_body(rng, hw, spec_kw):
+    """The dispatch and the promise: on a supported workload the jitted
+    chunk (bit body) equals the int8 body run eagerly with the bit gate
+    off — field for field, including histories and bookkeeping planes."""
+    h, w = hw
+    g = fce.graphs.square_grid(h, w)
+    plan = fce.graphs.stripes_plan(g, 2)
+    kw = dict(n_districts=2, proposal="bi", contiguity="patch",
+              invalid="repropose", accept="cut", parity_metrics=True,
+              geom_waits=True, record_interface=False)
+    kw.update(spec_kw)
+    spec = fce.Spec(**kw)
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=8, seed=11, spec=spec, base=1.7, pop_tol=0.3)
+    assert bb.supported(bg, spec)
+
+    got_state, got_outs = kb.run_board_chunk(bg, spec, params, st, 75)
+
+    orig = bb.supported
+    try:
+        bb.supported = lambda *_: False
+        want_state, want_outs = kb.run_board_chunk.__wrapped__(
+            bg, spec, params, st, 75)
+    finally:
+        bb.supported = orig
+
+    for f in st.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got_state, f)),
+            np.asarray(getattr(want_state, f)), err_msg=f)
+    for k in want_outs:
+        np.testing.assert_array_equal(np.asarray(got_outs[k]),
+                                      np.asarray(want_outs[k]), err_msg=k)
+
+
+def test_dispatch_gates():
+    g = fce.graphs.square_grid(6, 32)
+    bg = kb.make_board_graph(g)
+    assert bg.uniform_pop
+    assert not bb.supported(bg, fce.Spec(accept="corrected"))
+    assert not bb.supported(bg, fce.Spec(record_assignment_bits=True))
+    g2 = fce.graphs.square_grid(8, 8)          # w % 32 != 0
+    assert not bb.supported(kb.make_board_graph(g2), fce.Spec())
+    # non-uniform population defeats the scalar pop gate
+    import dataclasses
+    g3 = dataclasses.replace(
+        g, pop=np.arange(g.n_nodes, dtype=np.int64) % 3 + 1)
+    bg3 = kb.make_board_graph(g3)
+    assert not bg3.uniform_pop
+    assert not bb.supported(bg3, fce.Spec())

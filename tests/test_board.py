@@ -172,6 +172,38 @@ def test_apply_flip_log_matches_sequential(rng):
         np.testing.assert_array_equal(np.asarray(g_arr), w_arr, err_msg=name)
 
 
+def test_apply_flip_log_benchmark_scale(rng):
+    """Exactness at the headline shapes: N > 128 exercises the two-level
+    node factorization (n = x*128 + y), tlen > 256 exercises weight
+    magnitudes past bf16's exact-integer range, and big t0 exercises the
+    chunk-relative carry correction (absolute yields ~1e5)."""
+    tlen, c, n = 300, 3, 4096
+    log_f, log_s = _random_log(rng, tlen, c, n)
+    t0 = np.full(c, 100_000, np.int32)
+    ps0 = rng.integers(-10 ** 5, 10 ** 5, size=(c, n)).astype(np.int32)
+    lf0 = rng.integers(0, 100_000, size=(c, n)).astype(np.int32)
+    nf0 = rng.integers(0, 1000, size=(c, n)).astype(np.int32)
+
+    want = _replay_sequential(ps0, lf0, nf0, log_f, log_s, t0)
+    got = kb.apply_flip_log(jnp.asarray(ps0), jnp.asarray(lf0),
+                            jnp.asarray(nf0), jnp.asarray(log_f),
+                            jnp.asarray(log_s), jnp.asarray(t0))
+    for w_arr, g_arr, name in zip(want, got,
+                                  ("part_sum", "last_flipped", "num_flips")):
+        np.testing.assert_array_equal(np.asarray(g_arr), w_arr, err_msg=name)
+
+
+def test_apply_flip_log_key_overflow_guard():
+    n = 70_000
+    with pytest.raises(ValueError, match="overflows int32"):
+        kb.apply_flip_log(jnp.zeros((1, n), jnp.int32),
+                          jnp.zeros((1, n), jnp.int32),
+                          jnp.zeros((1, n), jnp.int32),
+                          jnp.zeros((32_000, 1), jnp.int32),
+                          jnp.zeros((32_000, 1), jnp.int32),
+                          jnp.zeros(1, jnp.int32))
+
+
 def test_apply_flip_log_chunked_composition(rng):
     """Splitting a log at an arbitrary boundary (including mid-run) and
     applying the pieces sequentially gives the same result as one piece."""
