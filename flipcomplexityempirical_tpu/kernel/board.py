@@ -44,6 +44,11 @@ and the memory system:
 - cut_times accumulates in chunk-local int16 planes (chunk <= 32767
   asserted) folded into the int32 state once per chunk — half the HBM
   traffic of the per-step int32 read-modify-write.
+- On uniform-population boards whose width is a multiple of 32, the whole
+  scan body switches to the bit-board backend (``kernel/bitboard.py``):
+  board and planes packed 32 cells per uint32 lane, cut_times in
+  bit-sliced ripple-carry counters — bit-identical trajectories at a
+  fraction of the plane traffic (``tests/test_bitboard.py``).
 
 Reference semantics preserved (same quirk set as kernel/step.py):
 - uniform boundary-node proposal, flip to the other district
