@@ -50,6 +50,8 @@ def supported(bg, spec: Spec) -> bool:
     return (
         bool(bg.uniform_pop)
         and bg.w % 32 == 0
+        and spec.n_districts == 2
+        and spec.proposal == "bi"
         and spec.accept in ("cut", "always")
         and spec.contiguity in ("patch", "none")
         and not spec.record_assignment_bits

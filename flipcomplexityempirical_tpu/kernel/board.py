@@ -44,11 +44,17 @@ and the memory system:
 - cut_times accumulates in chunk-local int16 planes (chunk <= 32767
   asserted) folded into the int32 state once per chunk — half the HBM
   traffic of the per-step int32 read-modify-write.
-- On uniform-population boards whose width is a multiple of 32, the whole
-  scan body switches to the bit-board backend (``kernel/bitboard.py``):
-  board and planes packed 32 cells per uint32 lane, cut_times in
-  bit-sliced ripple-carry counters — bit-identical trajectories at a
-  fraction of the plane traffic (``tests/test_bitboard.py``).
+- On uniform-population 2-district 'bi' workloads whose width is a
+  multiple of 32, the whole scan body switches to the bit-board backend
+  (``kernel/bitboard.py``): board and planes packed 32 cells per uint32
+  lane, cut_times in bit-sliced ripple-carry counters — bit-identical
+  trajectories at a fraction of the plane traffic
+  (``tests/test_bitboard.py``).
+- The k-district 'pair' proposal (slow_reversible_propose semantics,
+  grid_chain_sec11.py:117-130) has its own int8 body: per-(node,
+  direction) pair validity planes with district dedup, selection over
+  the (N*4)-slot row-major mask, population gates as per-chain district
+  bitmasks (``tests/test_board_pair.py``).
 
 Reference semantics preserved (same quirk set as kernel/step.py):
 - uniform boundary-node proposal, flip to the other district
@@ -115,8 +121,8 @@ class BoardState:
     ``cut_times_s[c, i]`` of edge (i, i+W)."""
 
     key: jnp.ndarray           # uint32[C, 2] per-chain PRNG keys
-    board: jnp.ndarray         # int8[C, N] district 0/1
-    dist_pop: jnp.ndarray      # int32[C, 2]
+    board: jnp.ndarray         # int8[C, N] district 0..K-1 (0/1 for 'bi')
+    dist_pop: jnp.ndarray      # int32[C, K]
     cut_count: jnp.ndarray     # int32[C]
     cur_wait: jnp.ndarray      # f32[C] memoized geometric wait
     wait_pending: jnp.ndarray  # bool[C] accepted move awaits its wait sample
@@ -170,18 +176,28 @@ def board_shape(graph: LatticeGraph):
 def supports(graph: LatticeGraph, spec: Spec) -> bool:
     """True iff this kernel reproduces run_chains semantics exactly for
     (graph, spec). Everything outside falls back to the general path."""
+    if spec.n_districts == 2 and spec.proposal == "bi":
+        prop_ok = spec.accept in ("cut", "corrected", "always")
+    elif spec.proposal == "pair" and 2 <= spec.n_districts <= 31:
+        # k-district pair walk (slow_reversible_propose): the pair body
+        # needs uniform node population (its per-district bound test is a
+        # per-chain bitmask) and has no reversibility-corrected accept
+        pop = np.asarray(graph.pop)
+        prop_ok = (spec.accept in ("cut", "always")
+                   and pop.size > 0 and bool((pop == pop[0]).all()))
+    else:
+        return False
     return (
-        board_shape(graph) is not None
-        and spec.n_districts == 2
-        and spec.proposal == "bi"
+        prop_ok
+        and board_shape(graph) is not None
         and spec.contiguity in ("patch", "none")
         and spec.invalid == "repropose"
-        and spec.accept in ("cut", "corrected", "always")
         and spec.anneal in ("none", "linear")
         and not spec.frame_interface
         and not spec.weighted_cut
         and not spec.record_interface
-        and (not spec.record_assignment_bits or graph.n_nodes <= 32)
+        and (not spec.record_assignment_bits
+             or (graph.n_nodes <= 32 and spec.n_districts == 2))
     )
 
 
@@ -314,7 +330,8 @@ def _complete_wait(spec: Spec, state: BoardState, b_count, kwait,
                    n_nodes: int):
     if not spec.geom_waits:
         return state.cur_wait
-    w = jax.vmap(lambda k, b: sample_geom_minus1(k, b, n_nodes, 2))(
+    nd = spec.n_districts
+    w = jax.vmap(lambda k, b: sample_geom_minus1(k, b, n_nodes, nd))(
         kwait, b_count)
     return jnp.where(state.wait_pending, w, state.cur_wait)
 
@@ -395,6 +412,46 @@ def _record(bg: BoardGraph, spec: Spec, params: StepParams,
     return state, ct_e16, ct_s16, out, log
 
 
+def _select_two_level(valid, u, n_rows: int, row_w: int):
+    """Index of the (m+1)-th True cell of a row-major (C, n_rows*row_w)
+    boolean mask, for m uniform on the True count — with BOTH selection
+    levels on the MXU so the hot loop has no big gather and no big cumsum:
+
+    1. rowcnt[c, x] = valid @ block-indicator (bf16 products, exact f32
+       accumulation), tiny (C, n_rows) cumsum picks the row;
+    2. vrow[c, y] = (valid & onehot-row) @ column-indicator — with
+       exactly one row unmasked the column sums ARE that row's cells,
+       so this doubles as the row extraction. (jnp.take_along_axis
+       here lowered to a kCustom gather that ran ~3 ms/step; a flat
+       (C, N) cumsum lowered to ~0.9 ms of reduce-window passes.)
+
+    Returns (flat, any_valid)."""
+    c, n = valid.shape
+    cidx = jnp.arange(c)
+    block = (jnp.arange(n)[:, None] // row_w
+             == jnp.arange(n_rows)[None, :]).astype(jnp.bfloat16)
+    colsel = (jnp.arange(n)[:, None] % row_w
+              == jnp.arange(row_w)[None, :]).astype(jnp.bfloat16)
+    valid_bf = valid.astype(jnp.bfloat16)
+    rowcnt = jnp.dot(valid_bf, block,
+                     preferred_element_type=jnp.float32).astype(jnp.int32)
+    rowcum = jnp.cumsum(rowcnt, axis=1)                    # (C, n_rows)
+    total = rowcum[:, -1]                                  # (C,)
+    any_valid = total > 0
+    m = jnp.minimum((u * total.astype(jnp.float32)).astype(jnp.int32),
+                    jnp.maximum(total - 1, 0))
+    row = jnp.argmax(rowcum > m[:, None], axis=1).astype(jnp.int32)
+    before = jnp.where(row > 0,
+                       rowcum[cidx, jnp.maximum(row - 1, 0)], 0)
+    m_in_row = m - before
+    rowmask = ((jnp.arange(n) // row_w)[None, :] == row[:, None])
+    vrow = jnp.dot(jnp.where(rowmask, valid_bf, jnp.bfloat16(0)), colsel,
+                   preferred_element_type=jnp.float32) > 0.5
+    colcum = jnp.cumsum(vrow.astype(jnp.int32), axis=1)
+    col = jnp.argmax(colcum > m_in_row[:, None], axis=1).astype(jnp.int32)
+    return row * row_w + col, any_valid
+
+
 def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
                 state: BoardState, planes, kprop, kacc):
     """Propose (single masked draw == re-propose-until-valid), accept,
@@ -402,41 +459,9 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     c, n = state.board.shape
     h, w = bg.h, bg.w
     cidx = jnp.arange(c)
-    valid = planes["valid"]
 
-    # Two-level prefix selection of the (m+1)-th valid cell (row-major
-    # order), with BOTH levels on the MXU so the hot loop has no big
-    # gather and no big cumsum:
-    #   1. rowcnt[c, x] = valid @ block-indicator  (bf16, counts <= W
-    #      exact), tiny (C, H) cumsum picks the row;
-    #   2. vrow[c, y]  = (valid & onehot-row) @ column-indicator — with
-    #      exactly one row unmasked the column sums ARE that row's cells,
-    #      so this doubles as the row extraction. (jnp.take_along_axis
-    #      here lowered to a kCustom gather that ran ~3 ms/step; a flat
-    #      (C, N) cumsum lowered to ~0.9 ms of reduce-window passes.)
-    block = (jnp.arange(n)[:, None] // w
-             == jnp.arange(h)[None, :]).astype(jnp.bfloat16)
-    colsel = (jnp.arange(n)[:, None] % w
-              == jnp.arange(w)[None, :]).astype(jnp.bfloat16)
-    valid_bf = valid.astype(jnp.bfloat16)
-    rowcnt = jnp.dot(valid_bf, block,
-                     preferred_element_type=jnp.float32).astype(jnp.int32)
-    rowcum = jnp.cumsum(rowcnt, axis=1)                    # (C, H)
-    total = rowcum[:, -1]                                  # (C,)
-    any_valid = total > 0
-    u = _uniform(kprop)
-    m = jnp.minimum((u * total.astype(jnp.float32)).astype(jnp.int32),
-                    jnp.maximum(total - 1, 0))
-    row = jnp.argmax(rowcum > m[:, None], axis=1).astype(jnp.int32)
-    before = jnp.where(row > 0,
-                       rowcum[cidx, jnp.maximum(row - 1, 0)], 0)
-    m_in_row = m - before
-    rowmask = ((jnp.arange(n) // w)[None, :] == row[:, None])
-    vrow = jnp.dot(jnp.where(rowmask, valid_bf, jnp.bfloat16(0)), colsel,
-                   preferred_element_type=jnp.float32) > 0.5   # (C, W)
-    colcum = jnp.cumsum(vrow.astype(jnp.int32), axis=1)
-    col = jnp.argmax(colcum > m_in_row[:, None], axis=1).astype(jnp.int32)
-    flat = row * w + col
+    flat, any_valid = _select_two_level(planes["valid"], _uniform(kprop),
+                                        h, w)
 
     d_from = state.board[cidx, flat].astype(jnp.int32)
     d_to = 1 - d_from
@@ -491,6 +516,133 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     sgn = jnp.where(d_from == 0, 1, -1)       # moving out of 0 => 0 loses
     dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
     dist_pop = dist_pop.at[:, 1].add(popv * sgn)
+
+    return _commit_transition(state, params, board, dist_pop, flat, d_to,
+                              dcut, accept, any_valid)
+
+
+# ---------------------------------------------------------------------------
+# k-district pair proposal (slow_reversible_propose semantics)
+# ---------------------------------------------------------------------------
+
+_PAIR_DIRS = 4          # rook directions, fixed order E, S, W, N
+
+
+def _nbr_value_planes(bg: BoardGraph, board):
+    """Rook-neighbor district-id planes (pad/absent = -1), with their
+    existence masks, in E, S, W, N order."""
+    w, n = bg.w, bg.n
+    p = jnp.pad(board, ((0, 0), (w, w)), constant_values=-1)
+
+    def nv(o):
+        return p[:, w + o: w + o + n]
+
+    south_ok = (jnp.arange(n) < (bg.h - 1) * bg.w)[None]
+    north_ok = (jnp.arange(n) >= bg.w)[None]
+    return [(nv(1), bg.east_ok[None]), (nv(w), south_ok),
+            (nv(-1), bg.west_ok[None]), (nv(-w), north_ok)]
+
+
+def _planes_pair(bg: BoardGraph, spec: Spec, params: StepParams,
+                 state: BoardState):
+    """Per-(node, direction) pair validity for the k-district proposal
+    (slow_reversible_propose, grid_chain_sec11.py:117-130): uniform over
+    DISTINCT (boundary node, adjacent district != own) pairs. A direction
+    carries a pair iff its neighbor exists, differs from the node's
+    district, and no earlier direction saw the same district (dedup —
+    the reference's b_nodes pair updater is a SET)."""
+    board = state.board
+    nbrs = _nbr_value_planes(bg, board)
+    same = same_planes(bg, board)
+
+    diff = []
+    for (v, ex), s in zip(nbrs, (same[0], same[2], same[4], same[6])):
+        diff.append(ex & ~s)
+    b_mask = diff[0] | diff[1] | diff[2] | diff[3]
+    b_count = b_mask.sum(axis=1, dtype=jnp.int32)
+    south_ok = jnp.arange(bg.n) < (bg.h - 1) * bg.w
+    cut_e = bg.east_ok[None] & ~same[0]
+    cut_s = south_ok[None] & ~same[2]
+
+    if spec.contiguity == "patch":
+        contig = ring_contig_ok(same)
+    else:
+        contig = jnp.ones_like(b_mask)
+
+    # population gate per district as one bitmask per chain (uniform node
+    # population — supports() gates non-uniform pop off this path): bit d
+    # of from_bits[c] = "district d may lose one unit", of to_bits[c] =
+    # "may gain one unit"; plane tests are variable-shift extracts.
+    k = spec.n_districts
+    unit = bg.pop[0].astype(jnp.float32)
+    dp = state.dist_pop.astype(jnp.float32)             # (C, K)
+    from_ok = dp - unit >= params.pop_lo[:, None]       # (C, K) bool
+    to_ok = dp + unit <= params.pop_hi[:, None]
+    weights = (jnp.int32(1) << jnp.arange(k, dtype=jnp.int32))[None, :]
+    from_bits = jnp.sum(jnp.where(from_ok, weights, 0), axis=1,
+                        dtype=jnp.int32)                # (C,)
+    to_bits = jnp.sum(jnp.where(to_ok, weights, 0), axis=1,
+                      dtype=jnp.int32)
+    ok_from = ((from_bits[:, None] >> board.astype(jnp.int32)) & 1) == 1
+
+    pairs = []
+    for j, (v, ex) in enumerate(nbrs):
+        pj = diff[j]
+        for jp in range(j):
+            vp, exp = nbrs[jp]
+            pj &= ~(exp & (vp == v))                    # dedup districts
+        vi = jnp.maximum(v.astype(jnp.int32), 0)
+        ok_to = ((to_bits[:, None] >> vi) & 1) == 1
+        pairs.append(pj & contig & ok_from & ok_to)
+
+    # row-major (node, direction) interleave: flat' = v*4 + j
+    valid = jnp.stack(pairs, axis=2).reshape(board.shape[0], -1)
+    return dict(valid=valid, b_count=b_count, cut_e=cut_e, cut_s=cut_s)
+
+
+def _transition_pair(bg: BoardGraph, spec: Spec, params: StepParams,
+                     state: BoardState, planes, kprop, kacc):
+    """Pair-proposal transition: select the m-th valid (node, direction)
+    slot, flip the node to that direction's neighbor district."""
+    c, n = state.board.shape
+    h, w = bg.h, bg.w
+    cidx = jnp.arange(c)
+
+    flat4, any_valid = _select_two_level(
+        planes["valid"], _uniform(kprop), h, w * _PAIR_DIRS)
+    flat = flat4 // _PAIR_DIRS
+    j = flat4 % _PAIR_DIRS
+
+    offs = jnp.asarray([1, w, -1, -w], jnp.int32)
+    u_idx = jnp.clip(flat + offs[j], 0, n - 1)
+    board_i = state.board.astype(jnp.int32)
+    d_from = board_i[cidx, flat]
+    d_to = board_i[cidx, u_idx]          # the chosen direction's district
+
+    # dcut from v's rook neighborhood: each existing neighbor u changes
+    # the edge (v,u) cut state per (a(u) != d_to) - (a(u) != d_from)
+    south_ok = jnp.arange(n) < (bg.h - 1) * bg.w
+    north_ok = jnp.arange(n) >= bg.w
+    masks = (bg.east_ok, south_ok, bg.west_ok, north_ok)
+    dcut = jnp.zeros(c, jnp.int32)
+    for off, ok in zip((1, w, -1, -w), masks):
+        ui = jnp.clip(flat + off, 0, n - 1)
+        au = board_i[cidx, ui]
+        ex = ok[flat]
+        dcut += jnp.where(ex, (au != d_to).astype(jnp.int32)
+                          - (au != d_from).astype(jnp.int32), 0)
+
+    accept = _accept_decision(spec, params, state.move_clock, dcut,
+                              any_valid, kacc)
+    sel = (jnp.arange(n)[None, :] == flat[:, None]) & accept[:, None]
+    board = jnp.where(sel, d_to[:, None].astype(state.board.dtype),
+                      state.board)
+    popv = bg.pop[flat] * accept.astype(jnp.int32)
+    k = spec.n_districts
+    oh_to = jnp.arange(k)[None, :] == d_to[:, None]
+    oh_from = jnp.arange(k)[None, :] == d_from[:, None]
+    dist_pop = state.dist_pop + popv[:, None] * (
+        oh_to.astype(jnp.int32) - oh_from.astype(jnp.int32))
 
     return _commit_transition(state, params, board, dist_pop, flat, d_to,
                               dcut, accept, any_valid)
@@ -684,17 +836,22 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
         big["cut_times_e"] = big["cut_times_e"] + cte
         big["cut_times_s"] = big["cut_times_s"] + cts
     else:
+        make_planes = (_planes_pair if spec.proposal == "pair"
+                       else _planes)
+        make_transition = (_transition_pair if spec.proposal == "pair"
+                           else _transition)
+
         def body(carry, _):
             state, ct_e16, ct_s16 = carry
             key, kprop, kacc, kwait = _split4(state.key)
             state = state.replace(key=key)
-            planes = _planes(bg, spec, params, state)
+            planes = make_planes(bg, spec, params, state)
             cur_wait = _complete_wait(spec, state, planes["b_count"],
                                       kwait, n)
             state, ct_e16, ct_s16, out, log = _record(
                 bg, spec, params, state, ct_e16, ct_s16, planes, cur_wait)
-            state = _transition(bg, spec, params, state, planes, kprop,
-                                kacc)
+            state = make_transition(bg, spec, params, state, planes, kprop,
+                                    kacc)
             return (state, ct_e16, ct_s16), (out if collect else {}, log)
 
         ct16 = (jnp.zeros((c, n), jnp.int16), jnp.zeros((c, n), jnp.int16))
@@ -721,7 +878,8 @@ def record_final(bg: BoardGraph, spec: Spec, params: StepParams,
     loop_state = state.replace(**{k: None for k in _BOOKKEEPING})
     key, _, _, kwait = _split4(loop_state.key)
     loop_state = loop_state.replace(key=key)
-    planes = _planes(bg, spec, params, loop_state)
+    planes = (_planes_pair if spec.proposal == "pair" else _planes)(
+        bg, spec, params, loop_state)
     cur_wait = _complete_wait(spec, loop_state, planes["b_count"], kwait,
                               bg.n)
     ct16 = (jnp.zeros_like(big["cut_times_e"], jnp.int16),
@@ -748,10 +906,10 @@ def init_board_state(graph: LatticeGraph, bg: BoardGraph,
     n = bg.n
     a0 = np.asarray(assignment, np.int8)
     board = jnp.broadcast_to(jnp.asarray(a0), (n_chains, n))
-    pop0 = int(graph.pop[a0 == 0].sum())
-    pop1 = int(graph.pop.sum()) - pop0
-    dist_pop = jnp.broadcast_to(
-        jnp.asarray([pop0, pop1], jnp.int32), (n_chains, 2))
+    pops = np.bincount(a0.astype(np.int64), weights=graph.pop,
+                       minlength=spec.n_districts).astype(np.int32)
+    dist_pop = jnp.broadcast_to(jnp.asarray(pops),
+                                (n_chains, spec.n_districts))
     keys = jax.random.key_data(
         jax.random.split(jax.random.PRNGKey(seed), n_chains))
     label_values = np.asarray(params.label_values)

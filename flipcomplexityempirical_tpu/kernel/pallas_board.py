@@ -61,6 +61,8 @@ def supports(graph: LatticeGraph, spec: Spec, params: StepParams,
     batch."""
     lv = np.asarray(params.label_values)
     return (_board_supports(graph, spec)
+            and spec.n_districts == 2
+            and spec.proposal == "bi"
             and spec.accept == "cut"
             and spec.anneal == "none"
             and lv.shape == (2,) and lv[0] == 1 and lv[1] == -1
@@ -426,6 +428,10 @@ def check(spec: Spec, params: StepParams, n_chains: int,
     """Raise unless this kernel reproduces the requested semantics —
     the Pallas path hardcodes the cut-Metropolis acceptance and the
     reference +1/-1 labels, a strict subset of board.supports()."""
+    if spec.n_districts != 2 or spec.proposal != "bi":
+        raise ValueError("pallas path requires the 2-district 'bi' "
+                         f"proposal, got k={spec.n_districts} "
+                         f"proposal={spec.proposal!r}")
     if spec.accept != "cut":
         raise ValueError(f"pallas path requires accept='cut', "
                          f"got {spec.accept!r}")

@@ -18,7 +18,8 @@ from ..graphs.lattice import LatticeGraph
 from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
-from .runner import RunResult, pick_chunk, pop_bounds
+from .runner import (RunResult, default_label_values, pick_chunk,
+                     pop_bounds)
 
 
 def init_board(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
@@ -30,7 +31,7 @@ def init_board(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
         raise ValueError(
             f"board path does not support (graph={graph.name!r}, {spec})")
     if label_values is None:
-        label_values = [1, -1]
+        label_values = default_label_values(spec.n_districts)
     lo, hi = pop_bounds(graph, spec.n_districts, pop_tol)
     params = kstep.make_params(base, lo, hi, label_values, beta=beta,
                                n_chains=n_chains)

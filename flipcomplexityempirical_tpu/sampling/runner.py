@@ -56,6 +56,12 @@ def pop_bounds(graph: LatticeGraph, k: int, tol: float):
     return (1.0 - tol) * ideal, (1.0 + tol) * ideal
 
 
+def default_label_values(k: int):
+    """The reference's district labels: signed +1/-1 for 2 districts
+    (grid_chain_sec11.py's cddict values), plain indices otherwise."""
+    return [1, -1] if k == 2 else list(range(k))
+
+
 def init_batch(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
                seed: int, spec: Spec, base: float, pop_tol: float,
                label_values=None, beta=1.0) -> tuple:
@@ -63,7 +69,7 @@ def init_batch(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
     dg = graph.device()
     k = spec.n_districts
     if label_values is None:
-        label_values = [1, -1] if k == 2 else list(range(k))
+        label_values = default_label_values(k)
     label_values = jnp.asarray(label_values, jnp.int32)
     lo, hi = pop_bounds(graph, k, pop_tol)
     params = kstep.make_params(base, lo, hi, label_values, beta=beta,

@@ -323,7 +323,12 @@ def test_supports_gates():
     assert not kb.supports(fce.graphs.frankengraph(), spec)
     g = fce.graphs.square_grid(6, 6)
     assert not kb.supports(g, fce.Spec(contiguity="exact"))
-    assert not kb.supports(g, fce.Spec(proposal="pair"))
+    # the k-district pair walk has its own body (uniform pop, no
+    # corrected accept) — tests/test_board_pair.py
+    assert kb.supports(g, fce.Spec(proposal="pair", n_districts=4))
+    assert not kb.supports(g, fce.Spec(proposal="pair", n_districts=4,
+                                       accept="corrected"))
+    assert not kb.supports(g, fce.Spec(proposal="pair", n_districts=40))
     assert not kb.supports(g, fce.Spec(invalid="selfloop"))
     assert not kb.supports(g, fce.Spec(record_interface=True))
     assert kb.supports(g, fce.Spec(accept="corrected"))
