@@ -32,10 +32,19 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (jax.config, which works "
                          "even where JAX_PLATFORMS env is pre-pinned)")
+    ap.add_argument("--jax-cache", metavar="DIR", default=None,
+                    help="persistent XLA compilation cache directory: "
+                         "sweep re-runs and resumed runs skip the "
+                         "~30-60s/config compile (cache keys cover "
+                         "graph shape, spec, and chain count)")
     args = ap.parse_args()
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if args.jax_cache:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", args.jax_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     sweep = sec11_sweep if args.family == "sec11" else frank_sweep
     configs = list(sweep(total_steps=args.steps, n_chains=args.chains,

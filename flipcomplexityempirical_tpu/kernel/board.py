@@ -558,8 +558,6 @@ def _planes_pair(bg: BoardGraph, spec: Spec, params: StepParams,
     diff = []
     for (v, ex), s in zip(nbrs, (same[0], same[2], same[4], same[6])):
         diff.append(ex & ~s)
-    b_mask = diff[0] | diff[1] | diff[2] | diff[3]
-    b_count = b_mask.sum(axis=1, dtype=jnp.int32)
     south_ok = jnp.arange(bg.n) < (bg.h - 1) * bg.w
     cut_e = bg.east_ok[None] & ~same[0]
     cut_s = south_ok[None] & ~same[2]
@@ -567,7 +565,7 @@ def _planes_pair(bg: BoardGraph, spec: Spec, params: StepParams,
     if spec.contiguity == "patch":
         contig = ring_contig_ok(same)
     else:
-        contig = jnp.ones_like(b_mask)
+        contig = jnp.ones_like(diff[0])
 
     # population gate per district as one bitmask per chain (uniform node
     # population — supports() gates non-uniform pop off this path): bit d
@@ -586,11 +584,16 @@ def _planes_pair(bg: BoardGraph, spec: Spec, params: StepParams,
     ok_from = ((from_bits[:, None] >> board.astype(jnp.int32)) & 1) == 1
 
     pairs = []
+    b_count = jnp.zeros(board.shape[0], jnp.int32)
     for j, (v, ex) in enumerate(nbrs):
         pj = diff[j]
         for jp in range(j):
             vp, exp = nbrs[jp]
             pj &= ~(exp & (vp == v))                    # dedup districts
+        # |b_nodes| for the pair walk is the DISTINCT-PAIR count (the
+        # reference's pair updater feeding geom_wait), before the
+        # validity gates — one count per deduped slot
+        b_count = b_count + pj.sum(axis=1, dtype=jnp.int32)
         vi = jnp.maximum(v.astype(jnp.int32), 0)
         ok_to = ((to_bits[:, None] >> vi) & 1) == 1
         pairs.append(pj & contig & ok_from & ok_to)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..graphs.lattice import DeviceGraph
+from ..state import chain_state
 from ..state.chain_state import ChainState
 from . import contiguity
 
@@ -176,11 +177,7 @@ def _sample_pair(key, dg: DeviceGraph, state: ChainState, k: int):
     (grid_chain_sec11.py:117-130, the k-district move set). One uniform +
     prefix-sum selection over the flattened (N, K) pair mask."""
     a = state.assignment.astype(jnp.int32)
-    nbr_a = a[dg.nbr]                                        # (N, D)
-    onehot = jax.nn.one_hot(nbr_a, k, dtype=jnp.bool_)       # (N, D, K)
-    onehot = onehot & dg.nbr_mask[:, :, None]
-    has_part = onehot.any(axis=1)                            # (N, K)
-    pair_mask = (has_part & (jnp.arange(k)[None, :] != a[:, None])).reshape(-1)
+    pair_mask = chain_state.pair_move_mask(dg, a, k).reshape(-1)
     c = jnp.cumsum(pair_mask.astype(jnp.int32))
     total = c[-1]
     u = jax.random.uniform(key)
@@ -298,6 +295,27 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
     delta = new_cut.astype(jnp.int32) - old_cut.astype(jnp.int32)
     dcut = delta.sum()
 
+    if spec.proposal == "pair":
+        # incremental distinct-pair |b_nodes| (the pair walk's geom_wait
+        # input): only v's row and its true neighbors' rows of the
+        # (N, K) pair mask can change when v flips — O(D^2 K), not a
+        # full recount
+        aff = jnp.concatenate([v[None], nb])
+        wrow = jnp.concatenate([jnp.ones((1,), bool), nbm])
+        a_tent = state.assignment.at[v].set(
+            d_to.astype(state.assignment.dtype))
+
+        def pair_rows(a_arr):
+            na_r = a_arr[dg.nbr[aff]].astype(jnp.int32)      # (D+1, D)
+            oh = (jax.nn.one_hot(na_r, k, dtype=jnp.bool_)
+                  & dg.nbr_mask[aff][:, :, None])
+            hp = oh.any(axis=1)                              # (D+1, K)
+            own = a_arr[aff].astype(jnp.int32)
+            rows = hp & (jnp.arange(k)[None, :] != own[:, None])
+            return jnp.sum(rows & wrow[:, None], dtype=jnp.int32)
+
+        d_pairs = pair_rows(a_tent) - pair_rows(state.assignment)
+
     # Metropolis in log space: u < base**(beta * -dcut) [* b ratio]
     beta = effective_beta(spec, params, state)
     if spec.weighted_cut:
@@ -307,12 +325,17 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
         dscore = dcut.astype(jnp.float32)
     log_bound = -beta * dscore * params.log_base
     if spec.accept == "corrected":
-        cut_deg_new = state.cut_deg.astype(jnp.int32)
-        cut_deg_new = cut_deg_new.at[nb].add(jnp.where(nbm, delta, 0))
-        cut_deg_new = cut_deg_new.at[v].set(new_cut.sum())
-        b_new = (cut_deg_new > 0).sum()
+        # reversibility ratio |b(parent)|/|b(child)| in the move set's
+        # own units: boundary nodes for 'bi', distinct pairs for 'pair'
+        if spec.proposal == "pair":
+            b_new = state.b_count + d_pairs
+        else:
+            cut_deg_new = state.cut_deg.astype(jnp.int32)
+            cut_deg_new = cut_deg_new.at[nb].add(jnp.where(nbm, delta, 0))
+            cut_deg_new = cut_deg_new.at[v].set(new_cut.sum())
+            b_new = (cut_deg_new > 0).sum()
         log_bound += (jnp.log(state.b_count.astype(jnp.float32))
-                      - jnp.log(b_new.astype(jnp.float32)))
+                      - jnp.log(jnp.maximum(b_new, 1).astype(jnp.float32)))
     if spec.accept == "always":
         accept = valid
     else:
@@ -332,7 +355,10 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
     popv = dg.pop[v] * accept.astype(jnp.int32)
     dist_pop = state.dist_pop.at[d_from].add(-popv).at[d_to].add(popv)
     cut_count = state.cut_count + jnp.where(accept, dcut, 0)
-    b_count = (cut_deg > 0).sum().astype(jnp.int32)
+    if spec.proposal == "pair":
+        b_count = state.b_count + jnp.where(accept, d_pairs, 0)
+    else:
+        b_count = (cut_deg > 0).sum().astype(jnp.int32)
 
     if spec.geom_waits:
         wait_new = sample_geom_minus1(kwait, b_count, dg.n_nodes, k)

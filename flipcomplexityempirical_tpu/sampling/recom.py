@@ -249,7 +249,7 @@ def recom_move(dg: DeviceGraph, spec: Spec, state: ChainState,
     a_new = jnp.where(found, a_new, a).astype(state.assignment.dtype)
 
     cut, cut_deg, dist_pop, cut_count, b_count = derive(
-        dg, a_new, spec.n_districts)
+        dg, a_new, spec.n_districts, spec.proposal)
 
     # settle per-node parity clocks for relabeled nodes: credit the OLD
     # sign over (last_flipped, now], stamp the relabel time, and count the

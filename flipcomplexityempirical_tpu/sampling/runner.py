@@ -85,7 +85,7 @@ def init_batch(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
 
     def one(key):
         return init_state(dg, a0, k, key, label_values,
-                          sample_initial_wait=siw)
+                          sample_initial_wait=siw, proposal=spec.proposal)
 
     states = jax.vmap(one)(keys)
     return dg, states, params

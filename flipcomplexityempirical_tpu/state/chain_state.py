@@ -54,7 +54,32 @@ class ChainState:
         return self.dist_pop.shape[-1]
 
 
-def derive(dg: DeviceGraph, assignment: jnp.ndarray, k: int):
+def pair_move_mask(dg: DeviceGraph, a_i: jnp.ndarray, k: int):
+    """(N, K) bool: the k-district pair move set — district d is present
+    among node v's neighbors and differs from v's own (the reference's
+    b_nodes pair updater, grid_chain_sec11.py:151-153, a SET of distinct
+    (node, district) pairs)."""
+    nbr_a = a_i[dg.nbr]                                      # (N, D)
+    onehot = jax.nn.one_hot(nbr_a, k, dtype=jnp.bool_)       # (N, D, K)
+    onehot = onehot & dg.nbr_mask[:, :, None]
+    has_part = onehot.any(axis=1)                            # (N, K)
+    return has_part & (jnp.arange(k)[None, :] != a_i[:, None])
+
+
+def b_nodes_count(dg: DeviceGraph, assignment, cut_deg, k: int,
+                  proposal: str):
+    """|b_nodes| as the reference wires it per chain flavor: boundary
+    NODES for the 2-district 'bi' walk (b_nodes_bi), distinct (node,
+    district) PAIRS for the k-district pair walk (b_nodes pairs) — the
+    value geom_wait's p = |b_nodes| / (n**k - 1) consumes."""
+    if proposal == "pair":
+        a_i = assignment.astype(jnp.int32)
+        return pair_move_mask(dg, a_i, k).astype(jnp.int32).sum()
+    return (cut_deg > 0).astype(jnp.int32).sum()
+
+
+def derive(dg: DeviceGraph, assignment: jnp.ndarray, k: int,
+           proposal: str = "bi"):
     """Recompute all derived fields from the assignment (the invariant
     checker, and the initializer)."""
     a = assignment.astype(jnp.int32)
@@ -65,20 +90,21 @@ def derive(dg: DeviceGraph, assignment: jnp.ndarray, k: int):
     cut_deg = cut_deg.at[dg.edges[:, 1]].add(cut.astype(jnp.int32))
     dist_pop = jnp.zeros(k, jnp.int32).at[a].add(dg.pop)
     cut_count = cut.astype(jnp.int32).sum()
-    b_count = (cut_deg > 0).astype(jnp.int32).sum()
+    b_count = b_nodes_count(dg, assignment, cut_deg, k, proposal)
     return cut, cut_deg.astype(jnp.int8), dist_pop, cut_count, b_count
 
 
 def init_state(dg: DeviceGraph, assignment: jnp.ndarray, k: int,
                key: jnp.ndarray, label_values: jnp.ndarray,
-               sample_initial_wait=None) -> ChainState:
+               sample_initial_wait=None, proposal: str = "bi") -> ChainState:
     """Build the initial ChainState. ``label_values[district]`` is the
     reference's +1/-1 labeling used to seed part_sum
     (grid_chain_sec11.py:219: part_sum starts at the signed label).
     ``sample_initial_wait(key, b_count) -> float32`` seeds the memoized
     geometric wait of the initial state; None leaves it 0 (metrics off)."""
     assignment = assignment.astype(jnp.int8)
-    cut, cut_deg, dist_pop, cut_count, b_count = derive(dg, assignment, k)
+    cut, cut_deg, dist_pop, cut_count, b_count = derive(dg, assignment, k,
+                                                       proposal)
     key, kw = jax.random.split(key)
     if sample_initial_wait is not None:
         wait = sample_initial_wait(kw, b_count)

@@ -103,6 +103,17 @@ def test_pair_move_set_matches_brute_force():
         for v in range(g.n_nodes):
             ds = [int(flat[v + offs[j]]) for j in range(4) if valid[c, v, j]]
             assert len(ds) == len(set(ds)), f"chain {c} node {v} dup"
+        # b_count is the DISTINCT-PAIR count before validity gates (the
+        # reference's pair b_nodes updater feeding geom_wait)
+        raw = set()
+        for x in range(bg.h):
+            for y in range(bg.w):
+                for dx, dy in ((0, 1), (1, 0), (0, -1), (-1, 0)):
+                    if 0 <= x + dx < bg.h and 0 <= y + dy < bg.w:
+                        d = b2[x + dx, y + dy]
+                        if d != b2[x, y]:
+                            raw.add((x * bg.w + y, int(d)))
+        assert int(np.asarray(planes["b_count"])[c]) == len(raw), c
 
 
 def test_pair_run_invariants():
@@ -170,6 +181,20 @@ def test_pair_board_matches_general_path():
     ra = res_g.history["accepts"][:, -1].mean()
     rb = res_b.history["accepts"][:, -1].mean()
     assert abs(ra - rb) / ra < 0.06, (ra, rb)
+
+
+def test_pair_contiguity_none_smoke():
+    """No-contiguity pair walk (districts may fragment); derived fields
+    stay pure functions of the board."""
+    _, _, res = _run_pair(k=3, steps=201, tol=0.9, contiguity="none")
+    s = res.host_state()
+    b = np.asarray(s.board).reshape(-1, 8, 8)
+    for d in range(3):
+        np.testing.assert_array_equal(np.asarray(s.dist_pop)[:, d],
+                                      (b == d).sum((1, 2)))
+    cut = ((b[:, :, :-1] != b[:, :, 1:]).sum((1, 2))
+           + (b[:, :-1] != b[:, 1:]).sum((1, 2)))
+    np.testing.assert_array_equal(np.asarray(s.cut_count), cut)
 
 
 def test_pair_k8_smoke():

@@ -36,10 +36,10 @@ def assert_districts_connected(g, s, k, lo=None, hi=None):
                 assert lo <= len(nodes) <= hi, (d, len(nodes))
 
 
-def check_invariants(dg, s, k):
+def check_invariants(dg, s, k, proposal="bi"):
     c = s.assignment.shape[0]
-    cut, cdeg, dpop, cc, bc = jax.vmap(lambda a: derive(dg, a, k))(
-        jnp.asarray(s.assignment))
+    cut, cdeg, dpop, cc, bc = jax.vmap(
+        lambda a: derive(dg, a, k, proposal))(jnp.asarray(s.assignment))
     assert (np.asarray(cut) == np.asarray(s.cut)).all()
     assert (np.asarray(cdeg) == np.asarray(s.cut_deg)).all()
     assert (np.asarray(dpop) == np.asarray(s.dist_pop)).all()
@@ -58,7 +58,7 @@ def test_invariants_pair_k4():
     spec = fce.Spec(n_districts=4, proposal="pair", contiguity="patch")
     g, dg, res = run_small(spec, n=10, k=4, steps=300, tol=0.5)
     s = res.host_state()
-    check_invariants(dg, s, 4)
+    check_invariants(dg, s, 4, proposal="pair")
     assert_districts_connected(g, s, 4)
 
 
@@ -232,7 +232,7 @@ def test_invariants_pair_k8():
     spec = fce.Spec(n_districts=8, proposal="pair", contiguity="patch")
     g, dg, res = run_small(spec, n=12, k=8, steps=300, tol=0.5, base=1.0)
     s = res.host_state()
-    check_invariants(dg, s, 8)
+    check_invariants(dg, s, 8, proposal="pair")
     ideal = g.n_nodes / 8
     assert_districts_connected(g, s, 8, lo=0.5 * ideal, hi=1.5 * ideal)
 
