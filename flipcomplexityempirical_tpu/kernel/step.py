@@ -306,12 +306,8 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
             d_to.astype(state.assignment.dtype))
 
         def pair_rows(a_arr):
-            na_r = a_arr[dg.nbr[aff]].astype(jnp.int32)      # (D+1, D)
-            oh = (jax.nn.one_hot(na_r, k, dtype=jnp.bool_)
-                  & dg.nbr_mask[aff][:, :, None])
-            hp = oh.any(axis=1)                              # (D+1, K)
-            own = a_arr[aff].astype(jnp.int32)
-            rows = hp & (jnp.arange(k)[None, :] != own[:, None])
+            rows = chain_state.pair_move_mask(
+                dg, a_arr.astype(jnp.int32), k, nodes=aff)
             return jnp.sum(rows & wrow[:, None], dtype=jnp.int32)
 
         d_pairs = pair_rows(a_tent) - pair_rows(state.assignment)

@@ -54,16 +54,20 @@ class ChainState:
         return self.dist_pop.shape[-1]
 
 
-def pair_move_mask(dg: DeviceGraph, a_i: jnp.ndarray, k: int):
+def pair_move_mask(dg: DeviceGraph, a_i: jnp.ndarray, k: int, nodes=None):
     """(N, K) bool: the k-district pair move set — district d is present
     among node v's neighbors and differs from v's own (the reference's
     b_nodes pair updater, grid_chain_sec11.py:151-153, a SET of distinct
-    (node, district) pairs)."""
-    nbr_a = a_i[dg.nbr]                                      # (N, D)
-    onehot = jax.nn.one_hot(nbr_a, k, dtype=jnp.bool_)       # (N, D, K)
-    onehot = onehot & dg.nbr_mask[:, :, None]
-    has_part = onehot.any(axis=1)                            # (N, K)
-    return has_part & (jnp.arange(k)[None, :] != a_i[:, None])
+    (node, district) pairs). ``nodes`` restricts to a row subset (the
+    incremental updater's affected rows), returning (len(nodes), K)."""
+    nbr = dg.nbr if nodes is None else dg.nbr[nodes]
+    nbm = dg.nbr_mask if nodes is None else dg.nbr_mask[nodes]
+    own = a_i if nodes is None else a_i[nodes]
+    nbr_a = a_i[nbr]                                         # (R, D)
+    onehot = jax.nn.one_hot(nbr_a, k, dtype=jnp.bool_)       # (R, D, K)
+    onehot = onehot & nbm[:, :, None]
+    has_part = onehot.any(axis=1)                            # (R, K)
+    return has_part & (jnp.arange(k)[None, :] != own[:, None])
 
 
 def b_nodes_count(dg: DeviceGraph, assignment, cut_deg, k: int,

@@ -59,6 +59,44 @@ def ks_stat(x, y):
     return np.abs(fx - fy).max()
 
 
+def oracle_pair_trajectory(lat, k, seed):
+    """The k-district pair walk on the compat oracle: b_nodes is the PAIR
+    set (grid_chain_sec11.py:151-153) feeding both the proposal and
+    geom_wait's p = |b_nodes| / (n**k - 1)."""
+    rng = np.random.default_rng(seed)
+    plan = fce.graphs.stripes_plan(lat, k)
+    assign = {lab: int(plan[i]) for i, lab in enumerate(lat.labels)}
+    updaters = {"population": compat.Tally("population"),
+                "cut_edges": compat.cut_edges,
+                "b_nodes": compat.b_nodes_pairs,
+                "base": lambda p: BASE,
+                "geom": compat.make_geom_wait(rng)}
+    part = compat.Partition(lat, assign, updaters)
+    popbound = compat.within_percent_of_ideal_population(part, EPS)
+    chain = compat.MarkovChain(
+        compat.make_reversible_propose_pairs(rng),
+        compat.Validator([compat.single_flip_contiguous, popbound]),
+        compat.make_cut_accept(rng), part, STEPS)
+    cuts, bs, waits = [], [], []
+    for p in chain:
+        cuts.append(len(p["cut_edges"]))
+        bs.append(len(p["b_nodes"]))
+        waits.append(p["geom"])
+    return (np.array(cuts[BURN:]), np.array(bs[BURN:]),
+            np.array(waits[BURN:], dtype=float))
+
+
+def kernel_pair_trajectories(lat, k, seed, chains=8):
+    plan = fce.graphs.stripes_plan(lat, k)
+    spec = fce.Spec(n_districts=k, proposal="pair", contiguity="exact")
+    dg, st, params = fce.init_batch(lat, plan, n_chains=chains, seed=seed,
+                                    spec=spec, base=BASE, pop_tol=EPS)
+    res = fce.run_chains(dg, spec, params, st, n_steps=STEPS)
+    return (res.history["cut_count"][:, BURN:],
+            res.history["b_count"][:, BURN:],
+            res.history["wait"][:, BURN:])
+
+
 def test_kernel_matches_oracle_distributions():
     lat = fce.graphs.square_grid(6, 6)
     o_cut, o_b, o_w = oracle_trajectory(lat, seed=1)
@@ -75,4 +113,22 @@ def test_kernel_matches_oracle_distributions():
     assert abs(o_cut.mean() - k_cut.mean()) / o_cut.mean() < 0.03
     assert abs(o_b.mean() - k_b.mean()) / o_b.mean() < 0.03
     # waits: mean ratio within 10% (heavy-tailed)
+    assert abs(o_w.mean() - k_w.mean()) / o_w.mean() < 0.10
+
+
+def test_pair_kernel_matches_oracle_distributions():
+    """The k-district pair walk agrees with the gerrychain-semantics
+    oracle, including the distinct-PAIR |b_nodes| feeding geom_wait."""
+    lat = fce.graphs.square_grid(6, 6)
+    k = 3
+    o_cut, o_b, o_w = oracle_pair_trajectory(lat, k, seed=4)
+    k_cut, k_b, k_w = kernel_pair_trajectories(lat, k, seed=5)
+
+    sub = slice(None, None, 40)
+    ks_cut = ks_stat(o_cut[sub], k_cut[:, ::40].ravel())
+    ks_b = ks_stat(o_b[sub], k_b[:, ::40].ravel())
+    assert ks_cut < 0.12, f"cut-count KS {ks_cut:.3f}"
+    assert ks_b < 0.12, f"b-count KS {ks_b:.3f}"
+    assert abs(o_cut.mean() - k_cut.mean()) / o_cut.mean() < 0.03
+    assert abs(o_b.mean() - k_b.mean()) / o_b.mean() < 0.03
     assert abs(o_w.mean() - k_w.mean()) / o_w.mean() < 0.10

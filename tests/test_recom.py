@@ -177,11 +177,12 @@ def test_jax_recom_move_invariants(k):
         for d in range(k):
             members = np.nonzero(a == d)[0].tolist()
             assert nx.is_connected(gx.subgraph(members))
-    # derived fields consistent
+    # derived fields consistent (b_count in the spec's move-set units)
     cut, cdeg, dpop, cc, bc = jax.vmap(
-        lambda a: derive(dg, a, k))(jnp.asarray(a_all))
+        lambda a: derive(dg, a, k, spec.proposal))(jnp.asarray(a_all))
     assert (np.asarray(cut) == np.asarray(s.cut)).all()
     assert (np.asarray(dpop) == np.asarray(s.dist_pop)).all()
+    assert (np.asarray(bc) == np.asarray(s.b_count)).all()
 
 
 def test_jax_recom_balance():
