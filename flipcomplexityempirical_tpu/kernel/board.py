@@ -197,7 +197,8 @@ def supports(graph: LatticeGraph, spec: Spec) -> bool:
         and not spec.weighted_cut
         and not spec.record_interface
         and (not spec.record_assignment_bits
-             or (graph.n_nodes <= 32 and spec.n_districts == 2))
+             or graph.n_nodes * max(
+                 1, (spec.n_districts - 1).bit_length()) <= 32)
     )
 
 
@@ -401,9 +402,11 @@ def _record(bg: BoardGraph, spec: Spec, params: StepParams,
     emits the (flip pointer, sign) log row instead."""
     state, out, log = _record_common(state, planes["b_count"], cur_wait)
     if spec.record_assignment_bits:
-        if bg.n > 32:
-            raise ValueError("record_assignment_bits needs n_nodes <= 32")
-        shifts = jnp.arange(bg.n, dtype=jnp.uint32)[None, :]
+        bits_per = max(1, (spec.n_districts - 1).bit_length())
+        if bg.n * bits_per > 32:
+            raise ValueError("record_assignment_bits needs n_nodes * "
+                             "ceil(log2(k)) <= 32")
+        shifts = (jnp.arange(bg.n, dtype=jnp.uint32) * bits_per)[None, :]
         out["abits"] = jnp.sum(
             state.board.astype(jnp.uint32) << shifts, axis=1,
             dtype=jnp.uint32)

@@ -70,9 +70,11 @@ class Spec:
     record_interface: bool = False  # slope/angle wall metrics
     parity_metrics: bool = True   # reference-exact accumulator quirks
     geom_waits: bool = True       # sample geometric waiting times
-    record_assignment_bits: bool = False  # pack 2-district state to uint32
-                                          # per yield (graphs with N<=32;
-                                          # exact-distribution tests)
+    record_assignment_bits: bool = False  # pack the assignment to uint32
+                                          # per yield at ceil(log2(k))
+                                          # bits/node (graphs with
+                                          # N*bits <= 32; exact-
+                                          # distribution tests)
 
 
 @struct.dataclass
@@ -411,9 +413,11 @@ def record(dg: DeviceGraph, spec: Spec, params: StepParams,
         out["angle"] = angle
 
     if spec.record_assignment_bits:
-        if dg.n_nodes > 32:
-            raise ValueError("record_assignment_bits needs n_nodes <= 32")
-        shifts = jnp.arange(dg.n_nodes, dtype=jnp.uint32)
+        bits_per = max(1, (spec.n_districts - 1).bit_length())
+        if dg.n_nodes * bits_per > 32:
+            raise ValueError("record_assignment_bits needs n_nodes * "
+                             "ceil(log2(k)) <= 32")
+        shifts = jnp.arange(dg.n_nodes, dtype=jnp.uint32) * bits_per
         out["abits"] = jnp.sum(
             state.assignment.astype(jnp.uint32) << shifts, dtype=jnp.uint32)
 

@@ -22,10 +22,11 @@ EPS = 0.5              # pops within [3, 9]
 N = NX * NY
 
 
-def build_masks():
-    g = fce.graphs.square_grid(NX, NY)
-    nbrmask = [0] * N  # python ints: arbitrary-precision bit ops
-    for i in range(N):
+def build_masks(nx=NX, ny=NY):
+    g = fce.graphs.square_grid(nx, ny)
+    n = nx * ny
+    nbrmask = [0] * n  # python ints: arbitrary-precision bit ops
+    for i in range(n):
         for j in g.nbr[i][g.nbr_mask[i]]:
             nbrmask[i] |= 1 << int(j)
     return g, nbrmask
@@ -111,6 +112,23 @@ def stationary(P):
     return pi / pi.sum()
 
 
+def assert_matches_stationary(abits, states, pi, cuts,
+                              tv_tol=0.06, cut_tol=0.02):
+    """Empirical occupancy vs the exact stationary distribution: decode
+    the packed assignments (KeyError => the chain visited an invalid
+    state), bound the total-variation distance and the E[|cut|] error."""
+    index = {m: i for i, m in enumerate(states)}
+    idx = np.array([index[int(m)] for m in abits])
+    emp = np.bincount(idx, minlength=len(states)).astype(float)
+    emp /= emp.sum()
+    tv = 0.5 * np.abs(emp - pi).sum()
+    assert tv < tv_tol, f"TV distance {tv:.4f} (|S|={len(states)})"
+    e_cut_exact = float((pi * cuts).sum())
+    e_cut_emp = float((emp * cuts).sum())
+    assert abs(e_cut_emp - e_cut_exact) / e_cut_exact < cut_tol, \
+        (e_cut_emp, e_cut_exact)
+
+
 @pytest.mark.parametrize("base", [0.5, 1.0, 2.0])
 def test_kernel_matches_exact_stationary(base):
     g, nbrmask = build_masks()
@@ -125,21 +143,8 @@ def test_kernel_matches_exact_stationary(base):
     dg, st, params = fce.init_batch(g, plan, n_chains=chains, seed=42,
                                     spec=spec, base=base, pop_tol=EPS)
     res = fce.run_chains(dg, spec, params, st, n_steps=steps)
-    abits = res.history["abits"][:, burn:].ravel()
-
-    index = {m: i for i, m in enumerate(states)}
-    idx = np.array([index[int(m)] for m in abits])  # KeyError => invalid state
-    emp = np.bincount(idx, minlength=len(states)).astype(float)
-    emp /= emp.sum()
-
-    tv = 0.5 * np.abs(emp - pi).sum()
-    assert tv < 0.06, f"TV distance {tv:.4f} (|S|={len(states)})"
-
-    # aggregate observable: E[|cut|] within 2%
-    e_cut_exact = float((pi * cuts).sum())
-    e_cut_emp = float((emp * cuts).sum())
-    assert abs(e_cut_emp - e_cut_exact) / e_cut_exact < 0.02, \
-        (e_cut_emp, e_cut_exact)
+    assert_matches_stationary(res.history["abits"][:, burn:].ravel(),
+                              states, pi, cuts)
 
 
 def test_corrected_accept_matches_reversible_target():
@@ -163,13 +168,106 @@ def test_corrected_accept_matches_reversible_target():
     dg, st, params = fce.init_batch(g, plan, n_chains=chains, seed=7,
                                     spec=spec, base=base, pop_tol=EPS)
     res = fce.run_chains(dg, spec, params, st, n_steps=steps)
-    abits = res.history["abits"][:, burn:].ravel()
+    assert_matches_stationary(res.history["abits"][:, burn:].ravel(),
+                              states, target, cuts, cut_tol=np.inf)
+
+
+# ---------------------------------------------------------------------------
+# k=3 pair walk: exact stationary distribution on a 3x3 grid
+# ---------------------------------------------------------------------------
+
+K3_NX = K3_NY = 3
+K3_N = 9
+K3_EPS = 0.5           # ideal 3 -> district sizes in {2, 3, 4}
+
+
+def k3_enumerate(nbrmask):
+    """All 3-labelings of the 3x3 grid with every district connected and
+    sized within bounds. Encoded base-4 (2 bits/node) to match
+    record_assignment_bits' packing for k=3."""
+    lo, hi = (1 - K3_EPS) * 3, (1 + K3_EPS) * 3
+    states = []
+    for m in range(3 ** K3_N):
+        digs, t = [], m
+        for _ in range(K3_N):
+            digs.append(t % 3)
+            t //= 3
+        masks = [0, 0, 0]
+        for v, d in enumerate(digs):
+            masks[d] |= 1 << v
+        if not all(lo <= bin(mk).count("1") <= hi for mk in masks):
+            continue
+        if all(connected_bitmask(mk, nbrmask) for mk in masks):
+            states.append(sum(d << (2 * v) for v, d in enumerate(digs)))
+    return states
+
+
+def k3_digits(code):
+    return [(code >> (2 * v)) & 3 for v in range(K3_N)]
+
+
+def k3_build_transition(states, g, base):
+    """Row-stochastic matrix of the re-propose PAIR chain: uniform over
+    distinct (node, adjacent-district) pairs whose landing state is
+    valid, literal cut_accept."""
     index = {m: i for i, m in enumerate(states)}
-    idx = np.array([index[int(m)] for m in abits])
-    emp = np.bincount(idx, minlength=len(states)).astype(float)
-    emp /= emp.sum()
-    tv = 0.5 * np.abs(emp - target).sum()
-    assert tv < 0.06, f"TV distance {tv:.4f}"
+    edges = g.edges
+    cuts = []
+    for m in states:
+        a = np.array(k3_digits(m))
+        cuts.append(int((a[edges[:, 0]] != a[edges[:, 1]]).sum()))
+    cuts = np.array(cuts)
+    n = len(states)
+    P = np.zeros((n, n))
+    nbrs = [g.nbr[i][g.nbr_mask[i]].tolist() for i in range(K3_N)]
+    for i, m in enumerate(states):
+        a = k3_digits(m)
+        moves = []
+        for v in range(K3_N):
+            for d in {a[u] for u in nbrs[v]} - {a[v]}:
+                m2 = m + ((d - a[v]) << (2 * v))
+                j = index.get(m2)
+                if j is not None:
+                    moves.append(j)
+        V = len(moves)
+        assert V > 0
+        stay = 0.0
+        for j in moves:
+            acc = min(1.0, base ** (cuts[i] - cuts[j]))
+            P[i, j] += acc / V
+            stay += (1 - acc) / V
+        P[i, i] += stay
+    assert np.allclose(P.sum(axis=1), 1.0)
+    return P, cuts
+
+
+@pytest.mark.parametrize("path", ["general", "board"])
+def test_pair_walk_matches_exact_stationary(path):
+    """The k=3 pair walk (both backends) against the power-iterated
+    stationary distribution of its exact transition matrix."""
+    base = 1.5
+    g, nbrmask = build_masks(K3_NX, K3_NY)
+    states = k3_enumerate(nbrmask)
+    P, cuts = k3_build_transition(states, g, base)
+    pi = stationary(P)
+
+    spec = fce.Spec(n_districts=3, proposal="pair", contiguity="patch",
+                    record_assignment_bits=True, geom_waits=False,
+                    parity_metrics=False)
+    plan = fce.graphs.stripes_plan(g, 3)
+    chains, steps, burn = 48, 12000, 2000
+    if path == "general":
+        dg, st, params = fce.init_batch(g, plan, n_chains=chains, seed=21,
+                                        spec=spec, base=base,
+                                        pop_tol=K3_EPS)
+        res = fce.run_chains(dg, spec, params, st, n_steps=steps)
+    else:
+        bg, st, params = fce.sampling.init_board(
+            g, plan, n_chains=chains, seed=22, spec=spec, base=base,
+            pop_tol=K3_EPS)
+        res = fce.sampling.run_board(bg, spec, params, st, n_steps=steps)
+    assert_matches_stationary(res.history["abits"][:, burn:].ravel(),
+                              states, pi, cuts)
 
 
 @pytest.mark.parametrize("base", [0.5, 2.0])
@@ -190,16 +288,5 @@ def test_board_path_matches_exact_stationary(base):
         g, plan, n_chains=chains, seed=13, spec=spec, base=base,
         pop_tol=EPS)
     res = fce.sampling.run_board(bg, spec, params, st, n_steps=steps)
-    abits = res.history["abits"][:, burn:].ravel()
-
-    index = {m: i for i, m in enumerate(states)}
-    idx = np.array([index[int(m)] for m in abits])  # KeyError => invalid
-    emp = np.bincount(idx, minlength=len(states)).astype(float)
-    emp /= emp.sum()
-
-    tv = 0.5 * np.abs(emp - pi).sum()
-    assert tv < 0.06, f"TV distance {tv:.4f} (|S|={len(states)})"
-    e_cut_exact = float((pi * cuts).sum())
-    e_cut_emp = float((emp * cuts).sum())
-    assert abs(e_cut_emp - e_cut_exact) / e_cut_exact < 0.02, \
-        (e_cut_emp, e_cut_exact)
+    assert_matches_stationary(res.history["abits"][:, burn:].ravel(),
+                              states, pi, cuts)
