@@ -820,13 +820,18 @@ def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
             bitboard.counter_fold(ct_s_sl, n))
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "chunk", "collect"))
+@functools.partial(jax.jit,
+                   static_argnames=("spec", "chunk", "collect", "bits"))
 def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
-                    state: BoardState, chunk: int, collect: bool = True):
+                    state: BoardState, chunk: int, collect: bool = True,
+                    bits: bool = None):
     """``chunk`` iterations of [complete-wait, record, transition]; records
     yields t .. t+chunk-1 and advances ``chunk`` transitions. The heavy
     accumulators stay OUT of the scan carry: cut_times in int16 planes
-    folded afterwards, flip bookkeeping replayed from the emitted log."""
+    folded afterwards, flip bookkeeping replayed from the emitted log.
+    ``bits`` overrides the bit-board dispatch (None = auto via
+    ``bitboard.supported``; False forces the int8 body — the two are
+    bit-identical, so the choice is purely a performance matter)."""
     if chunk > 32767:
         raise ValueError("chunk must be <= 32767 (int16 cut_times planes)")
     n = bg.n
@@ -836,7 +841,9 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
     loop_state = state.replace(
         **{k: None for k in _BOOKKEEPING})
 
-    if bitboard.supported(bg, spec):
+    use_bits = bitboard.supported(bg, spec) if bits is None \
+        else (bits and bitboard.supported(bg, spec))
+    if use_bits:
         (loop_state, outs, logs, cte, cts) = _scan_bits(
             bg, spec, params, loop_state, chunk, collect)
         big["cut_times_e"] = big["cut_times_e"] + cte

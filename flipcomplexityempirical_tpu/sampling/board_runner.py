@@ -77,9 +77,12 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
 def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
               state: kboard.BoardState, n_steps: int,
               record_history: bool = True,
-              chunk: Optional[int] = None) -> RunResult:
+              chunk: Optional[int] = None,
+              bits: Optional[bool] = None) -> RunResult:
     """Run the batched board chain for ``n_steps`` yields (yield 0 is the
-    initial state, as the reference's ``for part in exp_chain`` sees it)."""
+    initial state, as the reference's ``for part in exp_chain`` sees it).
+    ``bits`` overrides the bit-board body dispatch (perf toggle; the
+    bodies are bit-identical)."""
     if chunk is None:
         chunk = pick_chunk(n_steps, 2048)
 
@@ -93,7 +96,8 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
     while done < transitions:
         this = min(chunk, transitions - done)
         state, outs = kboard.run_board_chunk(bg, spec, params, state, this,
-                                             collect=record_history)
+                                             collect=record_history,
+                                             bits=bits)
         if record_history:
             outs = jax.tree.map(np.asarray, outs)
             for k, v in outs.items():

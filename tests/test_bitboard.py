@@ -97,14 +97,13 @@ def test_bit_identity_vs_int8_body(rng, hw, spec_kw):
     assert bb.supported(bg, spec)
 
     got_state, got_outs = kb.run_board_chunk(bg, spec, params, st, 75)
-
-    orig = bb.supported
-    try:
-        bb.supported = lambda *_: False
-        want_state, want_outs = kb.run_board_chunk.__wrapped__(
-            bg, spec, params, st, 75)
-    finally:
-        bb.supported = orig
+    # bits=False forces the int8 body first-class (same jit, distinct
+    # cache entry); bits=True must match the auto dispatch
+    want_state, want_outs = kb.run_board_chunk(bg, spec, params, st, 75,
+                                               bits=False)
+    alt_state, _ = kb.run_board_chunk(bg, spec, params, st, 75, bits=True)
+    np.testing.assert_array_equal(np.asarray(alt_state.board),
+                                  np.asarray(got_state.board))
 
     for f in st.__dataclass_fields__:
         np.testing.assert_array_equal(
