@@ -55,6 +55,11 @@ def main():
                          "charged for transient device/tunnel stalls). "
                          "Default 2, or 1 under --profile so the trace "
                          "holds exactly the timed region")
+    ap.add_argument("--ess", action="store_true",
+                    help="also run a recorded pass and report effective "
+                         "samples of the cut-count trajectory per second "
+                         "of wall clock (the BASELINE metric's "
+                         "'wall-clock to target ESS' axis) on stderr")
     args = ap.parse_args()
     if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
             or args.warmup - 1 < args.chunk):
@@ -109,10 +114,10 @@ def main():
             base=args.base, pop_tol=args.pop_tol)
 
         if args.pallas:
-            def run(states, n_steps, variant=None):
+            def run(states, n_steps, variant=None, record=False):
                 return fce.sampling.run_board_pallas(
                     bg, spec, params, states, n_steps=n_steps,
-                    record_history=False, chunk=args.chunk,
+                    record_history=record, chunk=args.chunk,
                     block_chains=args.block_chains)
         else:
             from flipcomplexityempirical_tpu.kernel import bitboard
@@ -122,18 +127,18 @@ def main():
                 # hardware/compiler question the benchmark answers)
                 variants = [True, False]
 
-            def run(states, n_steps, variant=None):
+            def run(states, n_steps, variant=None, record=False):
                 return fce.sampling.run_board(
                     bg, spec, params, states, n_steps=n_steps,
-                    record_history=False, chunk=args.chunk, bits=variant)
+                    record_history=record, chunk=args.chunk, bits=variant)
     else:
         dg, states, params = fce.init_batch(
             g, plan, n_chains=args.chains, seed=0, spec=spec,
             base=args.base, pop_tol=args.pop_tol)
 
-        def run(states, n_steps, variant=None):
+        def run(states, n_steps, variant=None, record=False):
             return fce.run_chains(dg, spec, params, states, n_steps=n_steps,
-                                  record_history=False, chunk=args.chunk)
+                                  record_history=record, chunk=args.chunk)
 
     # compile + mix in (reach steady-state boundary sizes); same chunk as
     # the timed run so the timed region reuses the compiled kernel
@@ -152,6 +157,10 @@ def main():
         jax.block_until_ready(
             jax.tree.leaves(run(states, args.warmup, variant).state)[0])
 
+    if args.profile:
+        # one body only under --profile, so the trace holds exactly one
+        # kernel's timed region (the auto-dispatched body)
+        variants = variants[:1]
     prof = (jax.profiler.trace(args.profile) if args.profile
             else contextlib.nullcontext())
     repeats = args.repeats if args.repeats else (1 if args.profile else 2)
@@ -185,6 +194,28 @@ def main():
     }
     if len(variants) > 1:
         meta["body"] = "bitboard" if best else "int8"
+
+    if args.ess:
+        # recorded pass at the winning variant: effective samples of the
+        # cut trajectory per wall-clock second (independent chains add)
+        from flipcomplexityempirical_tpu.stats import ess as ess_fn
+        # compile the collect=True kernel outside the timed window
+        jax.block_until_ready(jax.tree.leaves(
+            run(states, args.warmup, best, record=True).state)[0])
+        t0 = time.perf_counter()
+        res_h = run(states, args.steps, best, record=True)
+        jax.block_until_ready(jax.tree.leaves(res_h.state)[0])
+        d_rec = time.perf_counter() - t0
+        _, ess_total = ess_fn(np.asarray(res_h.history["cut_count"],
+                                         np.float64))
+        meta_ess = {
+            "metric": "cut_ess_per_sec",
+            "ess_total": round(float(ess_total), 1),
+            "recorded_seconds": round(d_rec, 3),
+            "value": round(float(ess_total) / d_rec, 2),
+        }
+        print(json.dumps(meta_ess), file=sys.stderr)
+
     print(json.dumps(meta), file=sys.stderr)
     print(json.dumps({
         "metric": "flips_per_sec_per_chip_64x64",

@@ -841,8 +841,10 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
     loop_state = state.replace(
         **{k: None for k in _BOOKKEEPING})
 
-    use_bits = bitboard.supported(bg, spec) if bits is None \
-        else (bits and bitboard.supported(bg, spec))
+    if bits and not bitboard.supported(bg, spec):
+        raise ValueError("bits=True: workload not supported by the "
+                         "bit-board body (see bitboard.supported)")
+    use_bits = bitboard.supported(bg, spec) if bits is None else bits
     if use_bits:
         (loop_state, outs, logs, cte, cts) = _scan_bits(
             bg, spec, params, loop_state, chunk, collect)
