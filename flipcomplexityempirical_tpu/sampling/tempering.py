@@ -28,7 +28,6 @@ import jax
 import jax.numpy as jnp
 
 from ..kernel.step import StepParams
-from ..state.chain_state import ChainState
 
 
 def make_ladder_params(params: StepParams, betas, n_ladders: int) -> StepParams:
@@ -54,7 +53,7 @@ def make_ladder_params(params: StepParams, betas, n_ladders: int) -> StepParams:
     )
 
 
-def swap_within_batch(key, states: ChainState, params: StepParams,
+def swap_within_batch(key, states, params: StepParams,
                       n_rungs: int, parity: int, spec=None):
     """One even-odd swap round inside a batch laid out (ladders, rungs).
 
@@ -62,12 +61,16 @@ def swap_within_batch(key, states: ChainState, params: StepParams,
     Returns (params with exchanged betas, swap-accept mask) — states are
     untouched by design. Pass the chains' ``Spec`` so the annealing
     incompatibility (module docstring) is caught at the misuse site.
+
+    ``states`` may be the general path's ChainState or the board path's
+    BoardState: only the batch size and the carried per-chain
+    ``cut_count`` (the energy) are read.
     """
     if spec is not None and spec.anneal != "none":
         raise ValueError("replica exchange is incompatible with Spec.anneal "
                          "!= 'none': the annealed kernel ignores "
                          "StepParams.beta, so swapped betas have no effect")
-    c = states.assignment.shape[0]
+    c = states.cut_count.shape[0]
     rung = jnp.arange(c) % n_rungs
     # partner of each chain within its ladder (identity at ladder edges)
     lo = (rung % 2) == (parity % 2)

@@ -91,6 +91,45 @@ def test_within_batch_tempering_swaps():
                 assert b2[lad, r + 1] == b0[lad, r]
 
 
+def test_within_batch_tempering_board_path():
+    """swap_within_batch reads only cut_count + batch size, so the board
+    fast path tempers in-batch too: alternate board chunks with swap
+    rounds, check ladder-multiset preservation and the physical ordering
+    (hot rungs sit at longer boundaries)."""
+    g = fce.graphs.square_grid(6, 32)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch", geom_waits=False,
+                    parity_metrics=False)
+    n_rungs, n_ladders = 4, 8
+    betas = np.linspace(0.2, 2.0, n_rungs)
+    bg, states, params = fce.sampling.init_board(
+        g, plan, n_chains=n_rungs * n_ladders, seed=9, spec=spec,
+        base=2.0, pop_tol=0.4)
+    params = tempering.make_ladder_params(params, betas=betas,
+                                          n_ladders=n_ladders)
+    key = jax.random.PRNGKey(3)
+    accepts = 0
+    for r in range(30):
+        res = fce.sampling.run_board(bg, spec, params, states, n_steps=41,
+                                     record_history=False)
+        states = res.state
+        key, ks = jax.random.split(key)
+        params, acc = tempering.swap_within_batch(
+            ks, states, params, n_rungs=n_rungs, parity=r % 2, spec=spec)
+        accepts += int(np.asarray(acc).sum())
+    assert accepts > 0
+    b = np.asarray(params.beta).reshape(n_ladders, n_rungs)
+    assert np.allclose(np.sort(b, axis=1), betas)
+    # physical sanity: base > 1 with high beta favors SHORT boundaries,
+    # so mean cut at the hottest rung (lowest beta) exceeds the coldest
+    cuts = np.asarray(states.cut_count).astype(float)
+    beta_flat = np.asarray(params.beta)
+    b32 = betas.astype(np.float32)
+    hot = cuts[beta_flat == b32[0]].mean()
+    cold = cuts[beta_flat == b32[-1]].mean()
+    assert hot > cold, (hot, cold)
+
+
 def test_board_sharded_run_bit_identical():
     """The board fast path shards the chains axis transparently: 1 vs 8
     devices produce bit-identical histories and state."""
