@@ -25,13 +25,21 @@ Semantics are IDENTICAL to the int8 path: the same PRNG stream drives
 the same uniform draws, the selection picks the same m-th valid cell in
 flat row-major order, and the acceptance formula is unchanged — so
 trajectories are bit-identical (asserted by tests/test_bitboard.py).
-``supported()`` gates the backend to the workloads where the packing is
-clean and exact: uniform node population (the population test collapses
-to one boolean per chain per side; true of every reference config,
-grid_chain_sec11.py:221), W a multiple of 32 (rows align to words),
-accept in ('cut', 'always') (the 'corrected' boundary-ratio correction
-needs per-node degree counts the bit planes don't keep), and no
-record_assignment_bits. Everything else silently uses the int8 body.
+``supported()`` gates the 2-district 'bi' body to the workloads where
+the packing is clean and exact: uniform node population (the population
+test collapses to one boolean per chain per side; true of every
+reference config, grid_chain_sec11.py:221), W a multiple of 32 (rows
+align to words), accept in ('cut', 'always') (the 'corrected'
+boundary-ratio correction needs per-node degree counts the bit planes
+don't keep), and no record_assignment_bits.
+
+The k-district 'pair' walk (2 <= k <= 31) has its own bit body, gated
+by ``supported_pair()`` under the same conditions: district ids live as
+``ceil(log2(k))`` bit-sliced planes, neighbor equality is an OR of
+per-plane XORs, the population gates become one per-cell plane per side
+built in a single pass over the k districts, and selection runs over
+the four per-direction pair planes in the int8 body's (node, direction)
+order. Everything outside both gates silently uses the int8 bodies.
 """
 
 from __future__ import annotations
@@ -45,17 +53,29 @@ from .step import Spec, StepParams
 U32 = jnp.uint32
 
 
-def supported(bg, spec: Spec) -> bool:
-    """Static gate: may this chunk run on the bit-board body?"""
+def _common_gates(bg, spec: Spec) -> bool:
     return (
         bool(bg.uniform_pop)
         and bg.w % 32 == 0
-        and spec.n_districts == 2
-        and spec.proposal == "bi"
         and spec.accept in ("cut", "always")
         and spec.contiguity in ("patch", "none")
         and not spec.record_assignment_bits
     )
+
+
+def supported(bg, spec: Spec) -> bool:
+    """Static gate: may this chunk run on the 2-district bit body?"""
+    return (_common_gates(bg, spec)
+            and spec.n_districts == 2
+            and spec.proposal == "bi")
+
+
+def supported_pair(bg, spec: Spec) -> bool:
+    """Static gate for the k-district pair bit body (district ids as
+    ceil(log2(k)) bit-planes)."""
+    return (_common_gates(bg, spec)
+            and spec.proposal == "pair"
+            and 2 <= spec.n_districts <= 31)
 
 
 def n_words(n: int) -> int:
@@ -181,16 +201,11 @@ def bit_at(words, flat):
     return ((wsel >> (flat % 32).astype(U32)) & U32(1)).astype(jnp.int32)
 
 
-def select_flat(bg, valid, u):
-    """The (m+1)-th valid cell in flat row-major order — identical choice
-    to the int8 path's two-matmul selection, via popcounts.
-
-    Returns (flat, any_valid)."""
-    c = valid.shape[0]
-    h, w = bg.h, bg.w
-    wpr = w // 32                          # static; gated by supported()
-    pc = jax.lax.population_count(valid).astype(jnp.int32)
-    rowcnt = pc.reshape(c, h, wpr).sum(-1)
+def _pick_row(rowcnt, u):
+    """Shared first level of the two-level m-th-valid selection: draw m
+    uniform on the total count, pick the row holding the m-th valid slot.
+    Returns (row, m_in_row, any_valid, onehot-row (C, n_rows, 1))."""
+    h = rowcnt.shape[1]
     rowcum = jnp.cumsum(rowcnt, axis=1)
     total = rowcum[:, -1]
     any_valid = total > 0
@@ -200,9 +215,22 @@ def select_flat(bg, valid, u):
     oh_prev = jnp.arange(h)[None, :] == (row - 1)[:, None]
     before = jnp.sum(jnp.where(oh_prev, rowcum, 0), axis=1,
                      dtype=jnp.int32)
-    m_in_row = m - before
-
     oh_row = (jnp.arange(h)[None, :, None] == row[:, None, None])
+    return row, m - before, any_valid, oh_row
+
+
+def select_flat(bg, valid, u):
+    """The (m+1)-th valid cell in flat row-major order — identical choice
+    to the int8 path's two-matmul selection, via popcounts.
+
+    Returns (flat, any_valid)."""
+    c = valid.shape[0]
+    h, w = bg.h, bg.w
+    wpr = w // 32                          # static; gated by supported()
+    pc = jax.lax.population_count(valid).astype(jnp.int32)
+    row, m_in_row, any_valid, oh_row = _pick_row(
+        pc.reshape(c, h, wpr).sum(-1), u)
+
     rw = jnp.sum(jnp.where(oh_row, valid.reshape(c, h, wpr), U32(0)),
                  axis=1, dtype=U32)        # (C, wpr): the chosen row
     colcum = jnp.cumsum(unpack_bits(rw, w).astype(jnp.int32), axis=1)
@@ -217,6 +245,137 @@ def flip_bit(board_w, flat, accept):
            & accept[:, None])
     val = (U32(1) << (flat % 32).astype(U32))[:, None]
     return board_w ^ jnp.where(sel, val, U32(0))
+
+
+# ---------------------------------------------------------------------------
+# k-district pair walk on bit-sliced district ids
+# ---------------------------------------------------------------------------
+
+def bits_per_district(k: int) -> int:
+    return max(1, (k - 1).bit_length())
+
+
+def pack_board_planes(board, k: int):
+    """int8 (C, N) district ids -> list of bit-sliced (C, NW) planes,
+    plane b holding bit b of every id."""
+    return [pack_bits((board.astype(jnp.int32) >> b) & 1)
+            for b in range(bits_per_district(k))]
+
+
+def unpack_board_planes(planes, n: int):
+    out = jnp.zeros(planes[0].shape[:-1] + (n,), jnp.int8)
+    for b, p in enumerate(planes):
+        out = out + (unpack_bits(p, n) << b)
+    return out
+
+
+def _full_if_bit(bits, d):
+    """(C, 1) uint32: all-ones where bit ``d`` of per-chain mask is set."""
+    on = ((bits >> d) & 1) == 1
+    return jnp.where(on, U32(0xFFFFFFFF), U32(0))[:, None]
+
+
+def _eq_const(planes, d: int):
+    """Bit-plane mask of cells whose district id == d."""
+    acc = planes[0] if (d >> 0) & 1 else ~planes[0]
+    for b in range(1, len(planes)):
+        acc = acc & (planes[b] if (d >> b) & 1 else ~planes[b])
+    return acc
+
+
+def planes_bits_pair(bg, spec: Spec, params: StepParams, planes, dist_pop):
+    """Bit-plane analogue of board._planes_pair: per-(node, rook
+    direction) pair validity with district dedup, ring contiguity of the
+    origin district, per-chain district-bitmask population gates."""
+    k = spec.n_districts
+    masks = static_masks(bg)
+    w = bg.w
+    offs = [(shift_down, 1), (shift_down, w + 1), (shift_down, w),
+            (shift_down, w - 1), (shift_up, 1), (shift_up, w + 1),
+            (shift_up, w), (shift_up, w - 1)]
+    sh = [[fn(p, kk) for p in planes] for (fn, kk) in offs]   # 8 x B
+    same8, diff8 = [], []
+    for i in range(8):
+        x = planes[0] ^ sh[i][0]
+        for b in range(1, len(planes)):
+            x = x | (planes[b] ^ sh[i][b])
+        same8.append(~x & masks[i])
+        diff8.append(x & masks[i])
+
+    if spec.contiguity == "patch":
+        seeds_le1 = _at_most_one(same8[0], same8[2], same8[4], same8[6])
+        runs = [same8[i] & ~(same8[i - 1] & same8[i - 2])
+                for i in (0, 2, 4, 6)]
+        contig = seeds_le1 | _at_most_one(*runs)
+    else:
+        contig = ~jnp.zeros_like(diff8[0])
+
+    # population gates as per-chain district bitmasks (uniform pop)
+    unit = bg.pop[0].astype(jnp.float32)
+    dp = dist_pop.astype(jnp.float32)                        # (C, K)
+    from_ok = dp - unit >= params.pop_lo[:, None]
+    to_ok = dp + unit <= params.pop_hi[:, None]
+    weights = (jnp.int32(1) << jnp.arange(k, dtype=jnp.int32))[None, :]
+    from_bits = jnp.sum(jnp.where(from_ok, weights, 0), axis=1,
+                        dtype=jnp.int32)
+    to_bits = jnp.sum(jnp.where(to_ok, weights, 0), axis=1,
+                      dtype=jnp.int32)
+    # one pass over the k districts builds BOTH per-cell gate planes;
+    # each direction's to-gate is then just the shifted to_plane (pad
+    # garbage is masked by diff8)
+    ok_from = jnp.zeros_like(planes[0])
+    to_plane = jnp.zeros_like(planes[0])
+    for d in range(k):
+        eq = _eq_const(planes, d)
+        ok_from = ok_from | (eq & _full_if_bit(from_bits, d))
+        to_plane = to_plane | (eq & _full_if_bit(to_bits, d))
+
+    rook = (0, 2, 4, 6)                      # E, S, W, N (ring indices)
+    pair, b_count = [], jnp.zeros(planes[0].shape[0], jnp.int32)
+    for jj, i in enumerate(rook):
+        pj = diff8[i]
+        for jp in rook[:jj]:                 # dedup repeated districts
+            eq = sh[i][0] ^ sh[jp][0]
+            for b in range(1, len(planes)):
+                eq = eq | (sh[i][b] ^ sh[jp][b])
+            pj = pj & ~(masks[jp] & ~eq)
+        b_count = b_count + jax.lax.population_count(pj).astype(
+            jnp.int32).sum(1)
+        fn, kk = offs[i]
+        pair.append(pj & contig & ok_from & fn(to_plane, kk))
+
+    return dict(valid4=pair, b_count=b_count,
+                cut_e=diff8[0], cut_s=diff8[2])
+
+
+def select_flat_pair(bg, valid4, u):
+    """The (m+1)-th valid (node, direction) slot in the int8 pair body's
+    row-major order (flat' = v*4 + j). Returns (flat4, any_valid)."""
+    c = valid4[0].shape[0]
+    h, w = bg.h, bg.w
+    wpr = w // 32
+    pc = sum(jax.lax.population_count(vj).astype(jnp.int32)
+             for vj in valid4)
+    row, m_in_row, any_valid, oh_row = _pick_row(
+        pc.reshape(c, h, wpr).sum(-1), u)
+
+    rows = [jnp.sum(jnp.where(oh_row, vj.reshape(c, h, wpr), U32(0)),
+                    axis=1, dtype=U32) for vj in valid4]     # 4 x (C, wpr)
+    # interleave to the int8 body's (y, j) lexicographic order
+    row_bits = jnp.stack([unpack_bits(r, w) for r in rows],
+                         axis=2).reshape(c, 4 * w)
+    colcum = jnp.cumsum(row_bits.astype(jnp.int32), axis=1)
+    col4 = jnp.argmax(colcum > m_in_row[:, None],
+                      axis=1).astype(jnp.int32)
+    return row * (4 * w) + col4, any_valid
+
+
+def value_at(planes, flat):
+    """District id at ``flat[c]`` from the bit-sliced planes, int32."""
+    out = jnp.zeros(flat.shape, jnp.int32)
+    for b, p in enumerate(planes):
+        out = out + (bit_at(p, flat) << b)
+    return out
 
 
 def counter_init(c: int, nw: int, slices: int):

@@ -820,6 +820,79 @@ def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
             bitboard.counter_fold(ct_s_sl, n))
 
 
+def _scan_bits_pair(bg: BoardGraph, spec: Spec, params: StepParams,
+                    loop_state: BoardState, chunk: int, collect: bool):
+    """The k-district pair chunk scan on bit-sliced district planes
+    (kernel/bitboard.py): same trajectory as the int8 pair body,
+    bit-for-bit (tests/test_bitboard.py)."""
+    n = bg.n
+    c = loop_state.board.shape[0]
+    k = spec.n_districts
+    w = bg.w
+
+    def body(carry, _):
+        state, ct_e_sl, ct_s_sl = carry
+        key, kprop, kacc, kwait = _split4(state.key)
+        state = state.replace(key=key)
+        planes = bitboard.planes_bits_pair(bg, spec, params, state.board,
+                                           state.dist_pop)
+        cur_wait = _complete_wait(spec, state, planes["b_count"], kwait, n)
+        state, out, log = _record_common(state, planes["b_count"],
+                                         cur_wait)
+        ct_e_sl = bitboard.counter_add(ct_e_sl, planes["cut_e"])
+        ct_s_sl = bitboard.counter_add(ct_s_sl, planes["cut_s"])
+
+        u = _uniform(kprop)
+        flat4, any_valid = bitboard.select_flat_pair(
+            bg, planes["valid4"], u)
+        flat = flat4 // _PAIR_DIRS
+        j = flat4 % _PAIR_DIRS
+        offs = jnp.asarray([1, w, -1, -w], jnp.int32)
+        u_idx = jnp.clip(flat + offs[j], 0, n - 1)
+        d_from = bitboard.value_at(state.board, flat)
+        d_to = bitboard.value_at(state.board, u_idx)
+
+        south_ok = jnp.arange(n) < (bg.h - 1) * bg.w
+        north_ok = jnp.arange(n) >= bg.w
+        dcut = jnp.zeros(c, jnp.int32)
+        for off, ok in zip((1, w, -1, -w),
+                           (bg.east_ok, south_ok, bg.west_ok, north_ok)):
+            ui = jnp.clip(flat + off, 0, n - 1)
+            au = bitboard.value_at(state.board, ui)
+            ex = ok[flat]
+            dcut += jnp.where(ex, (au != d_to).astype(jnp.int32)
+                              - (au != d_from).astype(jnp.int32), 0)
+
+        accept = _accept_decision(spec, params, state.move_clock, dcut,
+                                  any_valid, kacc)
+        xor = d_from ^ d_to
+        new_planes = [
+            bitboard.flip_bit(p, flat, accept & (((xor >> b) & 1) == 1))
+            for b, p in enumerate(state.board)]
+        popv = bg.pop[0] * accept.astype(jnp.int32)
+        oh_to = jnp.arange(k)[None, :] == d_to[:, None]
+        oh_from = jnp.arange(k)[None, :] == d_from[:, None]
+        dist_pop = state.dist_pop + popv[:, None] * (
+            oh_to.astype(jnp.int32) - oh_from.astype(jnp.int32))
+        state = _commit_transition(state, params, new_planes, dist_pop,
+                                   flat, d_to, dcut, accept, any_valid)
+        return (state, ct_e_sl, ct_s_sl), (out if collect else {}, log)
+
+    nw = bitboard.n_words(n)
+    slices = max(chunk.bit_length(), 1)
+    loop_state = loop_state.replace(
+        board=bitboard.pack_board_planes(loop_state.board, k))
+    ct0 = (bitboard.counter_init(c, nw, slices),
+           bitboard.counter_init(c, nw, slices))
+    (loop_state, ct_e_sl, ct_s_sl), (outs, logs) = jax.lax.scan(
+        body, (loop_state, *ct0), None, length=chunk)
+    loop_state = loop_state.replace(
+        board=bitboard.unpack_board_planes(loop_state.board, n))
+    return (loop_state, outs, logs,
+            bitboard.counter_fold(ct_e_sl, n),
+            bitboard.counter_fold(ct_s_sl, n))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("spec", "chunk", "collect", "bits"))
 def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
@@ -841,12 +914,18 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
     loop_state = state.replace(
         **{k: None for k in _BOOKKEEPING})
 
-    if bits and not bitboard.supported(bg, spec):
+    bits_ok = (bitboard.supported_pair(bg, spec)
+               if spec.proposal == "pair"
+               else bitboard.supported(bg, spec))
+    if bits and not bits_ok:
         raise ValueError("bits=True: workload not supported by the "
-                         "bit-board body (see bitboard.supported)")
-    use_bits = bitboard.supported(bg, spec) if bits is None else bits
+                         "bit-board body (see bitboard.supported / "
+                         "supported_pair)")
+    use_bits = bits_ok if bits is None else bits
     if use_bits:
-        (loop_state, outs, logs, cte, cts) = _scan_bits(
+        scan_bits = (_scan_bits_pair if spec.proposal == "pair"
+                     else _scan_bits)
+        (loop_state, outs, logs, cte, cts) = scan_bits(
             bg, spec, params, loop_state, chunk, collect)
         big["cut_times_e"] = big["cut_times_e"] + cte
         big["cut_times_s"] = big["cut_times_s"] + cts

@@ -73,6 +73,20 @@ def test_select_flat_picks_mth_valid(rng):
         assert flat[ci] == idxs[m], ci
 
 
+def assert_run_equal(st, got, want):
+    """Field-for-field equality of two (state, outs) chunk results."""
+    got_state, got_outs = got
+    want_state, want_outs = want
+    for f in st.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got_state, f)),
+            np.asarray(getattr(want_state, f)), err_msg=f)
+    for key in want_outs:
+        np.testing.assert_array_equal(np.asarray(got_outs[key]),
+                                      np.asarray(want_outs[key]),
+                                      err_msg=key)
+
+
 @pytest.mark.parametrize("hw,spec_kw", [
     ((6, 32), {}),
     ((4, 64), {}),
@@ -97,19 +111,48 @@ def test_bit_identity_vs_int8_body(rng, hw, spec_kw):
         g, plan, n_chains=8, seed=11, spec=spec, base=1.7, pop_tol=0.3)
     assert bb.supported(bg, spec)
 
-    got_state, got_outs = kb.run_board_chunk(bg, spec, params, st, 75)
     # bits=False forces the int8 body first-class (same jit, distinct
     # cache entry)
-    want_state, want_outs = kb.run_board_chunk(bg, spec, params, st, 75,
-                                               bits=False)
+    assert_run_equal(st, kb.run_board_chunk(bg, spec, params, st, 75),
+                     kb.run_board_chunk(bg, spec, params, st, 75,
+                                        bits=False))
 
-    for f in st.__dataclass_fields__:
-        np.testing.assert_array_equal(
-            np.asarray(getattr(got_state, f)),
-            np.asarray(getattr(want_state, f)), err_msg=f)
-    for k in want_outs:
-        np.testing.assert_array_equal(np.asarray(got_outs[k]),
-                                      np.asarray(want_outs[k]), err_msg=k)
+
+@pytest.mark.parametrize("hw,k,spec_kw", [
+    ((6, 32), 3, {}),
+    ((4, 64), 4, {}),
+    ((6, 32), 8, {}),
+    ((6, 32), 4, dict(accept="always")),
+    ((6, 32), 3, dict(contiguity="none")),
+    ((6, 32), 5, dict(geom_waits=False, parity_metrics=False)),
+])
+def test_pair_bit_identity_vs_int8_body(hw, k, spec_kw):
+    """The k-district pair bit body (district ids as bit-sliced planes)
+    equals the int8 pair body forced via bits=False — field for field."""
+    h, w = hw
+    g = fce.graphs.square_grid(h, w)
+    plan = fce.graphs.stripes_plan(g, k)
+    kw = dict(n_districts=k, proposal="pair", contiguity="patch",
+              invalid="repropose", accept="cut", parity_metrics=True,
+              geom_waits=True, record_interface=False)
+    kw.update(spec_kw)
+    spec = fce.Spec(**kw)
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=8, seed=7, spec=spec, base=1.4, pop_tol=0.5)
+    assert bb.supported_pair(bg, spec)
+
+    assert_run_equal(st, kb.run_board_chunk(bg, spec, params, st, 60),
+                     kb.run_board_chunk(bg, spec, params, st, 60,
+                                        bits=False))
+
+
+def test_pack_board_planes_roundtrip(rng):
+    for k in (2, 3, 5, 8):
+        board = rng.integers(0, k, size=(3, 100)).astype(np.int8)
+        planes = bb.pack_board_planes(jnp.asarray(board), k)
+        assert len(planes) == bb.bits_per_district(k)
+        back = bb.unpack_board_planes(planes, 100)
+        np.testing.assert_array_equal(np.asarray(back), board)
 
 
 def test_dispatch_gates():
