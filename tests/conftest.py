@@ -22,3 +22,16 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+def assert_grid_districts_connected(boards, k):
+    """Every district of every (C, H, W) board is nonempty and
+    rook-connected (scipy 4-connectivity labeling)."""
+    from scipy.ndimage import label as cc_label
+
+    for c in range(boards.shape[0]):
+        for d in range(k):
+            member = boards[c] == d
+            assert member.any(), f"chain {c} district {d} vanished"
+            _, ncomp = cc_label(member)
+            assert ncomp == 1, f"chain {c} district {d}: {ncomp} components"

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 
 import flipcomplexityempirical_tpu as fce
+
+from conftest import assert_grid_districts_connected
 from flipcomplexityempirical_tpu.kernel import board as kb
 from flipcomplexityempirical_tpu.kernel import contiguity
 
@@ -263,11 +265,7 @@ def test_board_invariants():
 
     # every chain still satisfies contiguity (district connected) — the
     # single masked draw must never commit a disconnecting flip
-    from scipy.ndimage import label as cc_label
-    for c in range(b.shape[0]):
-        for d in (0, 1):
-            _, ncomp = cc_label(b[c] == d)
-            assert ncomp == 1, f"chain {c} district {d} split into {ncomp}"
+    assert_grid_districts_connected(b, 2)
 
     # accumulators tie out against histories
     cut_t = kb.edge_cut_times(g, res.state)

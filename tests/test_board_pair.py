@@ -12,6 +12,8 @@ gather-path kernel running the same spec.
 import numpy as np
 
 import flipcomplexityempirical_tpu as fce
+
+from conftest import assert_grid_districts_connected
 from flipcomplexityempirical_tpu.kernel import board as kb
 
 from test_parity import ks_stat
@@ -129,11 +131,7 @@ def test_pair_run_invariants():
            + (b[:, :-1, :] != b[:, 1:, :]).sum(axis=(1, 2)))
     np.testing.assert_array_equal(np.asarray(s.cut_count), cut)
 
-    from scipy.ndimage import label as cc_label
-    for c in range(b.shape[0]):
-        for d in range(k):
-            _, ncomp = cc_label(b[c] == d)
-            assert ncomp == 1, f"chain {c} district {d} split"
+    assert_grid_districts_connected(b, k)
 
     ideal = 64 / k
     dp = np.asarray(s.dist_pop)
@@ -202,8 +200,4 @@ def test_pair_k8_smoke():
     s = res.host_state()
     assert (np.asarray(s.tries_sum) == 300).all()
     b = np.asarray(s.board).reshape(-1, 8, 16)
-    from scipy.ndimage import label as cc_label
-    for c in range(b.shape[0]):
-        for d in range(8):
-            _, ncomp = cc_label(b[c] == d)
-            assert ncomp == 1
+    assert_grid_districts_connected(b, 8)

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 
 import flipcomplexityempirical_tpu as fce
+
+from conftest import assert_grid_districts_connected
 from flipcomplexityempirical_tpu.kernel import board as kb
 from flipcomplexityempirical_tpu.kernel import pallas_board as pb
 
@@ -198,10 +200,7 @@ def test_kernel_invariants_and_log_replay(rng):
     st2 = pb.unpack_state(st, bg, outs, 60)
     b = np.asarray(st2.board).reshape(-1, H, W)
 
-    from scipy.ndimage import label as cc_label
-    for c in range(b.shape[0]):
-        for d in (0, 1):
-            assert cc_label(b[c] == d)[1] == 1
+    assert_grid_districts_connected(b, 2)
     ideal = N / 2
     dp = np.asarray(st2.dist_pop)
     assert (dp >= 0.9 * ideal - 1e-6).all() and (dp <= 1.1 * ideal).all()
@@ -321,10 +320,7 @@ def test_pallas_runner_end_to_end_interpret(rng):
     b = s.board.reshape(chains, H, W)
     pop0 = (b == 0).sum(axis=(1, 2))
     np.testing.assert_array_equal(s.dist_pop[:, 0], pop0)
-    from scipy.ndimage import label as cc_label
-    for c in range(chains):
-        for d in (0, 1):
-            assert cc_label(b[c] == d)[1] == 1
+    assert_grid_districts_connected(b, 2)
     assert (s.t_yield == steps).all()
 
 

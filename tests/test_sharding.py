@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import pytest
 
 import flipcomplexityempirical_tpu as fce
+
+from conftest import assert_grid_districts_connected
 from flipcomplexityempirical_tpu import distribute
 from flipcomplexityempirical_tpu.sampling import tempering
 
@@ -128,6 +130,33 @@ def test_within_batch_tempering_board_path():
     hot = cuts[beta_flat == b32[0]].mean()
     cold = cuts[beta_flat == b32[-1]].mean()
     assert hot > cold, (hot, cold)
+
+
+def test_board_sharded_pair_train_step():
+    """The k-district pair walk composes with the sharded board train
+    step: chunks auto-dispatch (pair bit body on this 32-aligned grid)
+    and the exchange ladder reads the carried cut_count."""
+    k = 4
+    g = fce.graphs.square_grid(4, 32)
+    plan = fce.graphs.stripes_plan(g, k)
+    spec = fce.Spec(n_districts=k, proposal="pair", contiguity="patch")
+    bg, states, params = fce.sampling.init_board(
+        g, plan, n_chains=16, seed=0, spec=spec, base=1.3, pop_tol=0.6)
+    mesh = distribute.make_mesh(8)
+    betas = np.repeat(np.linspace(0.25, 2.0, 8), 2).astype(np.float32)
+    params = params.replace(beta=jnp.asarray(betas))
+    states = distribute.shard_chain_batch(mesh, states)
+    params = distribute.shard_chain_batch(mesh, params)
+    from flipcomplexityempirical_tpu.kernel import bitboard as bb
+    assert bb.supported_pair(bg, spec)   # the documented dispatch claim
+    step = distribute.make_board_train_step(bg, spec, mesh, inner_steps=5,
+                                            exchange=True)
+    params, states, info = step(jax.random.PRNGKey(2), params, states)
+    t = np.asarray(jax.device_get(states.t_yield))
+    assert int(t.sum()) == 16 * 5, t
+    assert int(info["accepts"]) > 0
+    b = np.asarray(jax.device_get(states.board)).reshape(-1, 4, 32)
+    assert_grid_districts_connected(b, k)
 
 
 def test_board_sharded_run_bit_identical():
