@@ -49,7 +49,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..graphs.lattice import LatticeGraph
-from .board import (BoardGraph, BoardState, board_shape, recount_cuts,
+from .board import (BoardGraph, BoardState, recount_cuts,
                     supports as _board_supports)
 from .step import Spec, StepParams
 
