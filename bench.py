@@ -13,8 +13,16 @@ Routes through the board (stencil) fast path when
 distribution-identical to the general path — and falls back to the general
 gather/while_loop kernel otherwise (``--general`` forces the fallback).
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "flips/s", "vs_baseline": N}
+Prints exactly one JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "flips/s", "vs_baseline": N,
+   "device": ..., "path": ..., "repeats": N, "repeat_policy": "best",
+   ["body": ...,] ["cpu_fallback": true]}
+When the accelerator probe fails the measurement still happens, on host
+CPU with a reduced default chain count, tagged "device": "cpu-fallback"
+and "cpu_fallback": true — vs_baseline then still divides by the PER-CHIP
+TPU target and is not comparable to it; the tag is what makes the record
+interpretable. Per-run detail (chains, seconds, accept rate) goes to
+stderr as a second JSON object.
 """
 
 import argparse
@@ -68,10 +76,14 @@ def main():
                  f"warmup-1 must be >= chunk, so the warmup actually "
                  "compiles the chunk-length kernel the timed region reuses")
 
+    cpu_fallback = False
     if not args.cpu:
-        # fail fast when the accelerator backend is unreachable (a hung
-        # device claim otherwise stalls the caller for its full timeout);
-        # probe in a subprocess so this process's backend stays untouched
+        # probe the accelerator in a subprocess (a hung device claim would
+        # otherwise stall this process for the caller's full timeout, and
+        # probing in-process would pin our backend choice). On failure,
+        # fall back to an EXPLICIT CPU measurement rather than exiting
+        # empty-handed: a round's benchmark record must never be null just
+        # because the device tunnel is down (round-3 post-mortem).
         import subprocess
         err = b""
         try:
@@ -89,9 +101,16 @@ def main():
             for line in tail:
                 print(f"bench probe: {line}", file=sys.stderr)
             print("bench: accelerator backend unreachable or fell back "
-                  "to CPU (device probe); rerun with --cpu for an "
-                  "explicit CPU measurement", file=sys.stderr)
-            sys.exit(3)
+                  "to CPU (device probe); emitting a CPU-tagged "
+                  "measurement (the TPU number this stands in for is NOT "
+                  "comparable to vs_baseline's per-chip target)",
+                  file=sys.stderr)
+            cpu_fallback = True
+            args.cpu = True
+            if args.chains == ap.get_default("chains"):
+                # keep the fallback's wall clock tolerable: fewer chains,
+                # same per-chain horizon; the JSON carries the real count
+                args.chains = 512
 
     import jax
     if args.cpu:
@@ -180,13 +199,15 @@ def main():
     fps = flips / dt
     s = res.host_state()
     meta = {
-        "device": str(jax.devices()[0]),
+        "device": ("cpu-fallback" if cpu_fallback else str(jax.devices()[0])),
         "path": ("pallas" if use_board and args.pallas
                  else "board" if use_board else "general"),
         "chains": args.chains,
         "steps": args.steps,
         "grid": args.grid,
         "seconds": round(dt, 3),
+        "repeats": max(repeats, 1),
+        "repeat_policy": "best",
         "mean_tries_per_step": float(np.asarray(s.tries_sum).mean()
                                      / (args.steps - 1)),
         "accept_rate": float(np.asarray(s.accept_count).mean()
@@ -217,12 +238,25 @@ def main():
         print(json.dumps(meta_ess), file=sys.stderr)
 
     print(json.dumps(meta), file=sys.stderr)
-    print(json.dumps({
+    headline = {
         "metric": "flips_per_sec_per_chip_64x64",
         "value": round(fps, 1),
         "unit": "flips/s",
         "vs_baseline": round(fps / 1.25e6, 4),
-    }))
+        # interpretability tags (VERDICT r3): where the number ran, which
+        # kernel body won, and the repeat policy behind it
+        "device": meta["device"],
+        "path": meta["path"],
+        "repeats": meta["repeats"],
+        "repeat_policy": "best",
+    }
+    if "body" in meta:
+        headline["body"] = meta["body"]
+    if cpu_fallback:
+        # explicit stand-in: measured on host CPU because the accelerator
+        # probe failed; vs_baseline still divides by the PER-CHIP target
+        headline["cpu_fallback"] = True
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

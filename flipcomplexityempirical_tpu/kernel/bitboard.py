@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .step import Spec, StepParams
+from .step import Spec, StepParams, geom_denom_finite
 
 U32 = jnp.uint32
 
@@ -72,10 +72,13 @@ def supported(bg, spec: Spec) -> bool:
 
 def supported_pair(bg, spec: Spec) -> bool:
     """Static gate for the k-district pair bit body (district ids as
-    ceil(log2(k)) bit-planes)."""
+    ceil(log2(k)) bit-planes). Mirrors board.supports' geom-wait bound:
+    the literal n**k - 1 wait denominator must stay finite in f32."""
     return (_common_gates(bg, spec)
             and spec.proposal == "pair"
-            and 2 <= spec.n_districts <= 31)
+            and 2 <= spec.n_districts <= 31
+            and (not spec.geom_waits
+                 or geom_denom_finite(bg.n, spec.n_districts)))
 
 
 def n_words(n: int) -> int:
@@ -172,11 +175,16 @@ def planes_bits(bg, spec: Spec, params: StepParams, board_w, dist_pop):
 
     # uniform population: the bound test collapses to one boolean per
     # chain per side (board.supports gates non-uniform pop off this body)
+    # ceil/floor keep every operand an exact f32 integer so this matches
+    # the general path's exact-difference bound test bit-for-bit (see
+    # board._board_planes' population-gate comment)
     unit = bg.pop[0].astype(jnp.float32)
     p0 = dist_pop[:, 0].astype(jnp.float32)
     p1 = dist_pop[:, 1].astype(jnp.float32)
-    ok0 = unit <= jnp.minimum(p0 - params.pop_lo, params.pop_hi - p1)
-    ok1 = unit <= jnp.minimum(p1 - params.pop_lo, params.pop_hi - p0)
+    lo = jnp.ceil(params.pop_lo)
+    hi = jnp.floor(params.pop_hi)
+    ok0 = unit <= jnp.minimum(p0 - lo, hi - p1)
+    ok1 = unit <= jnp.minimum(p1 - lo, hi - p0)
     full = U32(0xFFFFFFFF)
     pop_ok = ((board_w & jnp.where(ok1, full, U32(0))[:, None])
               | (~board_w & jnp.where(ok0, full, U32(0))[:, None]))

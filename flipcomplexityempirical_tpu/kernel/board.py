@@ -88,6 +88,7 @@ from flax import struct
 from ..graphs.lattice import LatticeGraph
 from . import bitboard
 from .step import Spec, StepParams, sample_geom_minus1
+from .step import geom_denom_finite as kstep_geom_ok
 
 @struct.dataclass
 class BoardGraph:
@@ -181,10 +182,16 @@ def supports(graph: LatticeGraph, spec: Spec) -> bool:
     elif spec.proposal == "pair" and 2 <= spec.n_districts <= 31:
         # k-district pair walk (slow_reversible_propose): the pair body
         # needs uniform node population (its per-district bound test is a
-        # per-chain bitmask) and has no reversibility-corrected accept
+        # per-chain bitmask) and has no reversibility-corrected accept;
+        # geom waits need the literal n**k - 1 denominator to stay finite
+        # in f32; gating here fails such configs at init (the general
+        # fallback raises the explanatory error from sample_geom_minus1)
+        # instead of mid-trace inside a board body
         pop = np.asarray(graph.pop)
         prop_ok = (spec.accept in ("cut", "always")
-                   and pop.size > 0 and bool((pop == pop[0]).all()))
+                   and pop.size > 0 and bool((pop == pop[0]).all())
+                   and (not spec.geom_waits or kstep_geom_ok(
+                       graph.n_nodes, spec.n_districts)))
     else:
         return False
     return (
@@ -300,11 +307,18 @@ def _planes(bg: BoardGraph, spec: Spec, params: StepParams,
     # population bounds for flipping each node OUT of its current district
     # collapse to one per-chain threshold per side (flipping out of d must
     # keep d >= pop_lo and the other side <= pop_hi), so the plane test is
-    # a single broadcast compare instead of two (C, N) f32 constructions
+    # a single broadcast compare instead of two (C, N) f32 constructions.
+    # ceil/floor of the f32 bounds keep every operand an exact f32 integer
+    # (populations < 2^24), so the compare reproduces the general path's
+    # exact-difference test (p0 - popn >= pop_lo) bit-for-bit: an integer
+    # m >= real lo iff m >= ceil(lo), and fl(p0 - ceil(lo)) is exact where
+    # fl(p0 - pop_lo) could round across an integer.
     p0 = state.dist_pop[:, 0].astype(jnp.float32)
     p1 = state.dist_pop[:, 1].astype(jnp.float32)
-    thr0 = jnp.minimum(p0 - params.pop_lo, params.pop_hi - p1)  # leaving 0
-    thr1 = jnp.minimum(p1 - params.pop_lo, params.pop_hi - p0)  # leaving 1
+    lo = jnp.ceil(params.pop_lo)
+    hi = jnp.floor(params.pop_hi)
+    thr0 = jnp.minimum(p0 - lo, hi - p1)  # leaving 0
+    thr1 = jnp.minimum(p1 - lo, hi - p0)  # leaving 1
     is1 = board == 1
     popn = bg.pop[None].astype(jnp.float32)
     pop_ok = popn <= jnp.where(is1, thr1[:, None], thr0[:, None])

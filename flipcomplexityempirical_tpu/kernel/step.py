@@ -26,6 +26,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from ..graphs.lattice import DeviceGraph
@@ -140,10 +141,28 @@ def effective_beta(spec: Spec, params: StepParams, state: ChainState):
     raise ValueError(f"anneal mode {spec.anneal!r}")
 
 
+def geom_denom_finite(n_nodes: int, k: int) -> bool:
+    """True iff the literal wait denominator n**k - 1 survives the f32
+    cast. Past that point p underflows to 0 and every wait silently
+    becomes infinite, diverging from the reference's float64 geom_wait —
+    the single guard shared by sample_geom_minus1 and the fast-path gates
+    (board.supports, bitboard.supported_pair)."""
+    return bool(np.isfinite(np.float32(float(n_nodes) ** k - 1.0)))
+
+
 def sample_geom_minus1(key, b_count, n_nodes: int, k: int):
     """The reference waiting-time sample (grid_chain_sec11.py:147-148):
     Geometric(p) - 1 with p = |b_nodes| / (n_nodes**k - 1), via inverse CDF.
+
+    Large-k configs whose denominator fails ``geom_denom_finite`` must
+    disable ``Spec.geom_waits`` (their waits exceed f32/int64 range, so
+    no backend could represent them anyway).
     """
+    if not geom_denom_finite(n_nodes, k):
+        raise ValueError(
+            f"geom_waits: denominator n**k - 1 = {n_nodes}**{k} - 1 "
+            f"overflows float32; disable Spec.geom_waits for this config "
+            f"(its waits are not representable)")
     denom = jnp.float32(float(n_nodes) ** k - 1.0)
     p = b_count.astype(jnp.float32) / denom
     u = jnp.maximum(jax.random.uniform(key), jnp.float32(1e-12))

@@ -270,6 +270,52 @@ def test_pair_walk_matches_exact_stationary(path):
                               states, pi, cuts)
 
 
+@pytest.mark.parametrize("path", ["general", "board"])
+def test_pair_walk_k2_equals_bi_walk(path):
+    """At k=2 the pair move set — distinct (node, adjacent-other-district)
+    pairs (grid_chain_sec11.py:117-130) — is in bijection with the bi move
+    set (boundary nodes, grid_chain_sec11.py:132-145): each boundary node
+    has exactly one other district to move to. So the k=2 pair chain must
+    match the exact stationary distribution of the BI transition matrix,
+    and its |b_nodes| (distinct-pair count) must equal the boundary-node
+    count at every recorded state."""
+    base = 2.0
+    g, nbrmask = build_masks()
+    states = enumerate_states(nbrmask)
+    P, cuts = build_transition(states, g, base)   # the BI chain's matrix
+    pi = stationary(P)
+
+    spec = fce.Spec(n_districts=2, proposal="pair", contiguity="patch",
+                    record_assignment_bits=True, geom_waits=False,
+                    parity_metrics=False)
+    plan = fce.graphs.stripes_plan(g, 2)
+    chains, steps, burn = 48, 12000, 2000
+    if path == "general":
+        dg, st, params = fce.init_batch(g, plan, n_chains=chains, seed=31,
+                                        spec=spec, base=base, pop_tol=EPS)
+        res = fce.run_chains(dg, spec, params, st, n_steps=steps)
+    else:
+        bg, st, params = fce.sampling.init_board(
+            g, plan, n_chains=chains, seed=32, spec=spec, base=base,
+            pop_tol=EPS)
+        res = fce.sampling.run_board(bg, spec, params, st, n_steps=steps)
+    abits = np.asarray(res.history["abits"])
+    assert_matches_stationary(abits[:, burn:].ravel(), states, pi, cuts)
+
+    # |b_nodes|(pair, k=2) == boundary-node count, recomputed from the
+    # recorded assignments with independent numpy
+    sub = abits[:4]                                    # (4, T)
+    a = (sub[..., None] >> np.arange(N)) & 1           # (4, T, N)
+    e = g.edges
+    cut = a[..., e[:, 0]] != a[..., e[:, 1]]           # (4, T, E)
+    is_b = np.zeros(a.shape, bool)
+    ci, ti, ei = np.nonzero(cut)
+    is_b[ci, ti, e[ei, 0]] = True
+    is_b[ci, ti, e[ei, 1]] = True
+    np.testing.assert_array_equal(
+        np.asarray(res.history["b_count"])[:4], is_b.sum(-1))
+
+
 @pytest.mark.parametrize("base", [0.5, 2.0])
 def test_board_path_matches_exact_stationary(base):
     """The board (stencil) fast path faces the same exact-enumeration bar

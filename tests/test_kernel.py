@@ -1,6 +1,8 @@
 """Kernel step tests: derived-field invariants, connectivity preservation,
 acceptance math, parity bookkeeping quirks, geometric waits."""
 
+import dataclasses
+
 import numpy as np
 import networkx as nx
 import jax
@@ -136,6 +138,30 @@ def test_geom_wait_distribution():
     expect = (1 - p) / p
     assert abs(w.mean() - expect) / expect < 0.05
     assert (w >= 0).all()
+
+
+def test_geom_wait_overflow_guard():
+    """n**k - 1 past f32 range must raise (silent p=0 => infinite waits
+    diverging from the reference's float64 geom_wait), and the board gates
+    must route such configs off the geom-sampling bodies."""
+    key = jax.random.PRNGKey(0)
+    with pytest.raises(ValueError, match="geom_waits"):
+        kstep.sample_geom_minus1(key, jnp.int32(5), 4096, 11)
+    # k=10 at n=4096 is the last finite config and still samples
+    w = kstep.sample_geom_minus1(key, jnp.int32(5), 4096, 10)
+    assert np.isfinite(float(w))
+
+    from flipcomplexityempirical_tpu.kernel import bitboard, board
+    g = fce.graphs.square_grid(64, 64)
+    for k, ok in [(8, True), (11, False)]:
+        spec = fce.Spec(n_districts=k, proposal="pair", contiguity="patch",
+                        geom_waits=True, parity_metrics=False)
+        assert board.supports(g, spec) == ok
+        bg = board.make_board_graph(g)
+        assert bitboard.supported_pair(bg, spec) == ok
+        nogeom = dataclasses.replace(spec, geom_waits=False)
+        assert board.supports(g, nogeom)
+        assert bitboard.supported_pair(bg, nogeom)
 
 
 def test_interface_metrics_vertical_split():
