@@ -23,6 +23,7 @@ Semantics parity notes (each is load-bearing for replication targets):
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
@@ -146,8 +147,11 @@ def geom_denom_finite(n_nodes: int, k: int) -> bool:
     cast. Past that point p underflows to 0 and every wait silently
     becomes infinite, diverging from the reference's float64 geom_wait —
     the single guard shared by sample_geom_minus1 and the fast-path gates
-    (board.supports, bitboard.supported_pair)."""
-    return bool(np.isfinite(np.float32(float(n_nodes) ** k - 1.0)))
+    (board.supports, bitboard.supported_pair). Compared in log space:
+    float(n)**k itself would raise OverflowError past 1e308."""
+    if n_nodes <= 1:
+        return True
+    return k * math.log(float(n_nodes)) < math.log(3.4028235e38)
 
 
 def sample_geom_minus1(key, b_count, n_nodes: int, k: int):

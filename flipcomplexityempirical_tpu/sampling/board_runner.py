@@ -19,7 +19,7 @@ from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
 from .runner import (RunResult, default_label_values, pick_chunk,
-                     pop_bounds)
+                     pop_bounds, snap_chunk_to, thin_outs)
 
 
 def init_board(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
@@ -58,11 +58,14 @@ def _sum_pending(waits_total, pending_waits):
 
 
 def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
-                       pending_waits, record_history, n_steps) -> RunResult:
+                       pending_waits, record_history, n_steps,
+                       record_every: int = 1) -> RunResult:
     """Shared run epilogue for the board-path runners: record the final
-    yield (no trailing transition), drain waits, assemble the RunResult."""
+    yield (no trailing transition), drain waits, assemble the RunResult.
+    Under thinning the final yield joins the history only when it lands
+    on the record grid (its wait/bookkeeping effects apply regardless)."""
     state, out_last = kboard.record_final(bg, spec, params, state)
-    if record_history:
+    if record_history and (n_steps - 1) % record_every == 0:
         out_last = jax.tree.map(np.asarray, out_last)
         for k, v in out_last.items():
             hist_parts.setdefault(k, []).append(v[:, None])
@@ -78,13 +81,20 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
               state: kboard.BoardState, n_steps: int,
               record_history: bool = True,
               chunk: Optional[int] = None,
-              bits: Optional[bool] = None) -> RunResult:
+              bits: Optional[bool] = None,
+              record_every: int = 1) -> RunResult:
     """Run the batched board chain for ``n_steps`` yields (yield 0 is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
     ``bits`` overrides the bit-board body dispatch (perf toggle; the
-    bodies are bit-identical)."""
+    bodies are bit-identical). ``record_every=k`` keeps only yields
+    0, k, 2k, ... in the returned history (accumulators still advance
+    every step), strided on device before the host copy."""
+    if record_every < 1:
+        raise ValueError(f"record_every must be >= 1, got {record_every}")
     if chunk is None:
         chunk = pick_chunk(n_steps, 2048)
+    if record_every > 1:
+        chunk = snap_chunk_to(chunk, record_every)
 
     hist_parts: dict = {}
     waits_total = np.asarray(state.waits_sum, np.float64).copy()
@@ -99,7 +109,10 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
                                              collect=record_history,
                                              bits=bits)
         if record_history:
-            outs = jax.tree.map(np.asarray, outs)
+            # board chunks record BEFORE transitioning, so block-local
+            # index 0 is already on the global grid
+            outs = jax.tree.map(np.asarray,
+                                thin_outs(outs, record_every, offset=0))
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (T, C) -> (C, T)
         state = drain_waits(state, pending_waits)
@@ -107,4 +120,4 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
 
     return finalize_board_run(bg, spec, params, state, hist_parts,
                               waits_total, pending_waits, record_history,
-                              n_steps)
+                              n_steps, record_every)

@@ -117,21 +117,52 @@ def _record_initial(dg: DeviceGraph, spec: Spec, params: StepParams,
                     in_axes=(paxes, 0))(params, states)
 
 
+def thin_outs(outs: dict, every: int, offset: Optional[int] = None):
+    """Device-side stride of a chunk's (T, C) history block BEFORE host
+    transfer: keeps a 1e4-chain x 1e5-step recorded run inside host RAM
+    (and cuts the device->host copy) by the thinning factor. The default
+    slice offset ``every - 1`` puts record-after-transition chunks on the
+    global grid 0, every, 2*every, ... shared with the initial record;
+    the board runner's record-before-transition chunks pass offset 0."""
+    if every == 1:
+        return outs
+    if offset is None:
+        offset = every - 1
+    return {k: v[offset::every] for k, v in outs.items()}
+
+
+def snap_chunk_to(chunk: int, every: int) -> int:
+    """Largest multiple of ``every`` <= chunk (at least ``every``): full
+    chunks must hold a whole number of record periods so every chunk
+    boundary lands on the thinned grid."""
+    return max(every, chunk - chunk % every)
+
+
 def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                states: ChainState, n_steps: int,
                record_history: bool = True,
                chunk: Optional[int] = None,
-               record_initial: bool = True) -> RunResult:
+               record_initial: bool = True,
+               record_every: int = 1) -> RunResult:
     """Run the batched chain for ``n_steps`` yields (the first yield is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
 
     ``record_initial=False`` continues an earlier run: the current state
     was already recorded as that run's last yield, so all ``n_steps``
     yields here are fresh transitions (checkpoint-resume path).
+
+    ``record_every=k`` records yields 0, k, 2k, ... only (metric
+    accumulators — cut_times, flip counts, waits — still advance every
+    step; only the returned history is strided). When continuing a run,
+    segment lengths divisible by k keep the grid uniform across segments.
     """
     n_chains = states.assignment.shape[0]
+    if record_every < 1:
+        raise ValueError(f"record_every must be >= 1, got {record_every}")
     if chunk is None:
         chunk = pick_chunk(n_steps + (0 if record_initial else 1), 4096)
+    if record_every > 1:
+        chunk = snap_chunk_to(chunk, record_every)
 
     if record_initial:
         states, out0 = _record_initial(dg, spec, params, states)
@@ -151,7 +182,7 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         states, outs = _run_chunk(dg, spec, params, states, this,
                                   collect=record_history)
         if record_history:
-            outs = jax.tree.map(np.asarray, outs)
+            outs = jax.tree.map(np.asarray, thin_outs(outs, record_every))
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (chunk, C)->(C,)
         waits_total += np.asarray(states.waits_sum, np.float64)
