@@ -8,16 +8,19 @@ Runs the reference sweep grids with skip-if-done resume, emitting the
 
 import argparse
 
-from .config import sec11_sweep, frank_sweep
+from .config import SWEEPS
 from .driver import run_sweep
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--family", choices=["sec11", "frank"], required=True)
+    ap.add_argument("--family", choices=sorted(SWEEPS), required=True)
     ap.add_argument("--out", required=True)
     ap.add_argument("--steps", type=int, default=100_000)
     ap.add_argument("--chains", type=int, default=8)
+    ap.add_argument("--record-every", type=int, default=1,
+                    help="history thinning through the runners (yields "
+                         "0, k, 2k, ... recorded; accumulators exact)")
     ap.add_argument("--backend", choices=["jax", "python"], default="jax")
     ap.add_argument("--contiguity", choices=["patch", "exact"],
                     default="patch")
@@ -46,10 +49,10 @@ def main():
         jax.config.update("jax_compilation_cache_dir", args.jax_cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    sweep = sec11_sweep if args.family == "sec11" else frank_sweep
+    sweep = SWEEPS[args.family]
     configs = list(sweep(total_steps=args.steps, n_chains=args.chains,
                          backend=args.backend, contiguity=args.contiguity,
-                         seed=args.seed,
+                         seed=args.seed, record_every=args.record_every,
                          checkpoint_every=args.checkpoint_every))
     if args.only:
         configs = [c for c in configs if c.tag in set(args.only)]

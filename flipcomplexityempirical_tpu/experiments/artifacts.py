@@ -30,18 +30,27 @@ def _nx_graph(graph: LatticeGraph):
     return g
 
 
-def _draw_nodes(graph, values, path, node_size, cmap="tab20"):
+def _positions(graph, pos=None):
+    """Node positions for drawing: the labels themselves when they are
+    coordinate tuples (the reference's pos={x: x}), else caller-provided
+    (dual graphs pass precinct centroids)."""
+    if pos is not None:
+        return {lab: tuple(pos[graph.index[lab]]) for lab in graph.labels}
+    return {x: x for x in graph.labels}
+
+
+def _draw_nodes(graph, values, path, node_size, cmap="tab20", pos=None):
     import networkx as nx
     g = _nx_graph(graph)
     plt.figure()
-    nx.draw(g, pos={x: x for x in graph.labels},
+    nx.draw(g, pos=_positions(graph, pos),
             node_color=[values[graph.index[x]] for x in graph.labels],
             node_size=node_size, node_shape="s", cmap=cmap)
     plt.savefig(path)
     plt.close()
 
 
-def _draw_edges(graph, edge_values, path):
+def _draw_edges(graph, edge_values, path, pos=None):
     import networkx as nx
     g = _nx_graph(graph)
     colors = {}
@@ -50,7 +59,7 @@ def _draw_edges(graph, edge_values, path):
         v = graph.labels[graph.edges[e, 1]]
         colors[frozenset((u, v))] = edge_values[e]
     plt.figure()
-    nx.draw(g, pos={x: x for x in graph.labels},
+    nx.draw(g, pos=_positions(graph, pos),
             node_color=[0 for _ in graph.labels], node_size=10,
             edge_color=[colors[frozenset(e)] for e in g.edges()],
             node_shape="s", cmap="jet", width=5)
@@ -61,12 +70,19 @@ def _draw_edges(graph, edge_values, path):
 def _imshow(graph, family, values, path):
     # sec11: A2[40,40], A2[x,y] (grid_chain_sec11.py:440-443)
     # frank: A2[20,40], A2[x,y+19] (Frankenstein_chain.py:468-471)
+    # other families with integer-pair labels (e.g. kpair's plain rook
+    # grid): the label bounding box
     if family == "frank":
         a2 = np.zeros([20, 40])
         off = 19
-    else:
+    elif family == "sec11":
         a2 = np.zeros([40, 40])
         off = 0
+    else:
+        xs = [l[0] for l in graph.labels]
+        ys = [l[1] for l in graph.labels]
+        a2 = np.zeros([max(xs) + 1, max(ys) - min(ys) + 1])
+        off = -min(ys)
     for i, (x, y) in enumerate(graph.labels):
         a2[x, y + off] = values[i]
     plt.figure()
@@ -86,9 +102,12 @@ def _lineplot(series, path, title, ylim=None):
     plt.close()
 
 
-def render_start(graph, family, outdir, tag, start_signed, node_size):
+def render_start(graph, family, outdir, tag, start_signed, node_size,
+                 pos=None):
+    os.makedirs(outdir, exist_ok=True)
     _draw_nodes(graph, start_signed,
-                os.path.join(outdir, tag + "start.png"), node_size)
+                os.path.join(outdir, tag + "start.png"), node_size,
+                pos=pos)
 
 
 def render_all(graph: LatticeGraph, family: str, outdir: str, tag: str, *,
@@ -121,3 +140,75 @@ ARTIFACT_KINDS = ["start.png", "edges.png", "end.png", "end2.png",
                   "wca.png", "wca2.png", "slope.png", "angle.png",
                   "flip.png", "flip2.png", "logflip.png", "logflip2.png",
                   "wait.txt"]
+
+# Per-family artifact manifests. sec11/frank keep the reference's full
+# 13-artifact set byte-compatibly; the widened families emit the subset
+# their walk defines (no slope/angle without wall-interface recording, no
+# wca parity integral for k > 2 districts, no imshow off integer-pair
+# labels) plus family-specific diagnostics.
+FAMILY_ARTIFACTS = {
+    "sec11": ARTIFACT_KINDS,
+    "frank": ARTIFACT_KINDS,
+    "kpair": ["start.png", "edges.png", "end.png", "end2.png",
+              "flip.png", "flip2.png", "logflip.png", "logflip2.png",
+              "wait.txt"],
+    "tri": ["start.png", "edges.png", "end.png", "wca.png", "flip.png",
+            "logflip.png", "wait.txt"],
+    "hex": ["start.png", "edges.png", "end.png", "wca.png", "flip.png",
+            "logflip.png", "wait.txt"],
+    "temper": ["start.png", "edges.png", "end.png", "rungs.png",
+               "swapstats.json", "wait.txt"],
+    "dual": ["start.png", "edges.png", "end.png", "flip.png",
+             "logflip.png", "compactness.json", "wait.txt"],
+}
+
+
+def artifact_kinds(family: str):
+    return FAMILY_ARTIFACTS[family]
+
+
+def render_generic(graph, family: str, outdir: str, tag: str, *,
+                   kinds, node_size, end_signed, cut_times, num_flips,
+                   waits_sum, part_sum=None, pos=None):
+    """The widened families' post-run artifacts: any subset of the
+    reference kinds (start.png is rendered pre-run by render_start;
+    family-specific diagnostics — rungs.png, swapstats.json,
+    compactness.json — are written by the driver)."""
+    os.makedirs(outdir, exist_ok=True)
+    j = lambda kind: os.path.join(outdir, tag + kind)
+    lognum = np.log(np.asarray(num_flips, np.float64) + 1.0)
+    if "wait.txt" in kinds:
+        with open(j("wait.txt"), "w") as f:
+            f.write(str(int(round(waits_sum))))
+    if "edges.png" in kinds:
+        _draw_edges(graph, cut_times, j("edges.png"), pos=pos)
+    if "end.png" in kinds:
+        _draw_nodes(graph, end_signed, j("end.png"), node_size, pos=pos)
+    if "end2.png" in kinds:
+        _imshow(graph, family, end_signed, j("end2.png"))
+    if "wca.png" in kinds:
+        _draw_nodes(graph, part_sum, j("wca.png"), node_size, cmap="jet",
+                    pos=pos)
+    if "flip.png" in kinds:
+        _draw_nodes(graph, num_flips, j("flip.png"), node_size,
+                    cmap="jet", pos=pos)
+    if "flip2.png" in kinds:
+        _imshow(graph, family, num_flips, j("flip2.png"))
+    if "logflip.png" in kinds:
+        _draw_nodes(graph, lognum, j("logflip.png"), node_size,
+                    cmap="jet", pos=pos)
+    if "logflip2.png" in kinds:
+        _imshow(graph, family, lognum, j("logflip2.png"))
+
+
+def render_rungs(path, rung_cut, betas):
+    """temper: per-rung reconstructed cut-count trajectories of ladder 0
+    (the diagnostic the per-chain plots cannot show: after a swap the
+    physical rung hops between chains)."""
+    plt.figure()
+    for r, beta in enumerate(betas):
+        plt.plot(rung_cut[r], label=f"beta={beta:g}", lw=0.8)
+    plt.legend(fontsize=7)
+    plt.title("per-rung |cut| (ladder 0)")
+    plt.savefig(path)
+    plt.close()

@@ -24,8 +24,10 @@ FRANK_POPS = [.05, .1, .5, .9]
 
 @dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
-    family: str               # 'sec11' | 'frank'
-    alignment: int            # 0 | 1 | 2
+    family: str               # 'sec11' | 'frank' | 'kpair' | 'tri' |
+                              # 'hex' | 'temper' | 'dual'
+    alignment: int            # 0 | 1 | 2 (sec11/frank/temper); stripe
+                              # axis 0 | 1 (kpair/tri/hex/dual)
     base: float
     pop_tol: float
     total_steps: int = 100_000
@@ -40,16 +42,43 @@ class ExperimentConfig:
     propose_parallel: int = 1  # kernel/step.py Spec.propose_parallel:
                                # candidates per re-propose round (batch
                                # accelerators benefit from >1)
+    # --- widened families (BASELINE.json configs 2-5) ---
+    n_districts: int = 2      # kpair/dual: k districts via the pair walk
+    grid: int = 64            # kpair: board side (n x n rook grid)
+    lattice_m: int = 14       # tri/hex: generator rows
+    lattice_n: int = 28       # tri/hex: generator cols
+    betas: tuple = ()         # temper: the beta ladder, rung 0 first
+    swap_every: int = 0       # temper: transitions between swap rounds
+    dual_nx: int = 12         # dual: synthetic-precinct state is nx x ny
+    dual_ny: int = 12
+    record_every: int = 1     # history thinning through the runners
 
     @property
     def tag(self) -> str:
-        return (f"{self.alignment}B{int(100 * self.base)}"
+        core = (f"{self.alignment}B{int(100 * self.base)}"
                 f"P{int(100 * self.pop_tol)}")
+        if self.family in ("sec11", "frank"):
+            # reference families keep the reference's exact filename tag
+            # (grid_chain_sec11.py:323)
+            return core
+        # widened families prefix the family (artifact filenames and
+        # checkpoint keys must not collide when sweeps share an output
+        # or checkpoint directory) and their sweep-varying parameters
+        if self.family in ("kpair", "dual"):
+            return f"{self.family}-K{self.n_districts}-{core}"
+        if self.family == "temper":
+            return (f"{self.family}-{core}"
+                    f"R{len(self.betas)}S{self.swap_every}")
+        return f"{self.family}-{core}"
 
     @property
     def plot_node_size(self) -> int:
         # grid_chain_sec11.py:188 ns=120; Frankenstein_chain.py:37 ns=500
-        return 120 if self.family == "sec11" else 500
+        if self.family in ("frank", "temper"):
+            return 500
+        if self.family in ("tri", "hex", "dual"):
+            return 60
+        return 120 if self.family == "sec11" else 10
 
 
 def sec11_sweep(**overrides) -> Iterator[ExperimentConfig]:
@@ -67,3 +96,68 @@ def frank_sweep(**overrides) -> Iterator[ExperimentConfig]:
                                            [2, 1, 0]):
         yield ExperimentConfig(family="frank", alignment=al, base=base,
                                pop_tol=pop, **overrides)
+
+
+def kpair_sweep(**overrides) -> Iterator[ExperimentConfig]:
+    """BASELINE config 2: k-district (k=4, 8) pair walks on the 64x64
+    grid (slow_reversible_propose semantics, grid_chain_sec11.py:117-130),
+    routed through the board pair fast path."""
+    for k, base, al in itertools.product([4, 8], [0.8, MU], [0, 1]):
+        yield ExperimentConfig(family="kpair", alignment=al, base=base,
+                               pop_tol=0.5, n_districts=k, **overrides)
+
+
+def tri_sweep(**overrides) -> Iterator[ExperimentConfig]:
+    """BASELINE config 3a: 2-district flip walk on a triangular lattice
+    (non-grid planar adjacency)."""
+    for base, al in itertools.product(FRANK_BASES, [0, 1]):
+        yield ExperimentConfig(family="tri", alignment=al, base=base,
+                               pop_tol=0.1, **overrides)
+
+
+def hex_sweep(**overrides) -> Iterator[ExperimentConfig]:
+    """BASELINE config 3b: 2-district flip walk on a hexagonal lattice."""
+    for base, al in itertools.product(FRANK_BASES, [0, 1]):
+        yield ExperimentConfig(family="hex", alignment=al, base=base,
+                               pop_tol=0.1, **overrides)
+
+
+# The default FRANK B333 ladder spans [1.0, 0.63]: the order-disorder
+# transition sits near beta ~ 0.65 (REPLICATION.md "Tempering the B333
+# bimodal regime"), so the hottest rungs melt the interface and refreeze
+# it into a fresh mode, while 0.03-0.05 spacing keeps every adjacent
+# swap rate above ~0.4. A naive wide ladder (1.0 .. 0.25, spacing 0.15)
+# measured swap rates ~0.005 past the transition — betas beyond the melt
+# point buy nothing and starve the ladder.
+TEMPER_BETAS = (1.0, .95, .9, .85, .8, .76, .72, .69, .66, .63)
+
+
+def temper_sweep(**overrides) -> Iterator[ExperimentConfig]:
+    """BASELINE config 4: beta-tempered Frankengraph chains with replica
+    exchange, centred on the slow-mixing bimodal B333 regime
+    (REPLICATION.md). The cold rung (beta=1) is the physical chain."""
+    overrides.setdefault("betas", TEMPER_BETAS)
+    overrides.setdefault("swap_every", 50)
+    for al in [0, 1, 2]:
+        yield ExperimentConfig(family="temper", alignment=al,
+                               base=1 / .3, pop_tol=0.1, **overrides)
+
+
+def dual_sweep(**overrides) -> Iterator[ExperimentConfig]:
+    """BASELINE config 5: k districts on a precinct dual graph (synthetic
+    jittered-quad state; from_geojson also ingests real shapefiles), with
+    boundary-length Metropolis and Polsby-Popper compactness scores."""
+    for k, al in itertools.product([4, 8], [0, 1]):
+        yield ExperimentConfig(family="dual", alignment=al, base=MU,
+                               pop_tol=0.25, n_districts=k, **overrides)
+
+
+SWEEPS = {
+    "sec11": sec11_sweep,
+    "frank": frank_sweep,
+    "kpair": kpair_sweep,
+    "tri": tri_sweep,
+    "hex": hex_sweep,
+    "temper": temper_sweep,
+    "dual": dual_sweep,
+}

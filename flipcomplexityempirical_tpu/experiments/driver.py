@@ -16,6 +16,7 @@ everything).
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Optional
@@ -25,51 +26,158 @@ import numpy as np
 
 from .. import compat
 from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
+                      square_grid, triangular_lattice, hex_lattice,
+                      stripes_plan, from_geojson, synthetic_precincts,
                       seed_votes, PARITY_LABELS)
-from ..stats import partisan
+from ..stats import partisan, polsby_popper
+from ..kernel import board as kboard
 from ..kernel.step import Spec, finalize_host
-from ..sampling import init_batch, run_chains
-from .artifacts import ARTIFACT_KINDS, render_all, render_start
+from ..sampling import (init_batch, run_chains, init_board,
+                        init_tempered, run_tempered, per_rung_history)
+from .artifacts import (artifact_kinds, render_all, render_generic,
+                        render_rungs, render_start)
 from .config import ExperimentConfig
 
 
 def build_graph_and_plan(cfg: ExperimentConfig):
+    """(graph, initial plan, GeoAttributes-or-None) for a config. The
+    'temper' family runs the Frankengraph (its B333 cell is the
+    slow-mixing regime the ladder exists for, REPLICATION.md)."""
+    geo = None
     if cfg.family == "sec11":
         g = grid_sec11()
         plan = sec11_plan(g, cfg.alignment)
-    elif cfg.family == "frank":
+    elif cfg.family in ("frank", "temper"):
         g = frankengraph()
         plan = frank_plan(g, cfg.alignment)
+    elif cfg.family == "kpair":
+        g = square_grid(cfg.grid, cfg.grid)
+        plan = stripes_plan(g, cfg.n_districts, axis=cfg.alignment)
+    elif cfg.family == "tri":
+        g = triangular_lattice(cfg.lattice_m, cfg.lattice_n)
+        plan = stripes_plan(g, 2, axis=cfg.alignment)
+    elif cfg.family == "hex":
+        g = hex_lattice(cfg.lattice_m, cfg.lattice_n)
+        plan = stripes_plan(g, 2, axis=cfg.alignment)
+    elif cfg.family == "dual":
+        g, geo = from_geojson(
+            synthetic_precincts(cfg.dual_nx, cfg.dual_ny, seed=cfg.seed),
+            pop_property="POP")
+        plan = stripes_plan(g, cfg.n_districts, axis=cfg.alignment)
     else:
         raise ValueError(f"family {cfg.family!r}")
-    return g, plan
+    return g, plan, geo
+
+
+def spec_for(cfg: ExperimentConfig) -> Spec:
+    """The kernel Spec a family's walk runs under. sec11/frank keep the
+    reference's full metric set (wall-interface slopes need wall ids, so
+    record_interface only exists there); kpair/dual route the k-district
+    pair walk (slow_reversible_propose, grid_chain_sec11.py:117-130);
+    dual scores boundary LENGTH (weighted_cut) for compactness."""
+    common = dict(contiguity=cfg.contiguity, invalid="repropose",
+                  parity_metrics=True, geom_waits=True,
+                  propose_parallel=cfg.propose_parallel)
+    fam = cfg.family
+    if fam in ("sec11", "frank"):
+        return Spec(n_districts=2, proposal="bi", accept=cfg.accept,
+                    record_interface=True, **common)
+    if fam in ("temper", "tri", "hex"):
+        return Spec(n_districts=2, proposal="bi", accept=cfg.accept,
+                    record_interface=False, **common)
+    if fam == "kpair":
+        return Spec(n_districts=cfg.n_districts, proposal="pair",
+                    accept="cut", record_interface=False, **common)
+    if fam == "dual":
+        return Spec(n_districts=cfg.n_districts,
+                    proposal="pair" if cfg.n_districts > 2 else "bi",
+                    accept="cut", weighted_cut=True,
+                    record_interface=False, **common)
+    raise ValueError(f"family {fam!r}")
+
+
+def _labels_for(cfg: ExperimentConfig) -> np.ndarray:
+    """District -> rendered value: the reference's +1/-1 for 2 districts,
+    district ids for k > 2."""
+    if cfg.n_districts == 2:
+        return np.asarray(PARITY_LABELS)
+    return np.arange(cfg.n_districts, dtype=np.int32)
 
 
 def is_done(cfg: ExperimentConfig, outdir: str) -> bool:
     return all(os.path.exists(os.path.join(outdir, cfg.tag + k))
-               for k in ARTIFACT_KINDS)
+               for k in artifact_kinds(cfg.family))
 
 
 def run_config(cfg: ExperimentConfig, outdir: str,
                checkpoint_dir: Optional[str] = None) -> dict:
     os.makedirs(outdir, exist_ok=True)
-    g, plan = build_graph_and_plan(cfg)
-    signed = PARITY_LABELS[plan]
-    render_start(g, cfg.family, outdir, cfg.tag, signed, cfg.plot_node_size)
+    g, plan, geo = build_graph_and_plan(cfg)
+    labels = _labels_for(cfg)
+    signed = labels[plan]
+    pos = geo.centroid if geo is not None else None
+    render_start(g, cfg.family, outdir, cfg.tag, signed,
+                 cfg.plot_node_size, pos=pos)
     t0 = time.time()
-    if cfg.backend == "jax":
-        data = _run_jax(cfg, g, plan, checkpoint_dir)
-    elif cfg.backend == "python":
+    if cfg.backend == "python":
+        if cfg.family not in ("sec11", "frank"):
+            raise ValueError("backend='python' (the compat oracle) only "
+                             "covers the reference families sec11/frank")
         data = _run_python(cfg, g, plan)
-    else:
+    elif cfg.backend != "jax":
         raise ValueError(f"backend {cfg.backend!r}")
+    elif cfg.family == "temper":
+        if checkpoint_dir or cfg.checkpoint_every:
+            raise ValueError("the temper family does not checkpoint yet; "
+                             "drop --checkpoint-dir/--checkpoint-every "
+                             "rather than silently losing that guarantee")
+        data = _run_temper(cfg, g, plan)
+    else:
+        data = _run_jax(cfg, g, plan, checkpoint_dir)
     data["seconds"] = time.time() - t0
-    data["partisan"] = _partisan_summary(cfg, g, data)
-    render_all(g, cfg.family, outdir, cfg.tag,
-               end_signed=data["end_signed"], cut_times=data["cut_times"],
-               part_sum=data["part_sum"], num_flips=data["num_flips"],
-               slopes=data["slopes"], angles=data["angles"],
-               waits_sum=data["waits_sum"], node_size=cfg.plot_node_size)
+    if cfg.n_districts == 2:
+        data["partisan"] = _partisan_summary(cfg, g, data)
+
+    if cfg.family in ("sec11", "frank"):
+        render_all(g, cfg.family, outdir, cfg.tag,
+                   end_signed=data["end_signed"],
+                   cut_times=data["cut_times"],
+                   part_sum=data["part_sum"], num_flips=data["num_flips"],
+                   slopes=data["slopes"], angles=data["angles"],
+                   waits_sum=data["waits_sum"],
+                   node_size=cfg.plot_node_size)
+        return data
+
+    render_generic(g, cfg.family, outdir, cfg.tag,
+                   kinds=artifact_kinds(cfg.family),
+                   node_size=cfg.plot_node_size,
+                   end_signed=data["end_signed"],
+                   cut_times=data["cut_times"],
+                   num_flips=data["num_flips"],
+                   part_sum=data.get("part_sum"),
+                   waits_sum=data["waits_sum"], pos=pos)
+    j = lambda kind: os.path.join(outdir, cfg.tag + kind)
+    if cfg.family == "temper":
+        render_rungs(j("rungs.png"), data["rung_cut"], cfg.betas)
+        with open(j("swapstats.json"), "w") as f:
+            json.dump(data["swapstats"], f, indent=1)
+    if cfg.family == "dual":
+        pp = polsby_popper(
+            np.asarray(data["assignments"]), cfg.n_districts,
+            edges=g.edges, shared_perim=geo.shared_perim,
+            node_area=geo.area, node_exterior_perim=geo.exterior_perim)
+        data["polsby_popper"] = pp
+        with open(j("compactness.json"), "w") as f:
+            json.dump({
+                "polsby_popper_per_chain_mean": pp.mean(axis=1).tolist(),
+                "polsby_popper_batch_mean": float(pp.mean()),
+                "initial": polsby_popper(
+                    np.asarray(plan)[None], cfg.n_districts,
+                    edges=g.edges, shared_perim=geo.shared_perim,
+                    node_area=geo.area,
+                    node_exterior_perim=geo.exterior_perim
+                ).mean(axis=1).tolist(),
+            }, f, indent=1)
     return data
 
 
@@ -83,16 +191,27 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     story, SURVEY.md section 5 'Checkpoint / resume'). The segmented run
     is bit-identical to an uninterrupted one because PRNG keys live in the
     chain state and segment boundaries reuse the chunked runner.
-    ``_stop_after_segments`` simulates an interruption for tests."""
-    spec = Spec(n_districts=2, proposal="bi", contiguity=cfg.contiguity,
-                invalid="repropose", accept=cfg.accept,
-                record_interface=True, parity_metrics=True, geom_waits=True,
-                propose_parallel=cfg.propose_parallel)
-    dg, states, params = init_batch(
-        g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
-        base=cfg.base, pop_tol=cfg.pop_tol)
+    ``_stop_after_segments`` simulates an interruption for tests.
 
-    done = 0
+    Routes through the board (stencil) fast path whenever
+    ``kernel.board.supports(graph, spec)`` holds — e.g. the kpair family's
+    plain rook grid — and falls back to the general gather kernel (sec11's
+    corner surgery, the Frankengraph, tri/hex, dual graphs)."""
+    from ..sampling.board_runner import run_board_segment
+
+    spec = spec_for(cfg)
+    labels = _labels_for(cfg)
+    use_board = kboard.supports(g, spec)
+    if use_board:
+        handle, states, params = init_board(
+            g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
+            base=cfg.base, pop_tol=cfg.pop_tol)
+    else:
+        handle, states, params = init_batch(
+            g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
+            base=cfg.base, pop_tol=cfg.pop_tol)
+
+    done = 0   # yields recorded (general) / transitions advanced (board)
     n_parts = 0
     hist_parts: dict = {}
     waits_total = np.zeros(cfg.n_chains, np.float64)
@@ -107,11 +226,24 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
             waits_total = loaded["meta_waits_total"].copy()
 
     every = cfg.checkpoint_every or cfg.total_steps
+    if (cfg.checkpoint_every and cfg.record_every > 1
+            and cfg.checkpoint_every % cfg.record_every):
+        raise ValueError(
+            f"checkpoint_every ({cfg.checkpoint_every}) must be a "
+            f"multiple of record_every ({cfg.record_every}): each segment "
+            f"thins relative to its own start, so off-grid segment "
+            f"boundaries would silently skew the recorded time grid")
+    total = cfg.total_steps - (1 if use_board else 0)
     segments = 0
-    while done < cfg.total_steps:
-        n = min(every, cfg.total_steps - done)
-        res = run_chains(dg, spec, params, states, n_steps=n,
-                         record_initial=(done == 0))
+    while done < total:
+        n = min(every, total - done)
+        if use_board:
+            res = run_board_segment(handle, spec, params, states, n,
+                                    record_every=cfg.record_every)
+        else:
+            res = run_chains(handle, spec, params, states,
+                             n_steps=n, record_initial=(done == 0),
+                             record_every=cfg.record_every)
         states = res.state
         for k, v in res.history.items():
             hist_parts.setdefault(k, []).append(v)
@@ -126,24 +258,104 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         if _stop_after_segments and segments >= _stop_after_segments:
             raise _SegmentStop(done)
 
-    history = {k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+    if use_board:
+        # the final yield (no trailing transition) + its wait bookkeeping
+        from ..sampling.board_runner import finalize_board_run
+        res = finalize_board_run(handle, spec, params, states, hist_parts,
+                                 waits_total, [], True, cfg.total_steps,
+                                 cfg.record_every)
+        states, history, waits_total = (res.state, res.history,
+                                        res.waits_total)
+    else:
+        history = {k: np.concatenate(v, axis=1)
+                   for k, v in hist_parts.items()}
     s = jax.tree.map(np.asarray, states)
     t_final = cfg.total_steps  # reference t after the loop (line 402)
     c0 = type(s)(**{f: np.asarray(getattr(s, f))[0]
                     for f in s.__dataclass_fields__})
-    part_sum, _ = finalize_host(c0, np.asarray(PARITY_LABELS), t_final)
+    if use_board:
+        assign0 = np.asarray(c0.board, dtype=np.int64)
+        cut_times = kboard.edge_cut_times(g, s)[0]
+        assignments = np.asarray(s.board)
+    else:
+        assign0 = np.asarray(c0.assignment, dtype=np.int64)
+        cut_times = np.asarray(c0.cut_times)
+        assignments = np.asarray(s.assignment)
+    part_sum, _ = finalize_host(c0, labels, t_final, assignment=assign0)
     return {
-        "end_signed": np.asarray(PARITY_LABELS)[
-            np.asarray(c0.assignment, dtype=np.int64)],
-        "cut_times": np.asarray(c0.cut_times),
+        "end_signed": labels[assign0],
+        "cut_times": cut_times,
         "part_sum": part_sum,
         "num_flips": np.asarray(c0.num_flips),
-        "slopes": history["slope"][0],
-        "angles": history["angle"][0],
+        "slopes": history["slope"][0] if "slope" in history else None,
+        "angles": history["angle"][0] if "angle" in history else None,
         "waits_sum": float(waits_total[0]),
         "history": history,
         "waits_all": waits_total,
         "state": s,
+        "assignments": assignments,
+    }
+
+
+def _run_temper(cfg: ExperimentConfig, g, plan) -> dict:
+    """The temper family: n_chains LADDERS of len(betas) rungs each (so
+    the batch is n_chains * n_rungs chains), swap rounds every
+    ``swap_every`` transitions. Artifacts follow the chain that ENDS
+    holding beta = betas[0] in ladder 0; the per-rung trajectory plot and
+    swap-rate stats come from the reconstructed rung histories (a chain's
+    own accumulators mix temperatures by design)."""
+    if not cfg.betas:
+        raise ValueError("temper family needs cfg.betas")
+    spec = spec_for(cfg)
+    labels = _labels_for(cfg)
+    handle, states, params = init_tempered(
+        g, plan, betas=cfg.betas, n_ladders=cfg.n_chains, seed=cfg.seed,
+        spec=spec, base=cfg.base, pop_tol=cfg.pop_tol)
+    res = run_tempered(handle, spec, params, states,
+                       n_steps=cfg.total_steps, betas=cfg.betas,
+                       n_ladders=cfg.n_chains, swap_every=cfg.swap_every,
+                       swap_seed=cfg.seed,
+                       record_every=cfg.record_every)
+    s = res.host_state()
+    # the PHYSICAL (beta = betas[0]) chain of each ladder: swaps permute
+    # betas, so the cold chain's batch row differs per ladder at run end
+    n_rungs = len(cfg.betas)
+    beta_lr = np.asarray(res.params.beta).reshape(cfg.n_chains, n_rungs)
+    cold_rows = (np.arange(cfg.n_chains) * n_rungs
+                 + np.argmax(beta_lr == np.float32(cfg.betas[0]), axis=1))
+    cold = int(cold_rows[0])
+    cc = type(s)(**{f: np.asarray(getattr(s, f))[cold]
+                    for f in s.__dataclass_fields__})
+    assign_c = np.asarray(cc.assignment, dtype=np.int64)
+    part_sum, _ = finalize_host(cc, labels, cfg.total_steps,
+                                assignment=assign_c)
+    rung_cut = per_rung_history(res, "cut_count")[:, 0, :]  # ladder 0
+    return {
+        "end_signed": labels[assign_c],
+        "cut_times": np.asarray(cc.cut_times),
+        "part_sum": part_sum,
+        "num_flips": np.asarray(cc.num_flips),
+        "slopes": None,
+        "angles": None,
+        "waits_sum": float(res.waits_total[cold]),
+        "history": res.history,
+        "waits_all": res.waits_total,
+        "state": s,
+        # one physical plan per ladder (partisan summaries must not mix
+        # in molten hot-rung plans)
+        "assignments": np.asarray(s.assignment)[cold_rows],
+        "rung_cut": rung_cut,
+        "swapstats": {
+            # pair r is the exchange between the chains holding the
+            # (r+1)-th and (r+2)-th LARGEST betas (rank follows the
+            # temperature as swaps permute it, tempering.chain_rungs)
+            "betas": list(map(float, cfg.betas)),
+            "betas_by_rank": sorted(map(float, cfg.betas), reverse=True),
+            "swap_every": cfg.swap_every,
+            "attempts": res.swap_attempts.tolist(),
+            "accepts": res.swap_accepts.tolist(),
+            "rates": res.swap_rates().tolist(),
+        },
     }
 
 
@@ -153,8 +365,8 @@ def _partisan_summary(cfg: ExperimentConfig, g, data) -> dict:
     223-228; Election wiring of line 307). Batched: every chain's final
     plan is scored in one pass; the reference's single chain is row 0."""
     votes = seed_votes(g, cfg.seed)
-    if data["state"] is not None:               # jax backend: (C, N) batch
-        assign = np.asarray(data["state"].assignment)
+    if data.get("assignments") is not None:     # jax backend: (C, N) batch
+        assign = np.asarray(data["assignments"])
     else:                                       # python backend: final plan
         assign = (np.asarray(data["end_signed"]) < 0).astype(np.int64)[None]
     tallies = partisan.district_vote_tallies(assign, votes, k=2)
@@ -284,7 +496,9 @@ def _ckpt_identity(cfg: ExperimentConfig) -> str:
     return (f"{cfg.family}|steps={cfg.total_steps}|chains={cfg.n_chains}|"
             f"seed={cfg.seed}|contiguity={cfg.contiguity}|"
             f"accept={cfg.accept}|base={cfg.base!r}|pop={cfg.pop_tol!r}|"
-            f"kp={cfg.propose_parallel}")
+            f"kp={cfg.propose_parallel}|k={cfg.n_districts}|"
+            f"grid={cfg.grid}|lat={cfg.lattice_m}x{cfg.lattice_n}|"
+            f"dual={cfg.dual_nx}x{cfg.dual_ny}|re={cfg.record_every}")
 
 
 def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,

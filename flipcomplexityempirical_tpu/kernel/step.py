@@ -484,14 +484,19 @@ def interface_metrics(dg: DeviceGraph, cut):
             jnp.where(ok, angle, nan).astype(jnp.float32))
 
 
-def finalize_host(state_np, label_values, t_final):
+def finalize_host(state_np, label_values, t_final, assignment=None):
     """Reference post-run finalization (grid_chain_sec11.py:416-419),
     host-side numpy: never-flipped nodes get part_sum = t * final_sign;
     lognum_flips = log(num_flips + 1). Note the reference does NOT add the
-    tail segment for flipped nodes — preserved verbatim."""
+    tail segment for flipped nodes — preserved verbatim.
+
+    ``assignment`` overrides ``state_np.assignment`` for state flavors
+    that carry it under another name (the board path's ``.board``)."""
     import numpy as np
 
-    sign = np.asarray(label_values)[np.asarray(state_np.assignment,
+    if assignment is None:
+        assignment = state_np.assignment
+    sign = np.asarray(label_values)[np.asarray(assignment,
                                                dtype=np.int64)]
     part_sum = np.array(state_np.part_sum)
     never = np.array(state_np.last_flipped) == 0

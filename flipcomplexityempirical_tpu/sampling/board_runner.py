@@ -77,22 +77,23 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
                      waits_total=waits_total, n_yields=n_steps)
 
 
-def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
-              state: kboard.BoardState, n_steps: int,
-              record_history: bool = True,
-              chunk: Optional[int] = None,
-              bits: Optional[bool] = None,
-              record_every: int = 1) -> RunResult:
-    """Run the batched board chain for ``n_steps`` yields (yield 0 is the
-    initial state, as the reference's ``for part in exp_chain`` sees it).
-    ``bits`` overrides the bit-board body dispatch (perf toggle; the
-    bodies are bit-identical). ``record_every=k`` keeps only yields
-    0, k, 2k, ... in the returned history (accumulators still advance
-    every step), strided on device before the host copy."""
+def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
+                      params: StepParams, state: kboard.BoardState,
+                      n_transitions: int,
+                      record_history: bool = True,
+                      chunk: Optional[int] = None,
+                      bits: Optional[bool] = None,
+                      record_every: int = 1) -> RunResult:
+    """Advance ``n_transitions`` transitions, recording the same number of
+    yields (each BEFORE its transition) — and NO trailing record, so
+    segments compose without duplicate boundary yields: a full run is
+    segments summing to n_steps - 1 transitions plus one
+    ``kboard.record_final``. ``run_board`` is exactly that composition;
+    the experiment driver checkpoints between segments."""
     if record_every < 1:
         raise ValueError(f"record_every must be >= 1, got {record_every}")
     if chunk is None:
-        chunk = pick_chunk(n_steps, 2048)
+        chunk = pick_chunk(n_transitions + 1, 2048)
     if record_every > 1:
         chunk = snap_chunk_to(chunk, record_every)
 
@@ -101,10 +102,9 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
     state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
     pending_waits: list = []
 
-    done = 0                      # yields recorded so far
-    transitions = n_steps - 1
-    while done < transitions:
-        this = min(chunk, transitions - done)
+    done = 0
+    while done < n_transitions:
+        this = min(chunk, n_transitions - done)
         state, outs = kboard.run_board_chunk(bg, spec, params, state, this,
                                              collect=record_history,
                                              bits=bits)
@@ -118,6 +118,29 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
         state = drain_waits(state, pending_waits)
         done += this
 
-    return finalize_board_run(bg, spec, params, state, hist_parts,
-                              waits_total, pending_waits, record_history,
+    waits_total = _sum_pending(waits_total, pending_waits)
+    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+               if record_history and hist_parts else {})
+    return RunResult(state=state, history=history,
+                     waits_total=waits_total, n_yields=n_transitions)
+
+
+def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
+              state: kboard.BoardState, n_steps: int,
+              record_history: bool = True,
+              chunk: Optional[int] = None,
+              bits: Optional[bool] = None,
+              record_every: int = 1) -> RunResult:
+    """Run the batched board chain for ``n_steps`` yields (yield 0 is the
+    initial state, as the reference's ``for part in exp_chain`` sees it).
+    ``bits`` overrides the bit-board body dispatch (perf toggle; the
+    bodies are bit-identical). ``record_every=k`` keeps only yields
+    0, k, 2k, ... in the returned history (accumulators still advance
+    every step), strided on device before the host copy."""
+    seg = run_board_segment(bg, spec, params, state, n_steps - 1,
+                            record_history=record_history, chunk=chunk,
+                            bits=bits, record_every=record_every)
+    hist_parts = {k: [v] for k, v in seg.history.items()}
+    return finalize_board_run(bg, spec, params, seg.state, hist_parts,
+                              seg.waits_total, [], record_history,
                               n_steps, record_every)

@@ -1,0 +1,243 @@
+"""Single-device replica-exchange orchestration: chunked advance + swaps.
+
+``run_tempered`` composes the chunked chain runners (general and board
+paths) with ``tempering.swap_within_batch`` into the run loop the sharded
+train steps (distribute/sharded.py) fuse on-device: advance every chain
+``swap_every`` transitions, then one even-odd swap round with alternating
+parity. Temperatures (StepParams.beta) are exchanged, not states, so the
+orchestration is a pure params update between chunks — the chunk kernels
+recompile for nothing (beta is a traced per-chain array).
+
+The batch is laid out (ladders, rungs): chain c is rung ``c % n_rungs``
+of ladder ``c // n_rungs`` (tempering.make_ladder_params). Per-round
+diagnostics accumulate on host: swap attempts/accepts per adjacent rung
+pair, and the per-round beta assignment (``beta_hist``) from which
+``per_rung_history`` reconstructs rung-r trajectories — after a swap the
+physical rung wanders between chains, so per-chain histories alone cannot
+answer "what did the cold chain do".
+
+Capability target: BASELINE.json config 4 ("beta-tempered flip chains
+with replica-exchange swaps across a temperature ladder"); the reference
+itself carries only a dead annealing schedule (grid_chain_sec11.py:88-95).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernel import board as kboard
+from ..kernel.step import Spec, StepParams
+from . import board_runner, runner
+from .runner import thin_outs
+from .tempering import make_ladder_params, swap_within_batch
+
+
+def init_tempered(graph, assignment, *, betas, n_ladders: int, seed: int,
+                  spec: Spec, base: float, pop_tol: float):
+    """Build (handle, states, ladder params) for ``run_tempered``:
+    C = n_ladders * len(betas) chains laid out rung-fastest, routed to the
+    board fast path when ``board.supports`` holds."""
+    c = n_ladders * len(tuple(betas))
+    if kboard.supports(graph, spec):
+        handle, states, params = board_runner.init_board(
+            graph, assignment, n_chains=c, seed=seed, spec=spec,
+            base=base, pop_tol=pop_tol)
+    else:
+        handle, states, params = runner.init_batch(
+            graph, assignment, n_chains=c, seed=seed, spec=spec,
+            base=base, pop_tol=pop_tol)
+    return handle, states, make_ladder_params(params, betas, n_ladders)
+
+
+@dataclasses.dataclass
+class TemperResult:
+    """RunResult plus the ladder diagnostics."""
+    state: object                # final chain state (device)
+    history: dict                # name -> (C, T') recorded history
+    waits_total: np.ndarray      # f64 (C,)
+    n_yields: int
+    params: StepParams           # final params (exchanged betas)
+    betas: np.ndarray            # (n_rungs,) the ladder, rung 0 first
+    n_rungs: int
+    swap_every: int
+    record_every: int
+    general_initial: bool        # general path: extra initial record at t=0
+    beta_hist: np.ndarray        # (n_rounds, C) beta of chain c in round r
+    swap_attempts: np.ndarray    # (n_rungs-1,) pair (r, r+1) attempts
+    swap_accepts: np.ndarray     # (n_rungs-1,) accepted exchanges
+
+    def host_state(self):
+        return jax.tree.map(np.asarray, self.state)
+
+    def swap_rates(self) -> np.ndarray:
+        with np.errstate(invalid="ignore"):
+            return self.swap_accepts / np.maximum(self.swap_attempts, 1)
+
+
+def _host_rungs(beta, n_rungs: int) -> np.ndarray:
+    """numpy mirror of tempering.chain_rungs: per-chain rank of the
+    CURRENT beta within its ladder, rank 0 = coldest (largest beta)."""
+    b_lr = np.asarray(beta).reshape(-1, n_rungs)
+    pos_of_rank = np.argsort(-b_lr, axis=1, kind="stable")
+    return np.argsort(pos_of_rank, axis=1, kind="stable").reshape(-1)
+
+
+def _accumulate_swaps(accept_mask, rungs, n_rungs, parity,
+                      attempts, accepts, n_ladders):
+    """Host-side per-pair bookkeeping for one swap round. ``rungs`` is
+    the pre-swap rank assignment (a chain's rung follows its current
+    temperature). Pair (r, r+1) is active when r % 2 == parity; the
+    accept mask is symmetric, so the lower rung's entries count each
+    exchanged pair once."""
+    for r in range(n_rungs - 1):
+        if r % 2 != parity % 2:
+            continue
+        attempts[r] += n_ladders
+        accepts[r] += int(accept_mask[rungs == r].sum())
+
+
+def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
+                 n_steps: int, *, betas, n_ladders: int,
+                 swap_every: int, swap_seed: int = 0,
+                 record_history: bool = True, record_every: int = 1,
+                 bits: Optional[bool] = None) -> TemperResult:
+    """Run C = n_ladders * len(betas) chains for ``n_steps`` yields with a
+    replica-exchange round every ``swap_every`` transitions.
+
+    ``graph_handle`` is the DeviceGraph (general path) or BoardGraph
+    (board path — chosen by the type of ``states``). ``params`` must
+    already carry the ladder betas (tempering.make_ladder_params).
+    ``spec.anneal`` must be 'none' (swap_within_batch raises otherwise).
+
+    Yield/record semantics match run_chains / run_board exactly at
+    swap_every = n_steps - 1 (one round, no swap effect); the final
+    partial round is advanced without a trailing swap.
+    """
+    betas = np.asarray(betas, np.float64)
+    n_rungs = betas.shape[0]
+    is_board = isinstance(states, kboard.BoardState)
+    c = states.cut_count.shape[0]
+    if c != n_ladders * n_rungs:
+        raise ValueError(f"batch size {c} != n_ladders*n_rungs "
+                         f"{n_ladders}*{n_rungs}")
+    if swap_every < 1:
+        raise ValueError("swap_every must be >= 1")
+    if record_every > 1 and swap_every % record_every:
+        raise ValueError("record_every must divide swap_every so the "
+                         "record grid survives round boundaries")
+    attempts = np.zeros(n_rungs - 1, np.int64)
+    accepts = np.zeros(n_rungs - 1, np.int64)
+    beta_rows = []
+    key = jax.random.PRNGKey(swap_seed)
+
+    hist_parts: dict = {}
+    waits_total = np.asarray(states.waits_sum, np.float64).copy()
+    states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
+    pending: list = []
+
+    def collect(outs, offset):
+        outs = jax.tree.map(np.asarray,
+                            thin_outs(outs, record_every, offset=offset))
+        for k, v in outs.items():
+            hist_parts.setdefault(k, []).append(v.T)
+
+    transitions = n_steps - 1
+    done = 0
+    parity = 0
+    if not is_board:
+        states, out0 = runner._record_initial(
+            graph_handle, spec, params, states)
+        if record_history:
+            for k, v in out0.items():
+                hist_parts.setdefault(k, []).append(np.asarray(v)[:, None])
+    while done < transitions:
+        this = min(swap_every, transitions - done)
+        beta_rows.append(np.asarray(params.beta, np.float32))
+        if is_board:
+            states, outs = kboard.run_board_chunk(
+                graph_handle, spec, params, states, this,
+                collect=record_history, bits=bits)
+        else:
+            states, outs = runner._run_chunk(
+                graph_handle, spec, params, states, this,
+                collect=record_history)
+        if record_history:
+            collect(outs, 0 if is_board else record_every - 1)
+        pending.append(states.waits_sum)
+        states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
+        done += this
+        if done < transitions:
+            # swaps sit BETWEEN rounds only: no trailing swap, so the
+            # final recorded yield still belongs to beta_hist's last row
+            key, sub = jax.random.split(key)
+            rungs_now = _host_rungs(params.beta, n_rungs)
+            params, acc = swap_within_batch(sub, states, params,
+                                            n_rungs, parity, spec=spec)
+            _accumulate_swaps(np.asarray(acc), rungs_now, n_rungs, parity,
+                              attempts, accepts, n_ladders)
+            parity ^= 1
+
+    if is_board:
+        res = board_runner.finalize_board_run(
+            graph_handle, spec, params, states, hist_parts, waits_total,
+            pending, record_history, n_steps, record_every)
+        states, history, waits_total = res.state, res.history, \
+            res.waits_total
+    else:
+        for w in pending:
+            waits_total += np.asarray(w, np.float64)
+        history = ({k: np.concatenate(v, axis=1)
+                    for k, v in hist_parts.items()}
+                   if record_history else {})
+
+    return TemperResult(
+        state=states, history=history, waits_total=waits_total,
+        n_yields=n_steps, params=params, betas=betas, n_rungs=n_rungs,
+        swap_every=swap_every, record_every=record_every,
+        general_initial=not is_board,
+        beta_hist=(np.stack(beta_rows) if beta_rows
+                   else np.zeros((0, c), np.float32)),
+        swap_attempts=attempts, swap_accepts=accepts)
+
+
+def per_rung_history(res: TemperResult, name: str) -> np.ndarray:
+    """Reconstruct rung-resolved trajectories from a per-chain history:
+    returns (n_rungs, n_ladders, T') where entry [r, l, t] is the value
+    recorded at yield t by whichever of ladder l's chains held rung r
+    then. Swaps exchange temperatures, so the physical rung-r chain hops
+    between batch rows; this inverts the hop using ``beta_hist``.
+    Requires the ladder's betas to be pairwise distinct (they are matched
+    by exact f32 value: swaps permute betas, never recompute them).
+    """
+    beta32 = res.betas.astype(np.float32)
+    if len(set(beta32.tolist())) != res.n_rungs:
+        raise ValueError("per_rung_history needs pairwise-distinct betas")
+    h = np.asarray(res.history[name])                       # (C, T')
+    c, t_rec = h.shape
+    nl = c // res.n_rungs
+    se = res.swap_every
+    n_rounds = res.beta_hist.shape[0]
+    # round of each recorded column: the general path records yield t > 0
+    # AFTER transition t (round (t-1)//se, with the initial yield 0 in
+    # round 0); board chunks record yield t BEFORE transition t+1
+    # (round t//se), and the final yield lands in the last round
+    yields = np.arange(t_rec) * res.record_every
+    if res.general_initial:
+        rounds = np.maximum(yields - 1, 0) // se
+    else:
+        rounds = yields // se
+    rounds = np.minimum(rounds, max(n_rounds - 1, 0))
+
+    bh3 = res.beta_hist[rounds].reshape(t_rec, nl, res.n_rungs)
+    h3 = h.reshape(nl, res.n_rungs, t_rec)
+    out = np.empty((res.n_rungs, nl, t_rec), h.dtype)
+    for r in range(res.n_rungs):
+        # position of rung r inside each ladder, per recorded column
+        j = np.argmax(bh3 == beta32[r], axis=2)             # (T', nl)
+        out[r] = np.take_along_axis(h3, j.T[:, None, :], axis=1)[:, 0]
+    return out

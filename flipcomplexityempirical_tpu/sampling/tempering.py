@@ -53,14 +53,32 @@ def make_ladder_params(params: StepParams, betas, n_ladders: int) -> StepParams:
     )
 
 
+def chain_rungs(beta, n_rungs: int):
+    """Per-chain rung = rank of the chain's CURRENT beta within its
+    ladder, rank 0 = largest beta (coldest). Swaps move betas between
+    chains, so a chain's rung follows its temperature, not its batch
+    position; ties (equal betas) fall back to position order."""
+    c = beta.shape[0]
+    b_lr = beta.reshape(c // n_rungs, n_rungs)
+    pos_of_rank = jnp.argsort(-b_lr, axis=1, stable=True)   # (L, R)
+    rank_of_pos = jnp.argsort(pos_of_rank, axis=1, stable=True)
+    return rank_of_pos.reshape(-1), pos_of_rank
+
+
 def swap_within_batch(key, states, params: StepParams,
                       n_rungs: int, parity: int, spec=None):
     """One even-odd swap round inside a batch laid out (ladders, rungs).
 
-    ``parity`` 0 pairs rungs (0,1),(2,3),...; parity 1 pairs (1,2),(3,4),...
-    Returns (params with exchanged betas, swap-accept mask) — states are
-    untouched by design. Pass the chains' ``Spec`` so the annealing
-    incompatibility (module docstring) is caught at the misuse site.
+    Pairs are ADJACENT TEMPERATURES (rung = rank of each chain's current
+    beta within its ladder, coldest first), the standard ladder scheme:
+    ``parity`` 0 pairs rungs (0,1),(2,3),...; parity 1 pairs (1,2),...
+    Pairing by batch position instead would exchange arbitrary
+    temperature pairs once betas have permuted — still a valid MCMC move,
+    but with vanishing acceptance between distant rungs and mislabeled
+    diagnostics. Returns (params with exchanged betas, swap-accept mask)
+    — states are untouched by design. Pass the chains' ``Spec`` so the
+    annealing incompatibility (module docstring) is caught at the misuse
+    site.
 
     ``states`` may be the general path's ChainState or the board path's
     BoardState: only the batch size and the carried per-chain
@@ -71,19 +89,22 @@ def swap_within_batch(key, states, params: StepParams,
                          "!= 'none': the annealed kernel ignores "
                          "StepParams.beta, so swapped betas have no effect")
     c = states.cut_count.shape[0]
-    rung = jnp.arange(c) % n_rungs
-    # partner of each chain within its ladder (identity at ladder edges)
+    beta = params.beta
+    rung, pos_of_rank = chain_rungs(beta, n_rungs)
+    ladder = jnp.arange(c) // n_rungs
+    # partner of each chain = the chain holding the adjacent rung of the
+    # same ladder (identity at ladder edges)
     lo = (rung % 2) == (parity % 2)
-    partner = jnp.where(lo, jnp.arange(c) + 1, jnp.arange(c) - 1)
+    partner_rank = jnp.clip(jnp.where(lo, rung + 1, rung - 1),
+                            0, n_rungs - 1)
+    partner = (ladder * n_rungs
+               + jnp.take_along_axis(
+                   pos_of_rank, partner_rank.reshape(-1, n_rungs), axis=1
+               ).reshape(-1))
     valid_pair = jnp.where(
         lo, rung + 1 < n_rungs, (rung >= 1) & (rung % 2 == (1 - parity % 2)))
-    # guard ladder boundaries and batch edges
-    partner = jnp.clip(partner, 0, c - 1)
-    same_ladder = (jnp.arange(c) // n_rungs) == (partner // n_rungs)
-    valid_pair = valid_pair & same_ladder
 
     cut = states.cut_count.astype(jnp.float32)
-    beta = params.beta
     lb = params.log_base
     log_a = lb * (beta - beta[partner]) * (cut - cut[partner])
     # one shared uniform per unordered pair: draw at the lower index

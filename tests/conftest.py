@@ -19,6 +19,71 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run tests marked slow (the full matrix: the 100k-step "
+             "replication cell, 8-device ladder suites, exhaustive "
+             "enumerations). The default selection is the fast tier.")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, skipped unless --runslow")
+
+
+# The slow tier, maintained here in one place from pytest --durations runs
+# (everything >= ~9 s on the 1-core build box): full-scale replication,
+# exhaustive enumerations, long bit-identity matrices, 8-device suites,
+# heavyweight end-to-end cells. The default selection (< ~3 min) is for
+# iteration; CI-style runs pass --runslow for the full matrix.
+SLOW_TEST_SUBSTRINGS = (
+    "test_replication.py",
+    "test_pair_walk_matches_exact_stationary",
+    "test_pair_walk_k2_equals_bi_walk",
+    "test_kernel_matches_exact_stationary",
+    "test_board_path_matches_exact_stationary",
+    "test_corrected_accept_matches_reversible_target",
+    "test_bit_identity_vs_int8_body",
+    "test_pair_bit_identity_vs_int8_body",
+    "test_mid_config_resume_is_bit_identical",
+    "test_run_config_artifacts_and_resume",
+    "test_checkpoint_mismatch_and_stale_formats_ignored",
+    "test_checkpoint_roundtrip",
+    "test_apply_flip_log_chunked_composition",
+    "test_board_chunking_is_invisible",
+    "test_record_every_is_a_stride",
+    "test_board_matches_general_path",
+    "test_board_invariants",
+    "test_tree_retries_recover_tight_epsilon",
+    "test_simulator_matches_xla_board_distribution",
+    "test_pair_board_matches_general_path",
+    "test_sharded_run_bit_identical",
+    "test_board_sharded_run_bit_identical",
+    "test_temper_family_end_to_end",
+    "test_kpair_family_end_to_end",
+    "test_single_rung_matches_plain_runner",
+    "test_base1_deterministic_swaps_and_rung_reconstruction",
+    "test_pair_kernel_matches_oracle_distributions",
+    "test_kernel_matches_oracle_distributions",
+    "test_invariants_pair_k8",
+    "test_anneal_linear_beta_ramps_to_max",
+    "test_select_flat_picks_mth_valid",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if any(s in item.nodeid for s in SLOW_TEST_SUBSTRINGS):
+            item.add_marker(pytest.mark.slow)
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow tier: pass --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

@@ -81,7 +81,7 @@ def test_mid_config_resume_is_bit_identical(tmp_path):
     # interrupted run: crash after the first 100-step segment...
     cfg = ex.ExperimentConfig(**kw, checkpoint_every=100)
     ck_b = str(tmp_path / "ckb")
-    g, plan = drv.build_graph_and_plan(cfg)
+    g, plan, _ = drv.build_graph_and_plan(cfg)
     with pytest.raises(drv._SegmentStop):
         drv._run_jax(cfg, g, plan, checkpoint_dir=ck_b,
                      _stop_after_segments=1)

@@ -1,0 +1,163 @@
+"""End-to-end tests for the widened experiment families (BASELINE.json
+configs 2-5): each family must run from ExperimentConfig through
+run_config to its full artifact manifest, with family-specific invariants
+checked on the outputs — and the driver must dispatch the board fast path
+exactly when kernel.board.supports holds."""
+
+import json
+import os
+
+import networkx as nx
+import numpy as np
+import pytest
+
+import flipcomplexityempirical_tpu.experiments as ex
+from flipcomplexityempirical_tpu.experiments import driver as drv
+from flipcomplexityempirical_tpu.experiments.artifacts import artifact_kinds
+
+
+def _assert_artifacts(cfg, outdir):
+    for kind in artifact_kinds(cfg.family):
+        assert os.path.exists(os.path.join(outdir, cfg.tag + kind)), kind
+    assert ex.is_done(cfg, outdir)
+
+
+def _districts_connected(g, assignment, k):
+    gx = nx.Graph(list(map(tuple, np.asarray(g.edges))))
+    for d in range(k):
+        nodes = np.nonzero(np.asarray(assignment) == d)[0].tolist()
+        assert nodes, f"district {d} empty"
+        assert nx.is_connected(gx.subgraph(nodes))
+
+
+def test_kpair_family_end_to_end(tmp_path):
+    """k-district pair walk on the plain grid: board fast path, k=4."""
+    cfg = ex.ExperimentConfig(family="kpair", alignment=0, base=0.8,
+                              pop_tol=0.5, n_districts=4, grid=12,
+                              total_steps=300, n_chains=3)
+    out = str(tmp_path)
+    data = ex.run_config(cfg, out)
+    _assert_artifacts(cfg, out)
+    g, plan, _ = drv.build_graph_and_plan(cfg)
+    assert sorted(set(data["end_signed"].tolist())) <= [0, 1, 2, 3]
+    for c in range(cfg.n_chains):
+        _districts_connected(g, data["assignments"][c], 4)
+    # wait.txt carries the literal n**k - 1 denominator's scale
+    with open(os.path.join(out, cfg.tag + "wait.txt")) as f:
+        assert int(f.read()) > 0
+
+
+@pytest.mark.parametrize("family", ["tri", "hex"])
+def test_lattice_families_end_to_end(tmp_path, family):
+    cfg = ex.ExperimentConfig(family=family, alignment=1, base=0.3,
+                              pop_tol=0.1, lattice_m=6, lattice_n=10,
+                              total_steps=300, n_chains=3)
+    out = str(tmp_path)
+    data = ex.run_config(cfg, out)
+    _assert_artifacts(cfg, out)
+    g, plan, _ = drv.build_graph_and_plan(cfg)
+    for c in range(cfg.n_chains):
+        _districts_connected(g, data["assignments"][c], 2)
+    assert np.isfinite(data["waits_sum"])
+    assert "partisan" in data
+
+
+def test_dual_family_end_to_end(tmp_path):
+    """Synthetic-precinct dual graph: k-district pair walk, boundary-
+    length Metropolis, Polsby-Popper in the summary."""
+    cfg = ex.ExperimentConfig(family="dual", alignment=0, base=2.6,
+                              pop_tol=0.25, n_districts=4, dual_nx=8,
+                              dual_ny=8, total_steps=300, n_chains=3)
+    out = str(tmp_path)
+    data = ex.run_config(cfg, out)
+    _assert_artifacts(cfg, out)
+    g, plan, geo = drv.build_graph_and_plan(cfg)
+    for c in range(cfg.n_chains):
+        _districts_connected(g, data["assignments"][c], 4)
+    pp = data["polsby_popper"]
+    assert pp.shape == (cfg.n_chains, 4)
+    assert np.isfinite(pp).all() and (pp > 0).all() and (pp <= 1).all()
+    with open(os.path.join(out, cfg.tag + "compactness.json")) as f:
+        js = json.load(f)
+    assert len(js["polsby_popper_per_chain_mean"]) == cfg.n_chains
+    # population bounds hold at the end (weighted-cut chain stays valid)
+    ideal = g.pop.sum() / 4
+    for c in range(cfg.n_chains):
+        a = data["assignments"][c]
+        for d in range(4):
+            pd = g.pop[a == d].sum()
+            assert (1 - 0.25) * ideal - 1e-6 <= pd \
+                <= (1 + 0.25) * ideal + 1e-6
+
+
+def test_temper_family_end_to_end(tmp_path):
+    cfg = ex.ExperimentConfig(family="temper", alignment=0, base=1 / .3,
+                              pop_tol=0.1, betas=(1.0, 0.6, 0.3),
+                              swap_every=50, total_steps=400, n_chains=4)
+    out = str(tmp_path)
+    data = ex.run_config(cfg, out)
+    _assert_artifacts(cfg, out)
+    st = data["swapstats"]
+    assert st["attempts"][0] > 0
+    assert data["rung_cut"].shape == (3, 400)
+    # the batch is n_chains ladders x 3 rungs; the reported plans are the
+    # one PHYSICAL (cold) chain per ladder
+    assert data["state"].assignment.shape[0] == 4 * 3
+    assert data["assignments"].shape[0] == 4
+    with open(os.path.join(out, cfg.tag + "swapstats.json")) as f:
+        assert json.load(f)["betas"] == [1.0, 0.6, 0.3]
+
+
+def test_driver_dispatches_board_fast_path(tmp_path, monkeypatch):
+    """_run_jax must route through init_board exactly when
+    board.supports holds (kpair's plain grid yes, frank no)."""
+    calls = []
+    real = drv.init_board
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(drv, "init_board", spy)
+    cfg = ex.ExperimentConfig(family="kpair", alignment=0, base=0.8,
+                              pop_tol=0.5, n_districts=2, grid=8,
+                              total_steps=120, n_chains=2)
+    ex.run_config(cfg, str(tmp_path / "a"))
+    assert calls, "kpair config did not take the board fast path"
+
+    calls.clear()
+    cfg2 = ex.ExperimentConfig(family="frank", alignment=0, base=0.3,
+                               pop_tol=0.5, total_steps=120, n_chains=2)
+    ex.run_config(cfg2, str(tmp_path / "b"))
+    assert not calls, "frank config must use the general path"
+
+
+def test_board_family_checkpoint_resume_bit_identical(tmp_path):
+    """The board-path driver route checkpoints and resumes bit-exactly,
+    like the general path (test_experiments.py's mid-config test)."""
+    kw = dict(family="kpair", alignment=0, base=0.8, pop_tol=0.5,
+              n_districts=4, grid=10, total_steps=241, n_chains=2)
+    clean = ex.run_config(ex.ExperimentConfig(**kw), str(tmp_path / "a"))
+
+    cfg = ex.ExperimentConfig(**kw, checkpoint_every=80)
+    ck = str(tmp_path / "ck")
+    g, plan, _ = drv.build_graph_and_plan(cfg)
+    with pytest.raises(drv._SegmentStop):
+        drv._run_jax(cfg, g, plan, checkpoint_dir=ck,
+                     _stop_after_segments=1)
+    assert int(ex.load_checkpoint(ck, cfg)["meta_done"]) == 80
+    resumed = ex.run_config(cfg, str(tmp_path / "b"), checkpoint_dir=ck)
+
+    for k in clean["history"]:
+        np.testing.assert_array_equal(clean["history"][k],
+                                      resumed["history"][k], err_msg=k)
+    np.testing.assert_array_equal(clean["assignments"],
+                                  resumed["assignments"])
+    # waits accumulate on device in f32 per chunk (drained to f64 on
+    # host), so different segment boundaries legitimately regroup the
+    # f32 partial sums; the per-step "wait" HISTORY above is bit-equal
+    np.testing.assert_allclose(clean["waits_all"], resumed["waits_all"],
+                               rtol=2e-6)
+    np.testing.assert_array_equal(clean["part_sum"], resumed["part_sum"])
+    np.testing.assert_array_equal(clean["cut_times"],
+                                  resumed["cut_times"])

@@ -1,0 +1,166 @@
+"""run_tempered: single-device replica exchange (BASELINE config 4).
+
+Three bars: (1) a 1-rung ladder is bit-identical to the plain runners —
+the orchestration adds nothing when there is nothing to swap; (2) with
+base=1 every valid swap accepts, so the beta assignment is a
+deterministic permutation and per_rung_history must invert it exactly;
+(3) on the exhaustively-enumerated small grid, the reconstructed cold
+AND hot rung occupancies each match the exact stationary distribution of
+their own temperature's transition matrix — the standard parallel-
+tempering invariant, which breaks if the swap acceptance ratio is wrong.
+"""
+
+import numpy as np
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.sampling import (
+    init_tempered, run_tempered, per_rung_history)
+
+from test_enumeration import (build_masks, enumerate_states,
+                              build_transition, stationary,
+                              assert_matches_stationary, EPS)
+
+
+@pytest.mark.parametrize("path", ["general", "board"])
+def test_single_rung_matches_plain_runner(path):
+    g = fce.graphs.square_grid(6, 6)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    use_board = path == "board"
+    if use_board:
+        h, st, params = fce.sampling.init_board(
+            g, plan, n_chains=6, seed=3, spec=spec, base=1.3, pop_tol=0.3)
+        plain = fce.sampling.run_board(h, spec, params, st, n_steps=161,
+                                       chunk=40)
+        h2, st2, params2 = init_tempered(
+            g, plan, betas=[1.0], n_ladders=6, seed=3, spec=spec,
+            base=1.3, pop_tol=0.3)
+    else:
+        spec = fce.Spec(contiguity="patch", record_interface=True)
+        h, st, params = fce.init_batch(
+            g, plan, n_chains=6, seed=3, spec=spec, base=1.3, pop_tol=0.3)
+        plain = fce.run_chains(h, spec, params, st, n_steps=161, chunk=40)
+        h2, st2, params2 = init_tempered(
+            g, plan, betas=[1.0], n_ladders=6, seed=3, spec=spec,
+            base=1.3, pop_tol=0.3)
+    res = run_tempered(h2, spec, params2, st2, n_steps=161,
+                       betas=[1.0], n_ladders=6, swap_every=40)
+    assert set(res.history) == set(plain.history)
+    for k in plain.history:
+        np.testing.assert_array_equal(res.history[k], plain.history[k],
+                                      err_msg=k)
+    sp, st_ = plain.host_state(), res.host_state()
+    for fld in sp.__dataclass_fields__:
+        np.testing.assert_array_equal(np.asarray(getattr(sp, fld)),
+                                      np.asarray(getattr(st_, fld)),
+                                      err_msg=fld)
+    np.testing.assert_allclose(res.waits_total, plain.waits_total)
+    assert res.swap_attempts.sum() == 0
+
+
+def test_base1_deterministic_swaps_and_rung_reconstruction():
+    """At base=1 the swap log-ratio is 0 > log(u), so every valid pair
+    exchanges every round: beta_hist follows the deterministic even-odd
+    brickwork, and per_rung_history must invert it column-exactly."""
+    g = fce.graphs.square_grid(5, 5)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    betas = [1.0, 0.75, 0.5, 0.25]
+    h, st, params = init_tempered(g, plan, betas=betas, n_ladders=3,
+                                  seed=7, spec=spec, base=1.0, pop_tol=0.5)
+    res = run_tempered(h, spec, params, st, n_steps=121, betas=betas,
+                       n_ladders=3, swap_every=20)
+    n_rounds = res.beta_hist.shape[0]
+    assert n_rounds == 6
+    assert res.swap_rates().min() == 1.0
+
+    # expected assignment: swaps pair adjacent RANKS (rank follows the
+    # temperature, not the batch position): parity-0 rounds exchange rank
+    # pairs (0,1) and (2,3), parity-1 rounds (1,2). Track which position
+    # holds each rank; every valid pair accepts at base=1.
+    b32 = np.asarray(betas, np.float32)
+    pos_of_rank = np.arange(4)
+    rows = []
+    for rnd in range(n_rounds):
+        row = np.empty(4, np.float32)
+        row[pos_of_rank] = b32
+        rows.append(row.copy())
+        for r in range(3):
+            if r % 2 == rnd % 2:
+                pos_of_rank[[r, r + 1]] = pos_of_rank[[r + 1, r]]
+    expect = np.stack(rows)                        # (rounds, 4)
+    np.testing.assert_array_equal(res.beta_hist,
+                                  np.tile(expect, (1, 3)))
+
+    # reconstruction: rung r's trajectory equals the per-chain history
+    # read through the inverse permutation
+    rung = per_rung_history(res, "cut_count")      # (4, 3, T)
+    h_all = np.asarray(res.history["cut_count"])   # (12, T)
+    t_rec = h_all.shape[1]
+    for t in range(t_rec):
+        rnd = min(t // 20, n_rounds - 1)
+        for r, b in enumerate(b32):
+            for l in range(3):
+                j = int(np.argmax(expect[rnd] == b))
+                assert rung[r, l, t] == h_all[l * 4 + j, t]
+
+
+def _joint_tempered_stationary(P1, P2, cuts, lb, b1, b2):
+    """Exact time-averaged distribution of the 2-rung tempered chain with
+    swap_every=1 and the implementation's alternating parity (parity-1
+    rounds have no valid pair at 2 rungs, so they are identity): the
+    recorded-yield distribution obeys v_{t+1} = S_{t%2}(P(v_t)) over the
+    joint (cold state, hot state) space, independent numpy throughout."""
+    n = P1.shape[0]
+    d = cuts[:, None] - cuts[None, :]
+    a_ij = np.minimum(1.0, np.exp(lb * (b1 - b2) * d))      # a(i, j)
+
+    def step_p(v):
+        return P1.T @ v @ P2
+
+    def swap(v):
+        return v * (1 - a_ij) + v.T * a_ij.T
+
+    v = np.full((n, n), 1.0 / (n * n))
+    for _ in range(4000):
+        nxt = step_p(swap(step_p(v)))                       # M_even
+        if np.abs(nxt - v).max() < 1e-13:
+            break
+        v = nxt
+    v /= v.sum()
+    avg = (v + swap(step_p(v))) / 2                         # both phases
+    return avg / avg.sum()
+
+
+@pytest.mark.slow
+def test_rungs_match_exact_joint_stationary():
+    """Cold (beta=1) and hot (beta=0.5) rung occupancies, reconstructed
+    through the swap record, vs the EXACT marginals of the tempered
+    chain's joint stationary distribution — this fails if the swap
+    acceptance ratio, cadence, or rung bookkeeping is wrong."""
+    base = 3.0
+    b1, b2 = 1.0, 0.5
+    g, nbrmask = build_masks()
+    states = enumerate_states(nbrmask)
+    P1, cuts = build_transition(states, g, base ** b1)
+    P2, _ = build_transition(states, g, base ** b2)
+    avg = _joint_tempered_stationary(P1, P2, cuts.astype(np.float64),
+                                     np.log(base), b1, b2)
+    pi_cold = avg.sum(axis=1)
+    pi_hot = avg.sum(axis=0)
+
+    spec = fce.Spec(contiguity="patch", record_assignment_bits=True,
+                    geom_waits=False, parity_metrics=False)
+    plan = fce.graphs.stripes_plan(g, 2)
+    n_ladders, steps, burn = 48, 12001, 3000
+    h, st, params = init_tempered(g, plan, betas=[b1, b2],
+                                  n_ladders=n_ladders, seed=11, spec=spec,
+                                  base=base, pop_tol=EPS)
+    res = run_tempered(h, spec, params, st, n_steps=steps,
+                       betas=[b1, b2], n_ladders=n_ladders, swap_every=1)
+    assert res.swap_rates().min() > 0.05
+    rung = per_rung_history(res, "abits")          # (2, L, T)
+    for r, pi in ((0, pi_cold), (1, pi_hot)):
+        assert_matches_stationary(rung[r][:, burn:].ravel(),
+                                  states, pi, cuts)
