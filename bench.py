@@ -68,6 +68,10 @@ def main():
                          "samples of the cut-count trajectory per second "
                          "of wall clock (the BASELINE metric's "
                          "'wall-clock to target ESS' axis) on stderr")
+    ap.add_argument("--record-every", type=int, default=1,
+                    help="history thinning for the --ess recorded pass "
+                         "(device-side stride; cuts the history readback "
+                         "by the factor at large chain counts)")
     args = ap.parse_args()
     if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
             or args.warmup - 1 < args.chunk):
@@ -75,6 +79,11 @@ def main():
                  f"({args.steps - 1}) and warmup-1 ({args.warmup - 1}), and "
                  f"warmup-1 must be >= chunk, so the warmup actually "
                  "compiles the chunk-length kernel the timed region reuses")
+    if args.record_every > 1 and args.chunk % args.record_every:
+        ap.error(f"--record-every {args.record_every} must divide --chunk "
+                 f"({args.chunk}): the runner would otherwise snap the "
+                 "chunk down and compile a fresh partial-chunk kernel "
+                 "inside the timed ESS window")
 
     cpu_fallback = False
     if not args.cpu:
@@ -149,15 +158,18 @@ def main():
             def run(states, n_steps, variant=None, record=False):
                 return fce.sampling.run_board(
                     bg, spec, params, states, n_steps=n_steps,
-                    record_history=record, chunk=args.chunk, bits=variant)
+                    record_history=record, chunk=args.chunk, bits=variant,
+                    record_every=args.record_every if record else 1)
     else:
         dg, states, params = fce.init_batch(
             g, plan, n_chains=args.chains, seed=0, spec=spec,
             base=args.base, pop_tol=args.pop_tol)
 
         def run(states, n_steps, variant=None, record=False):
-            return fce.run_chains(dg, spec, params, states, n_steps=n_steps,
-                                  record_history=record, chunk=args.chunk)
+            return fce.run_chains(
+                dg, spec, params, states, n_steps=n_steps,
+                record_history=record, chunk=args.chunk,
+                record_every=args.record_every if record else 1)
 
     # compile + mix in (reach steady-state boundary sizes); same chunk as
     # the timed run so the timed region reuses the compiled kernel
@@ -235,6 +247,10 @@ def main():
             "recorded_seconds": round(d_rec, 3),
             "value": round(float(ess_total) / d_rec, 2),
         }
+        if args.record_every > 1:
+            # ESS of the THINNED trajectory (thinning >~ the IAT trades
+            # some measured ESS for a k-fold smaller history readback)
+            meta_ess["record_every"] = args.record_every
         print(json.dumps(meta_ess), file=sys.stderr)
 
     print(json.dumps(meta), file=sys.stderr)

@@ -127,11 +127,7 @@ def run_config(cfg: ExperimentConfig, outdir: str,
     elif cfg.backend != "jax":
         raise ValueError(f"backend {cfg.backend!r}")
     elif cfg.family == "temper":
-        if checkpoint_dir or cfg.checkpoint_every:
-            raise ValueError("the temper family does not checkpoint yet; "
-                             "drop --checkpoint-dir/--checkpoint-every "
-                             "rather than silently losing that guarantee")
-        data = _run_temper(cfg, g, plan)
+        data = _run_temper(cfg, g, plan, checkpoint_dir)
     else:
         data = _run_jax(cfg, g, plan, checkpoint_dir)
     data["seconds"] = time.time() - t0
@@ -215,15 +211,9 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     n_parts = 0
     hist_parts: dict = {}
     waits_total = np.zeros(cfg.n_chains, np.float64)
-    if checkpoint_dir:
-        loaded = load_checkpoint(checkpoint_dir, cfg)
-        if loaded is not None:
-            done = int(loaded["meta_done"])
-            n_parts = int(loaded["meta_n_parts"])
-            states = _state_from_arrays(states, loaded)
-            hist_parts = {k[len("hist_"):]: [v] for k, v in loaded.items()
-                          if k.startswith("hist_")}
-            waits_total = loaded["meta_waits_total"].copy()
+    resumed = _load_resume(checkpoint_dir, cfg, states)
+    if resumed is not None:
+        done, n_parts, states, hist_parts, waits_total, _ = resumed
 
     every = cfg.checkpoint_every or cfg.total_steps
     if (cfg.checkpoint_every and cfg.record_every > 1
@@ -297,29 +287,46 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     }
 
 
-def _run_temper(cfg: ExperimentConfig, g, plan) -> dict:
+def _run_temper(cfg: ExperimentConfig, g, plan,
+                checkpoint_dir: Optional[str] = None,
+                _stop_after_segments: Optional[int] = None) -> dict:
     """The temper family: n_chains LADDERS of len(betas) rungs each (so
     the batch is n_chains * n_rungs chains), swap rounds every
     ``swap_every`` transitions. Artifacts follow the chain that ENDS
     holding beta = betas[0] in ladder 0; the per-rung trajectory plot and
     swap-rate stats come from the reconstructed rung histories (a chain's
-    own accumulators mix temperatures by design)."""
+    own accumulators mix temperatures by design).
+
+    Checkpointing mirrors _run_jax, with the ladder's continuation state
+    (exchanged betas, swap key/parity, pair statistics, per-round beta
+    assignment) carried in the checkpoint's extra_* arrays; segments are
+    whole numbers of swap rounds."""
     if not cfg.betas:
         raise ValueError("temper family needs cfg.betas")
+    if cfg.checkpoint_every and cfg.checkpoint_every % cfg.swap_every:
+        raise ValueError(
+            f"checkpoint_every ({cfg.checkpoint_every}) must be a "
+            f"multiple of swap_every ({cfg.swap_every}): segments are "
+            f"whole swap rounds")
     spec = spec_for(cfg)
     labels = _labels_for(cfg)
     handle, states, params = init_tempered(
         g, plan, betas=cfg.betas, n_ladders=cfg.n_chains, seed=cfg.seed,
         spec=spec, base=cfg.base, pop_tol=cfg.pop_tol)
-    res = run_tempered(handle, spec, params, states,
-                       n_steps=cfg.total_steps, betas=cfg.betas,
-                       n_ladders=cfg.n_chains, swap_every=cfg.swap_every,
-                       swap_seed=cfg.seed,
-                       record_every=cfg.record_every)
+    n_rungs = len(cfg.betas)
+
+    if not checkpoint_dir and not cfg.checkpoint_every:
+        res = run_tempered(handle, spec, params, states,
+                           n_steps=cfg.total_steps, betas=cfg.betas,
+                           n_ladders=cfg.n_chains,
+                           swap_every=cfg.swap_every, swap_seed=cfg.seed,
+                           record_every=cfg.record_every)
+    else:
+        res = _run_temper_segmented(cfg, handle, spec, params, states,
+                                    checkpoint_dir, _stop_after_segments)
     s = res.host_state()
     # the PHYSICAL (beta = betas[0]) chain of each ladder: swaps permute
     # betas, so the cold chain's batch row differs per ladder at run end
-    n_rungs = len(cfg.betas)
     beta_lr = np.asarray(res.params.beta).reshape(cfg.n_chains, n_rungs)
     cold_rows = (np.arange(cfg.n_chains) * n_rungs
                  + np.argmax(beta_lr == np.float32(cfg.betas[0]), axis=1))
@@ -359,6 +366,87 @@ def _run_temper(cfg: ExperimentConfig, g, plan) -> dict:
     }
 
 
+def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
+                          states, checkpoint_dir,
+                          _stop_after_segments=None):
+    """Checkpointed temper run: whole-swap-round segments through
+    run_tempered(segment=True), the between-segment ladder state in the
+    checkpoint's extra_* arrays, the per-round beta assignment saved as a
+    history part (transposed to the (C, T) part layout). Resumes
+    bit-identically: chain PRNG keys live in the state, the swap key and
+    parity in the extras."""
+    from ..sampling.tempered import TemperResult
+
+    n_rungs = len(cfg.betas)
+    c = cfg.n_chains * n_rungs
+    done = 0                     # transitions advanced
+    n_parts = 0
+    hist_parts: dict = {}
+    waits_total = np.zeros(c, np.float64)
+    attempts = np.zeros(n_rungs - 1, np.int64)
+    accepts = np.zeros(n_rungs - 1, np.int64)
+    parity = 0
+    swap_key = jax.random.PRNGKey(cfg.seed)
+    resumed = _load_resume(checkpoint_dir, cfg, states)
+    if resumed is not None:
+        done, n_parts, states, hist_parts, waits_total, ex = resumed
+        params = params.replace(beta=jax.numpy.asarray(ex["beta"]))
+        attempts = ex["swap_attempts"].copy()
+        accepts = ex["swap_accepts"].copy()
+        parity = int(ex["parity"])
+        swap_key = jax.numpy.asarray(ex["swap_key"])
+
+    every = cfg.checkpoint_every or (cfg.total_steps - 1)
+    total = cfg.total_steps - 1
+    segments = 0
+    res = None
+    while done < total:
+        n = min(every, total - done)
+        last = done + n >= total
+        res = run_tempered(
+            handle, spec, params, states,
+            n_steps=(n + 1 if last else n), betas=cfg.betas,
+            n_ladders=cfg.n_chains, swap_every=cfg.swap_every,
+            record_every=cfg.record_every, segment=not last,
+            record_initial=(done == 0), start_parity=parity,
+            swap_key=swap_key)
+        states, params = res.state, res.params
+        parity, swap_key = res.end_parity, res.end_swap_key
+        seg_hist = dict(res.history)
+        seg_hist["beta_hist"] = res.beta_hist.T       # (C, rounds) part
+        for k, v in seg_hist.items():
+            hist_parts.setdefault(k, []).append(v)
+        waits_total += res.waits_total
+        attempts += res.swap_attempts
+        accepts += res.swap_accepts
+        done += n
+        segments += 1
+        if checkpoint_dir:
+            n_parts = save_checkpoint(
+                checkpoint_dir, cfg, res.host_state(), done=done,
+                waits_total=waits_total, new_hist=seg_hist,
+                part_idx=n_parts,
+                extra={"beta": np.asarray(params.beta),
+                       "swap_attempts": attempts,
+                       "swap_accepts": accepts,
+                       "parity": np.int64(parity),
+                       "swap_key": np.asarray(swap_key)})
+        if _stop_after_segments and segments >= _stop_after_segments:
+            raise _SegmentStop(done)
+
+    history = {k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+    beta_hist = history.pop("beta_hist").T            # (rounds, C)
+    return TemperResult(
+        state=states, history=history, waits_total=waits_total,
+        n_yields=cfg.total_steps, params=params,
+        betas=np.asarray(cfg.betas, np.float64), n_rungs=n_rungs,
+        swap_every=cfg.swap_every, record_every=cfg.record_every,
+        general_initial=(res.general_initial if res is not None else True),
+        beta_hist=beta_hist,
+        swap_attempts=attempts, swap_accepts=accepts,
+        end_parity=parity, end_swap_key=swap_key)
+
+
 def _partisan_summary(cfg: ExperimentConfig, g, data) -> dict:
     """Election scores over the run's final plans, from the reference's
     Bernoulli(1/2) pink/purple vote attributes (grid_chain_sec11.py:
@@ -395,6 +483,26 @@ def _state_from_arrays(template, loaded: dict):
         arr = loaded[f"state_{f}"]
         fields[f] = jnp.asarray(arr)
     return type(template)(**fields)
+
+
+def _load_resume(checkpoint_dir, cfg: ExperimentConfig, states_template):
+    """The shared resume unpack for every segmented runner: None for a
+    fresh start, else (done, n_parts, states, hist_parts, waits_total,
+    extras) — ``extras`` being the runner-specific extra_* continuation
+    arrays (the temper family's ladder state)."""
+    if not checkpoint_dir:
+        return None
+    loaded = load_checkpoint(checkpoint_dir, cfg)
+    if loaded is None:
+        return None
+    return (int(loaded["meta_done"]),
+            int(loaded["meta_n_parts"]),
+            _state_from_arrays(states_template, loaded),
+            {k[len("hist_"):]: [v] for k, v in loaded.items()
+             if k.startswith("hist_")},
+            loaded["meta_waits_total"].copy(),
+            {k[len("extra_"):]: v for k, v in loaded.items()
+             if k.startswith("extra_")})
 
 
 def make_wall_lookup(g):
@@ -498,15 +606,19 @@ def _ckpt_identity(cfg: ExperimentConfig) -> str:
             f"accept={cfg.accept}|base={cfg.base!r}|pop={cfg.pop_tol!r}|"
             f"kp={cfg.propose_parallel}|k={cfg.n_districts}|"
             f"grid={cfg.grid}|lat={cfg.lattice_m}x{cfg.lattice_n}|"
-            f"dual={cfg.dual_nx}x{cfg.dual_ny}|re={cfg.record_every}")
+            f"dual={cfg.dual_nx}x{cfg.dual_ny}|re={cfg.record_every}|"
+            f"betas={tuple(map(float, cfg.betas))!r}|"
+            f"se={cfg.swap_every}")
 
 
 def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,
                     done: int = 0, waits_total=None, new_hist=None,
-                    part_idx: int = 0) -> int:
+                    part_idx: int = 0, extra: Optional[dict] = None) -> int:
     """Per-config checkpoint: ``<tag>.npz`` holds the chain state
-    (state_*), progress + config identity (meta_*); each segment's history
-    goes to its own ``<tag>.h<k>.npz`` part file so a save costs
+    (state_*), progress + config identity (meta_*), and any
+    runner-specific continuation arrays (extra_* — the temper family's
+    ladder betas, swap key/parity, pair statistics); each segment's
+    history goes to its own ``<tag>.h<k>.npz`` part file so a save costs
     O(segment), not O(run-so-far). The main file is written atomically
     AFTER its part, so meta_n_parts never points at a missing file.
     Returns the next part index."""
@@ -525,6 +637,8 @@ def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,
     arrays["meta_identity"] = np.array(_ckpt_identity(cfg))
     if waits_total is not None:
         arrays["meta_waits_total"] = np.asarray(waits_total, np.float64)
+    for k, v in (extra or {}).items():
+        arrays[f"extra_{k}"] = np.asarray(v)
     path = os.path.join(ckpt_dir, cfg.tag + ".npz")
     np.savez_compressed(path + ".tmp.npz", **arrays)
     os.replace(path + ".tmp.npz", path)

@@ -70,6 +70,8 @@ class TemperResult:
     beta_hist: np.ndarray        # (n_rounds, C) beta of chain c in round r
     swap_attempts: np.ndarray    # (n_rungs-1,) pair (r, r+1) attempts
     swap_accepts: np.ndarray     # (n_rungs-1,) accepted exchanges
+    end_parity: int = 0          # swap parity a continuation starts from
+    end_swap_key: object = None  # PRNG key a continuation starts from
 
     def host_state(self):
         return jax.tree.map(np.asarray, self.state)
@@ -105,7 +107,9 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                  n_steps: int, *, betas, n_ladders: int,
                  swap_every: int, swap_seed: int = 0,
                  record_history: bool = True, record_every: int = 1,
-                 bits: Optional[bool] = None) -> TemperResult:
+                 bits: Optional[bool] = None,
+                 segment: bool = False, record_initial: bool = True,
+                 start_parity: int = 0, swap_key=None) -> TemperResult:
     """Run C = n_ladders * len(betas) chains for ``n_steps`` yields with a
     replica-exchange round every ``swap_every`` transitions.
 
@@ -117,6 +121,16 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
     Yield/record semantics match run_chains / run_board exactly at
     swap_every = n_steps - 1 (one round, no swap effect); the final
     partial round is advanced without a trailing swap.
+
+    Checkpoint-segment composition (the experiment driver's temper
+    checkpointing): call with ``segment=True`` for every non-final slice
+    — ``n_steps`` then counts TRANSITIONS (the board path's final record
+    and the trailing-swap omission are deferred to the final slice), a
+    between-segment swap still fires after the last round, and the
+    continuation resumes with ``record_initial=False`` (general path),
+    ``start_parity=result.end_parity``, ``swap_key=result.end_swap_key``,
+    and the returned ``params``. Segments must be multiples of
+    ``swap_every``.
     """
     betas = np.asarray(betas, np.float64)
     n_rungs = betas.shape[0]
@@ -130,10 +144,14 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
     if record_every > 1 and swap_every % record_every:
         raise ValueError("record_every must divide swap_every so the "
                          "record grid survives round boundaries")
+    if segment and n_steps % swap_every:
+        raise ValueError("a checkpoint segment must be a whole number of "
+                         "swap rounds (n_steps % swap_every == 0)")
     attempts = np.zeros(n_rungs - 1, np.int64)
     accepts = np.zeros(n_rungs - 1, np.int64)
     beta_rows = []
-    key = jax.random.PRNGKey(swap_seed)
+    key = (swap_key if swap_key is not None
+           else jax.random.PRNGKey(swap_seed))
 
     hist_parts: dict = {}
     waits_total = np.asarray(states.waits_sum, np.float64).copy()
@@ -146,10 +164,10 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
         for k, v in outs.items():
             hist_parts.setdefault(k, []).append(v.T)
 
-    transitions = n_steps - 1
+    transitions = n_steps if segment else n_steps - 1
     done = 0
-    parity = 0
-    if not is_board:
+    parity = start_parity
+    if not is_board and record_initial:
         states, out0 = runner._record_initial(
             graph_handle, spec, params, states)
         if record_history:
@@ -171,9 +189,11 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
         pending.append(states.waits_sum)
         states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
         done += this
-        if done < transitions:
-            # swaps sit BETWEEN rounds only: no trailing swap, so the
-            # final recorded yield still belongs to beta_hist's last row
+        if done < transitions or segment:
+            # swaps sit BETWEEN rounds only: no trailing swap on a FULL
+            # run, so the final recorded yield still belongs to
+            # beta_hist's last row; a checkpoint segment DOES end with
+            # its between-segment swap (the continuation's rounds follow)
             key, sub = jax.random.split(key)
             rungs_now = _host_rungs(params.beta, n_rungs)
             params, acc = swap_within_batch(sub, states, params,
@@ -182,7 +202,7 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                               attempts, accepts, n_ladders)
             parity ^= 1
 
-    if is_board:
+    if is_board and not segment:
         res = board_runner.finalize_board_run(
             graph_handle, spec, params, states, hist_parts, waits_total,
             pending, record_history, n_steps, record_every)
@@ -193,7 +213,7 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
             waits_total += np.asarray(w, np.float64)
         history = ({k: np.concatenate(v, axis=1)
                     for k, v in hist_parts.items()}
-                   if record_history else {})
+                   if record_history and hist_parts else {})
 
     return TemperResult(
         state=states, history=history, waits_total=waits_total,
@@ -202,7 +222,8 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
         general_initial=not is_board,
         beta_hist=(np.stack(beta_rows) if beta_rows
                    else np.zeros((0, c), np.float32)),
-        swap_attempts=attempts, swap_accepts=accepts)
+        swap_attempts=attempts, swap_accepts=accepts,
+        end_parity=parity, end_swap_key=key)
 
 
 def per_rung_history(res: TemperResult, name: str) -> np.ndarray:

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Compare our per-config wait.txt scalars against the reference's
+shipped ground truth, per Metropolis base.
+
+Usage:
+  python replication/compare_waits.py \
+      --ours replication/sec11 --ref /root/reference/New_plots/sec11
+  python replication/compare_waits.py \
+      --ours replication/frank --ref /root/reference/plots/FRANK
+
+Prints a markdown table: per base B, cell count compared, our mean
+Σwaits, the reference mean, and the min/max per-cell ratio (ours/ref on
+the SAME cell tag). Single 100k-step runs in slow-mixing regimes are
+mode-dominated (REPLICATION.md), so per-cell ratios there reflect mode
+occupancy, not error.
+"""
+
+import argparse
+import os
+import re
+from collections import defaultdict
+
+import numpy as np
+
+
+def read_waits(d):
+    out = {}
+    for f in os.listdir(d):
+        if f.endswith("wait.txt"):
+            with open(os.path.join(d, f)) as fh:
+                out[f[:-len("wait.txt")]] = float(fh.read().strip())
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ours", required=True)
+    ap.add_argument("--ref", required=True)
+    args = ap.parse_args()
+    ours = read_waits(args.ours)
+    ref = read_waits(args.ref)
+    common = sorted(set(ours) & set(ref))
+    missing = sorted(set(ref) - set(ours))
+    by_base = defaultdict(list)
+    for tag in common:
+        m = re.match(r"(\d)B(\d+)P(\d+)", tag)
+        by_base[int(m.group(2))].append((tag, ours[tag], ref[tag]))
+
+    print(f"{len(common)} cells compared "
+          f"({len(missing)} reference cells not yet run)")
+    print("| B | cells | ours mean | ref mean | ratio min | ratio max |")
+    print("|---|---|---|---|---|---|")
+    for b in sorted(by_base):
+        rows = by_base[b]
+        o = np.array([r[1] for r in rows])
+        rf = np.array([r[2] for r in rows])
+        rat = o / rf
+        print(f"| {b} | {len(rows)} | {o.mean():.4g} | {rf.mean():.4g} "
+              f"| {rat.min():.3f} | {rat.max():.3f} |")
+
+
+if __name__ == "__main__":
+    main()

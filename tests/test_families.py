@@ -132,6 +132,36 @@ def test_driver_dispatches_board_fast_path(tmp_path, monkeypatch):
     assert not calls, "frank config must use the general path"
 
 
+def test_temper_family_checkpoint_resume_bit_identical(tmp_path):
+    """The temper family checkpoints whole swap rounds and resumes
+    bit-exactly: ladder betas, swap key/parity, pair statistics, and the
+    per-round beta assignment all survive the crash."""
+    kw = dict(family="temper", alignment=0, base=1 / .3, pop_tol=0.1,
+              betas=(1.0, 0.6, 0.3), swap_every=40, total_steps=241,
+              n_chains=2)
+    clean = ex.run_config(ex.ExperimentConfig(**kw), str(tmp_path / "a"))
+
+    cfg = ex.ExperimentConfig(**kw, checkpoint_every=80)
+    ck = str(tmp_path / "ck")
+    g, plan, _ = drv.build_graph_and_plan(cfg)
+    with pytest.raises(drv._SegmentStop):
+        drv._run_temper(cfg, g, plan, checkpoint_dir=ck,
+                        _stop_after_segments=1)
+    assert int(ex.load_checkpoint(ck, cfg)["meta_done"]) == 80
+    resumed = ex.run_config(cfg, str(tmp_path / "b"), checkpoint_dir=ck)
+
+    for k in clean["history"]:
+        np.testing.assert_array_equal(clean["history"][k],
+                                      resumed["history"][k], err_msg=k)
+    np.testing.assert_array_equal(clean["assignments"],
+                                  resumed["assignments"])
+    np.testing.assert_array_equal(clean["rung_cut"], resumed["rung_cut"])
+    assert clean["swapstats"] == resumed["swapstats"]
+    np.testing.assert_allclose(clean["waits_all"], resumed["waits_all"],
+                               rtol=2e-6)
+    np.testing.assert_array_equal(clean["part_sum"], resumed["part_sum"])
+
+
 def test_board_family_checkpoint_resume_bit_identical(tmp_path):
     """The board-path driver route checkpoints and resumes bit-exactly,
     like the general path (test_experiments.py's mid-config test)."""
