@@ -4,10 +4,11 @@ exchange over ICI.
 The full "training step" of this framework (the analogue of a model's
 fwd+bwd+optimizer): advance every chain ``inner_steps`` flips locally
 (zero communication), then run an even-odd replica-exchange round where the
-temperature ladder runs ALONG THE DEVICE AXIS — local chain i on device d is
-rung d of ladder i — so a swap is one `lax.ppermute` neighbor exchange of
-(cut_count, beta) vectors plus a select, riding ICI. Telemetry (aggregate
-accepts) reduces with `lax.psum`.
+temperature ladder runs ALONG THE DEVICE AXIS — local chain slot i forms a
+ladder whose rungs START one per device. Swaps pair adjacent TEMPERATURES
+(rank-based — see _swap_round), exchanged via one `lax.all_gather` of the
+per-chain beta/energy scalars over ICI plus replicated selection. Telemetry
+(aggregate accepts) reduces with `lax.psum`.
 """
 
 from __future__ import annotations
@@ -35,41 +36,53 @@ def _params_spec(sharded: bool):
                       anneal_beta_max=P())
 
 
-def _even_odd_perms(n_dev: int):
-    perms = []
-    for parity in (0, 1):
-        perm = []
-        for i in range(n_dev):
-            j = i + 1 if i % 2 == parity else i - 1
-            if 0 <= j < n_dev:
-                perm.append((i, j))
-        perms.append(tuple(perm))
-    return perms
+def _swap_round(key, params, cut_count, parity, n_dev):
+    """One even-odd replica-exchange round along the device axis.
 
-
-def _swap_round(key, params, cut_count, parity, n_dev, perms):
-    """One even-odd replica-exchange round along the device axis: exchange
-    (cut_count, beta) with the ppermute neighbor, Metropolis-accept the
-    beta swap per chain slot from a shared replicated key, return the
-    updated params and the per-slot accept mask's sum."""
+    Pairs are ADJACENT TEMPERATURES, not adjacent devices: accepted swaps
+    move betas between devices, so after a few rounds the device order no
+    longer tracks the temperature order and device-neighbor pairing would
+    exchange arbitrary (mostly-rejecting) temperature pairs — the same
+    degradation tempering.swap_within_batch fixes in-batch. The partner
+    device is therefore data-dependent, which rules out a static
+    ``ppermute``; instead each device ``all_gather``s one stacked
+    (3, L) f32 block of (beta, cut, log_base) scalars over ICI and
+    computes the WHOLE round's outcome redundantly from the shared
+    replicated key, then keeps its own row. Swap decisions are identical
+    on every device by construction."""
     idx = jax.lax.axis_index(CHAINS_AXIS)
-    partner_exists = jnp.where(
-        idx % 2 == parity, idx + 1 < n_dev, idx - 1 >= 0)
-    cut = cut_count.astype(jnp.float32)
-    beta = params.beta
-    cut_p = jax.lax.ppermute(cut, CHAINS_AXIS, perms[parity])
-    beta_p = jax.lax.ppermute(beta, CHAINS_AXIS, perms[parity])
-    log_a = params.log_base * (beta - beta_p) * (cut - cut_p)
-    # shared uniform per unordered pair (pair id = lower device index),
-    # computed identically on both partners from the replicated key
-    pair_id = jnp.where(idx % 2 == parity, idx, idx - 1)
+    stacked = jax.lax.all_gather(
+        jnp.stack([params.beta, cut_count.astype(jnp.float32),
+                   params.log_base]), CHAINS_AXIS)            # (D, 3, L)
+    bl = stacked[:, 0].T                                      # (L, D)
+    cl = stacked[:, 1].T
+    # rank of each device's beta within its slot's ladder (0 = coldest);
+    # ties fall back to device order via the stable sort
+    pos_of_rank = jnp.argsort(-bl, axis=1, stable=True)       # (L, D)
+    rank_of_pos = jnp.argsort(pos_of_rank, axis=1, stable=True)
+    lo = (rank_of_pos % 2) == parity
+    partner_rank = jnp.clip(jnp.where(lo, rank_of_pos + 1,
+                                      rank_of_pos - 1), 0, n_dev - 1)
+    partner_pos = jnp.take_along_axis(pos_of_rank, partner_rank, axis=1)
+    valid = jnp.where(lo, rank_of_pos + 1 < n_dev, rank_of_pos >= 1)
+    beta_p = jnp.take_along_axis(bl, partner_pos, axis=1)
+    cut_p = jnp.take_along_axis(cl, partner_pos, axis=1)
+    lb = stacked[:, 2].T                                      # (L, D)
+    log_a = lb * (bl - beta_p) * (cl - cut_p)
+    # shared uniform per unordered pair: keyed by (slot, lower rank),
+    # identical on both partners and on every device
+    pair_rank = jnp.minimum(rank_of_pos, partner_rank)
     k = jax.random.fold_in(key, parity)
-    u = jax.vmap(lambda i: jax.random.uniform(
-        jax.random.fold_in(k, pair_id * beta.shape[0] + i)))(
-        jnp.arange(beta.shape[0]))
-    accept = partner_exists & (jnp.log(jnp.maximum(u, 1e-12)) < log_a)
-    new_beta = jnp.where(accept, beta_p, beta)
-    return params.replace(beta=new_beta), accept.sum()
+    n_l = bl.shape[0]
+    u = jax.vmap(jax.vmap(lambda s, r: jax.random.uniform(
+        jax.random.fold_in(k, s * n_dev + r))))(
+        jnp.broadcast_to(jnp.arange(n_l)[:, None], pair_rank.shape),
+        pair_rank)
+    accept = valid & (jnp.log(jnp.maximum(u, 1e-12)) < log_a)  # (L, D)
+    new_bl = jnp.where(accept, beta_p, bl)
+    my_beta = new_bl.T[idx]
+    my_accept = accept.T[idx]
+    return params.replace(beta=my_beta), my_accept.sum()
 
 
 def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
@@ -89,7 +102,6 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
                          "beta, which the annealed kernel ignores")
     n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     paxes = StepParams.vmap_axes()
-    perms = _even_odd_perms(n_dev)
 
     def local_advance(params, states):
         def body(states, _):
@@ -116,9 +128,9 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
         swaps = jnp.int32(0)
         if exchange and n_dev > 1:
             params, s0 = _swap_round(key, params, states.cut_count, 0,
-                                     n_dev, perms)
+                                     n_dev)
             params, s1 = _swap_round(key, params, states.cut_count, 1,
-                                     n_dev, perms)
+                                     n_dev)
             swaps = s0 + s1
         info = {
             "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),
@@ -140,7 +152,6 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
         raise ValueError("replica exchange is incompatible with "
                          "Spec.anneal != 'none'")
     n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
-    perms = _even_odd_perms(n_dev)
     pspec = _params_spec(sharded=True)
     state_spec = jax.tree.map(lambda _: P(CHAINS_AXIS),
                               board_states_struct())
@@ -158,8 +169,8 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
             # the board loop carries cut_count incrementally, so it is the
             # current energy right after a chunk
             cuts = states.cut_count
-            params, s0 = _swap_round(key, params, cuts, 0, n_dev, perms)
-            params, s1 = _swap_round(key, params, cuts, 1, n_dev, perms)
+            params, s0 = _swap_round(key, params, cuts, 0, n_dev)
+            params, s1 = _swap_round(key, params, cuts, 1, n_dev)
             swaps = s0 + s1
         info = {
             "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),

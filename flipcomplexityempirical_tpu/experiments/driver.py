@@ -441,7 +441,7 @@ def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
         n_yields=cfg.total_steps, params=params,
         betas=np.asarray(cfg.betas, np.float64), n_rungs=n_rungs,
         swap_every=cfg.swap_every, record_every=cfg.record_every,
-        general_initial=(res.general_initial if res is not None else True),
+        general_initial=not isinstance(states, kboard.BoardState),
         beta_hist=beta_hist,
         swap_attempts=attempts, swap_accepts=accepts,
         end_parity=parity, end_swap_key=swap_key)

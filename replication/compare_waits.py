@@ -44,6 +44,9 @@ def main():
     by_base = defaultdict(list)
     for tag in common:
         m = re.match(r"(\d)B(\d+)P(\d+)", tag)
+        if m is None:
+            print(f"(skipping unrecognized tag {tag!r})")
+            continue
         by_base[int(m.group(2))].append((tag, ours[tag], ref[tag]))
 
     print(f"{len(common)} cells compared "

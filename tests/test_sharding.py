@@ -48,6 +48,33 @@ def test_sharded_run_bit_identical():
     assert (res1.history["wait"] == res2.history["wait"]).all()
 
 
+def test_cross_device_swaps_pair_adjacent_ranks():
+    """At base=1 every valid swap accepts, so one train step (a parity-0
+    round then a parity-1 round) must apply the deterministic rank
+    brickwork to each slot's ladder — rank-paired, NOT device-paired:
+    after the parity-0 exchange the betas sit permuted across devices,
+    and the parity-1 round must still pair the adjacent TEMPERATURES."""
+    mesh = distribute.make_mesh(8)
+    g, dg, states, params, spec = setup_batch(chains=8, base=1.0)
+    betas = np.linspace(2.0, 0.25, 8).astype(np.float32)  # descending
+    params = params.replace(beta=jnp.asarray(betas))
+    states = distribute.shard_chain_batch(mesh, states)
+    params = distribute.shard_chain_batch(mesh, params)
+    step = distribute.make_train_step(dg, spec, mesh, inner_steps=3)
+    params2, _, info = step(jax.random.PRNGKey(1), params, states)
+    # expected: pos_of_rank starts [0..7]; parity-0 swaps rank pairs
+    # (0,1)(2,3)(4,5)(6,7); parity-1 swaps (1,2)(3,4)(5,6)
+    pos_of_rank = np.arange(8)
+    for parity in (0, 1):
+        for r in range(7):
+            if r % 2 == parity:
+                pos_of_rank[[r, r + 1]] = pos_of_rank[[r + 1, r]]
+    expect = np.empty(8, np.float32)
+    expect[pos_of_rank] = betas
+    np.testing.assert_array_equal(np.asarray(params2.beta), expect)
+    assert int(info["swaps"]) == 2 * (4 + 3)  # both partners count
+
+
 def test_train_step_with_cross_device_exchange():
     mesh = distribute.make_mesh(8)
     g, dg, states, params, spec = setup_batch(chains=16)
