@@ -25,6 +25,7 @@ from ..graphs.lattice import DeviceGraph
 from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
+from ..sampling.tempering import chain_rungs
 from ..state.chain_state import ChainState
 from .mesh import CHAINS_AXIS
 
@@ -55,29 +56,30 @@ def _swap_round(key, params, cut_count, parity, n_dev):
         jnp.stack([params.beta, cut_count.astype(jnp.float32),
                    params.log_base]), CHAINS_AXIS)            # (D, 3, L)
     bl = stacked[:, 0].T                                      # (L, D)
-    cl = stacked[:, 1].T
-    # rank of each device's beta within its slot's ladder (0 = coldest);
-    # ties fall back to device order via the stable sort
-    pos_of_rank = jnp.argsort(-bl, axis=1, stable=True)       # (L, D)
-    rank_of_pos = jnp.argsort(pos_of_rank, axis=1, stable=True)
+    # per-chain ENERGY log_base * cut: the swap ratio for targets
+    # pi_i ∝ exp(-beta_i * lb_i * cut) is exp((b1-b2)(lb1*c1 - lb2*c2)),
+    # which is symmetric under partner exchange even when log_base
+    # differs per chain (the (b1-b2)*lb*(c1-c2) shortcut is not)
+    el = stacked[:, 2].T * stacked[:, 1].T                    # (L, D)
+    n_l = bl.shape[0]
+    # rank of each device's beta within its slot's ladder (0 = coldest;
+    # the same convention as the in-batch tempering.chain_rungs)
+    rung_flat, pos_of_rank = chain_rungs(bl.reshape(-1), n_dev)
+    rank_of_pos = rung_flat.reshape(n_l, n_dev)
     lo = (rank_of_pos % 2) == parity
     partner_rank = jnp.clip(jnp.where(lo, rank_of_pos + 1,
                                       rank_of_pos - 1), 0, n_dev - 1)
     partner_pos = jnp.take_along_axis(pos_of_rank, partner_rank, axis=1)
     valid = jnp.where(lo, rank_of_pos + 1 < n_dev, rank_of_pos >= 1)
     beta_p = jnp.take_along_axis(bl, partner_pos, axis=1)
-    cut_p = jnp.take_along_axis(cl, partner_pos, axis=1)
-    lb = stacked[:, 2].T                                      # (L, D)
-    log_a = lb * (bl - beta_p) * (cl - cut_p)
-    # shared uniform per unordered pair: keyed by (slot, lower rank),
-    # identical on both partners and on every device
+    e_p = jnp.take_along_axis(el, partner_pos, axis=1)
+    log_a = (bl - beta_p) * (el - e_p)
+    # shared uniform per unordered pair: one (L, D) draw read through the
+    # pair's lower rank, identical on both partners and on every device
     pair_rank = jnp.minimum(rank_of_pos, partner_rank)
-    k = jax.random.fold_in(key, parity)
-    n_l = bl.shape[0]
-    u = jax.vmap(jax.vmap(lambda s, r: jax.random.uniform(
-        jax.random.fold_in(k, s * n_dev + r))))(
-        jnp.broadcast_to(jnp.arange(n_l)[:, None], pair_rank.shape),
-        pair_rank)
+    u_rank = jax.random.uniform(jax.random.fold_in(key, parity),
+                                (n_l, n_dev))
+    u = jnp.take_along_axis(u_rank, pair_rank, axis=1)
     accept = valid & (jnp.log(jnp.maximum(u, 1e-12)) < log_a)  # (L, D)
     new_bl = jnp.where(accept, beta_p, bl)
     my_beta = new_bl.T[idx]

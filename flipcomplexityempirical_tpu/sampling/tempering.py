@@ -6,7 +6,8 @@ chains with replica-exchange swaps across a temperature ladder" as a target
 config. TPU-native design: the ladder lives along the chains axis — chain c
 is rung ``c % n_rungs`` of ladder ``c // n_rungs`` — so a swap round is a
 pure permutation-and-select over the batch (no gather/scatter), and a
-cross-device ladder rides `lax.ppermute` over ICI (distribute/sharded.py).
+cross-device ladder rides a scalar `lax.all_gather` over ICI with
+rank-paired replicated selection (distribute/sharded.py).
 
 Swaps exchange TEMPERATURES (the beta entries of StepParams), not states:
 exchanging the cheap scalar keeps assignment tensors in place, which is the
@@ -104,9 +105,12 @@ def swap_within_batch(key, states, params: StepParams,
     valid_pair = jnp.where(
         lo, rung + 1 < n_rungs, (rung >= 1) & (rung % 2 == (1 - parity % 2)))
 
-    cut = states.cut_count.astype(jnp.float32)
-    lb = params.log_base
-    log_a = lb * (beta - beta[partner]) * (cut - cut[partner])
+    # per-chain ENERGY log_base * cut: exp((b1-b2)(lb1*c1 - lb2*c2)) is
+    # the correct swap ratio and stays partner-symmetric even if
+    # log_base differs per chain (the lb*(b1-b2)*(c1-c2) shortcut does
+    # not — partners would disagree on the same shared uniform)
+    energy = params.log_base * states.cut_count.astype(jnp.float32)
+    log_a = (beta - beta[partner]) * (energy - energy[partner])
     # one shared uniform per unordered pair: draw at the lower index
     pair_id = jnp.minimum(jnp.arange(c), partner)
     u = jax.random.uniform(key, (c,))

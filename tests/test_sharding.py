@@ -217,7 +217,7 @@ def test_board_sharded_run_bit_identical():
 
 
 def test_board_train_step_cross_device_exchange():
-    """shard_map'd board kernel + ppermute beta ladder: the multi-chip
+    """shard_map'd board kernel + rank-paired beta ladder: the multi-chip
     form of the benchmark workload."""
     from flipcomplexityempirical_tpu.kernel import board as kboard
 
