@@ -74,17 +74,17 @@ def _imshow(graph, family, values, path):
     # grid): the label bounding box
     if family == "frank":
         a2 = np.zeros([20, 40])
-        off = 19
+        xoff, yoff = 0, 19
     elif family == "sec11":
         a2 = np.zeros([40, 40])
-        off = 0
+        xoff, yoff = 0, 0
     else:
         xs = [l[0] for l in graph.labels]
         ys = [l[1] for l in graph.labels]
-        a2 = np.zeros([max(xs) + 1, max(ys) - min(ys) + 1])
-        off = -min(ys)
+        a2 = np.zeros([max(xs) - min(xs) + 1, max(ys) - min(ys) + 1])
+        xoff, yoff = -min(xs), -min(ys)
     for i, (x, y) in enumerate(graph.labels):
-        a2[x, y + off] = values[i]
+        a2[x + xoff, y + yoff] = values[i]
     plt.figure()
     plt.imshow(a2, cmap="jet")
     plt.colorbar()

@@ -600,7 +600,15 @@ def _run_python(cfg: ExperimentConfig, g, plan) -> dict:
 
 def _ckpt_identity(cfg: ExperimentConfig) -> str:
     """Everything the tag does NOT encode (or encodes lossily — the tag
-    truncates base/pop_tol to int(100*x)) but resume correctness needs."""
+    truncates base/pop_tol to int(100*x)) but resume correctness needs.
+
+    Compatibility note (ADVICE r4): adding a field here invalidates every
+    checkpoint written before the addition — identity mismatch makes
+    resume restart the config from scratch (by design: a stale checkpoint
+    must never be silently continued under new semantics). The round-4
+    additions (k, grid, lattice/dual dims, record_every, betas,
+    swap_every) did exactly that to round-3 checkpoints. Discarding is
+    loud: the driver logs the mismatch before restarting."""
     return (f"{cfg.family}|steps={cfg.total_steps}|chains={cfg.n_chains}|"
             f"seed={cfg.seed}|contiguity={cfg.contiguity}|"
             f"accept={cfg.accept}|base={cfg.base!r}|pop={cfg.pop_tol!r}|"

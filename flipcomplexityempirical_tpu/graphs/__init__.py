@@ -3,8 +3,10 @@ from .builders import (
     square_grid, grid_sec11, triangular_lattice, hex_lattice, frankengraph,
     sec11_plan, frank_plan, stripes_plan, PARITY_LABELS,
 )
+from .shapefile import read_shapefile, write_shapefile
 from .dualgraph import (
     GeoAttributes, from_geojson, from_shapefile, synthetic_precincts,
+    voronoi_precincts,
 )
 from .votes import seed_votes, PARTIES
 
@@ -14,6 +16,7 @@ __all__ = [
     "frankengraph", "sec11_plan", "frank_plan", "stripes_plan",
     "PARITY_LABELS",
     "GeoAttributes", "from_geojson", "from_shapefile",
-    "synthetic_precincts",
+    "synthetic_precincts", "voronoi_precincts",
+    "read_shapefile", "write_shapefile",
     "seed_votes", "PARTIES",
 ]

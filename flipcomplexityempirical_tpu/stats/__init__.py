@@ -12,7 +12,7 @@ district compactness scores for real-geometry dual graphs.
 
 from .diagnostics import (
     autocorrelation, integrated_autocorr_time, ess, gelman_rubin,
-    autocorr_mixing_time,
+    autocorr_mixing_time, round_trips, well_crossings,
 )
 from .bottleneck import conductance_profile, bottleneck_ratio
 from .partisan import (
@@ -22,7 +22,7 @@ from .compactness import polsby_popper, cut_edge_count, perimeter_area
 
 __all__ = [
     "autocorrelation", "integrated_autocorr_time", "ess", "gelman_rubin",
-    "autocorr_mixing_time",
+    "autocorr_mixing_time", "round_trips", "well_crossings",
     "conductance_profile", "bottleneck_ratio",
     "district_vote_tallies", "mean_median", "efficiency_gap", "seats_won",
     "polsby_popper", "cut_edge_count", "perimeter_area",

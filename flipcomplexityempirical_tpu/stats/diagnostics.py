@@ -113,3 +113,35 @@ def autocorr_mixing_time(x, threshold: float = np.exp(-1.0)) -> float:
     if not below.any():
         return float("inf")
     return float(np.argmax(below))
+
+
+def well_crossings(x, lo: float, hi: float) -> np.ndarray:
+    """Per-chain count of well-to-well transitions of a (C, T) trajectory
+    between the metastable wells ``x < lo`` and ``x > hi``.
+
+    Samples are classified low (-1) / high (+1) / transit (0); transit
+    samples are dropped; each alternation of the remaining sign sequence
+    is one crossing. This is the mode-mixing observable behind
+    REPLICATION.md's plain-vs-tempered comparison on the bimodal FRANK
+    B333 cell (wells |cut| < 40 and |cut| > 60, where that section's
+    "round trips per chain" counted exactly these crossings — a chain
+    whose only crossing is the one-way initial relaxation scores 1).
+    """
+    x = _chains(x)
+    out = np.zeros(x.shape[0], dtype=np.int64)
+    for c, row in enumerate(x):
+        sign = np.where(row < lo, -1, np.where(row > hi, 1, 0))
+        sign = sign[sign != 0]
+        if sign.size < 2:
+            continue
+        out[c] = int((np.diff(sign) != 0).sum())
+    return out
+
+
+def round_trips(x, lo: float, hi: float) -> np.ndarray:
+    """Per-chain COMPLETED round trips between the wells ``x < lo`` and
+    ``x > hi``: two consecutive crossings (low->high->low or
+    high->low->high) make one trip, so this is ``well_crossings // 2``
+    and the one-way initial relaxation scores 0 — the stricter of the
+    two mode-mixing counts (see ``well_crossings``)."""
+    return well_crossings(x, lo, hi) // 2

@@ -32,56 +32,44 @@ def pytest_configure(config):
         "markers", "slow: long-running test, skipped unless --runslow")
 
 
-# The slow tier, maintained here in one place from pytest --durations runs
-# (everything >= ~9 s on the 1-core build box): full-scale replication,
-# exhaustive enumerations, long bit-identity matrices, 8-device suites,
-# heavyweight end-to-end cells. The default selection (< ~3 min) is for
-# iteration; CI-style runs pass --runslow for the full matrix.
-SLOW_TEST_SUBSTRINGS = (
-    "test_replication.py",
-    "test_pair_walk_matches_exact_stationary",
-    "test_pair_walk_k2_equals_bi_walk",
-    "test_kernel_matches_exact_stationary",
-    "test_board_path_matches_exact_stationary",
-    "test_corrected_accept_matches_reversible_target",
-    "test_bit_identity_vs_int8_body",
-    "test_pair_bit_identity_vs_int8_body",
-    "test_mid_config_resume_is_bit_identical",
-    "test_run_config_artifacts_and_resume",
-    "test_checkpoint_mismatch_and_stale_formats_ignored",
-    "test_checkpoint_roundtrip",
-    "test_apply_flip_log_chunked_composition",
-    "test_board_chunking_is_invisible",
-    "test_record_every_is_a_stride",
-    "test_board_matches_general_path",
-    "test_board_invariants",
-    "test_tree_retries_recover_tight_epsilon",
-    "test_simulator_matches_xla_board_distribution",
-    "test_pair_board_matches_general_path",
-    "test_sharded_run_bit_identical",
-    "test_board_sharded_run_bit_identical",
-    "test_temper_family_end_to_end",
-    "test_kpair_family_end_to_end",
-    "test_single_rung_matches_plain_runner",
-    "test_base1_deterministic_swaps_and_rung_reconstruction",
-    "test_pair_kernel_matches_oracle_distributions",
-    "test_kernel_matches_oracle_distributions",
-    "test_invariants_pair_k8",
-    "test_anneal_linear_beta_ramps_to_max",
-    "test_select_flat_picks_mth_valid",
-)
+# The slow tier is declared AT DEFINITION SITE with @pytest.mark.slow
+# (VERDICT r4: a name-substring table here silently mis-tiered renamed or
+# new slow tests). Criterion for marking a test slow: >= ~9 s on the
+# 1-core build box (full-scale replication, exhaustive enumerations, long
+# bit-identity matrices, 8-device suites, heavyweight end-to-end cells).
+# The default selection is the fast iteration tier; CI-style runs pass
+# --runslow for the full matrix. pytest_terminal_summary below polices the
+# boundary: any unmarked test that runs long is flagged at the end of a
+# fast-tier run, so the tier cannot silently drift (the wall-clock load on
+# this box varies 2-3x, hence a loud report rather than a hard failure).
+
+FAST_TIER_PER_TEST_BUDGET_S = 12.0
 
 
 def pytest_collection_modifyitems(config, items):
-    for item in items:
-        if any(s in item.nodeid for s in SLOW_TEST_SUBSTRINGS):
-            item.add_marker(pytest.mark.slow)
     if config.getoption("--runslow"):
         return
     skip = pytest.mark.skip(reason="slow tier: pass --runslow")
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if config.getoption("--runslow"):
+        return
+    over = [
+        (rep.duration, rep.nodeid)
+        for rep in terminalreporter.stats.get("passed", ())
+        if rep.when == "call" and rep.duration > FAST_TIER_PER_TEST_BUDGET_S
+    ]
+    if over:
+        terminalreporter.section("fast-tier budget")
+        for dur, nodeid in sorted(over, reverse=True):
+            terminalreporter.write_line(
+                f"{dur:6.1f}s  {nodeid}  — exceeds the "
+                f"{FAST_TIER_PER_TEST_BUDGET_S:.0f}s fast-tier budget; "
+                "mark it @pytest.mark.slow")
 
 
 @pytest.fixture(scope="session")

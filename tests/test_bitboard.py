@@ -53,6 +53,7 @@ def test_bit_sliced_counters(rng):
     np.testing.assert_array_equal(np.asarray(got), planes.sum(0))
 
 
+@pytest.mark.slow
 def test_select_flat_picks_mth_valid(rng):
     g = fce.graphs.square_grid(6, 32)
     bg = kb.make_board_graph(g)
@@ -94,6 +95,7 @@ def assert_run_equal(st, got, want):
     ((6, 32), dict(contiguity="none")),
     ((6, 32), dict(geom_waits=False, parity_metrics=False)),
 ])
+@pytest.mark.slow
 def test_bit_identity_vs_int8_body(rng, hw, spec_kw):
     """The dispatch and the promise: on a supported workload the
     auto-dispatched chunk (bit body) equals the int8 body forced via
@@ -126,6 +128,7 @@ def test_bit_identity_vs_int8_body(rng, hw, spec_kw):
     ((6, 32), 3, dict(contiguity="none")),
     ((6, 32), 5, dict(geom_waits=False, parity_metrics=False)),
 ])
+@pytest.mark.slow
 def test_pair_bit_identity_vs_int8_body(hw, k, spec_kw):
     """The k-district pair bit body (district ids as bit-sliced planes)
     equals the int8 pair body forced via bits=False — field for field."""

@@ -206,6 +206,7 @@ def test_apply_flip_log_key_overflow_guard():
                           jnp.zeros(1, jnp.int32))
 
 
+@pytest.mark.slow
 def test_apply_flip_log_chunked_composition(rng):
     """Splitting a log at an arbitrary boundary (including mid-run) and
     applying the pieces sequentially gives the same result as one piece."""
@@ -249,6 +250,7 @@ def _run(grid=8, chains=32, steps=601, base=1.4, tol=0.3, seed=3, **kw):
     return g, res
 
 
+@pytest.mark.slow
 def test_board_invariants():
     g, res = _run()
     s = res.host_state()
@@ -290,6 +292,7 @@ def test_board_population_bounds_respected():
     assert (s.dist_pop <= (1 + 0.05) * ideal + 1e-6).all()
 
 
+@pytest.mark.slow
 def test_board_chunking_is_invisible():
     """Same seed, different chunking => bit-identical state and history."""
     g = fce.graphs.square_grid(6, 6)
@@ -315,6 +318,7 @@ def test_board_chunking_is_invisible():
 
 @pytest.mark.parametrize("path", ["general", "board"])
 @pytest.mark.parametrize("every", [4, 7])
+@pytest.mark.slow
 def test_record_every_is_a_stride(path, every):
     """Thinned recording (record_every=k) must be EXACTLY the full
     history's columns 0, k, 2k, ... — same seed, same final state, same
@@ -386,6 +390,7 @@ def test_supports_gates():
 # 4. board path vs general path: same distribution
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_board_matches_general_path():
     grid, chains, steps, burn = 8, 24, 4001, 800
     base, tol = 1.4, 0.2

@@ -17,6 +17,7 @@ from conftest import assert_grid_districts_connected
 from flipcomplexityempirical_tpu.kernel import board as kb
 
 from test_parity import ks_stat
+import pytest
 
 
 def _spec(k, **kw):
@@ -143,6 +144,7 @@ def test_pair_run_invariants():
                                   res.history["cut_count"].sum(axis=1))
 
 
+@pytest.mark.slow
 def test_pair_board_matches_general_path():
     # burn must cover the k=4 mode-mixing transient: at burn 600 the
     # per-run mean-cut spread is ~1.3% seed-to-seed (both backends);

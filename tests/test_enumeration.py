@@ -130,6 +130,7 @@ def assert_matches_stationary(abits, states, pi, cuts,
 
 
 @pytest.mark.parametrize("base", [0.5, 1.0, 2.0])
+@pytest.mark.slow
 def test_kernel_matches_exact_stationary(base):
     g, nbrmask = build_masks()
     states = enumerate_states(nbrmask)
@@ -147,6 +148,7 @@ def test_kernel_matches_exact_stationary(base):
                               states, pi, cuts)
 
 
+@pytest.mark.slow
 def test_corrected_accept_matches_reversible_target():
     """With the |b_nodes| correction AND selfloop invalid policy, the chain
     IS reversible w.r.t. pi ∝ base^(-|cut|) on the valid-state space: the
@@ -242,6 +244,7 @@ def k3_build_transition(states, g, base):
 
 
 @pytest.mark.parametrize("path", ["general", "board"])
+@pytest.mark.slow
 def test_pair_walk_matches_exact_stationary(path):
     """The k=3 pair walk (both backends) against the power-iterated
     stationary distribution of its exact transition matrix."""
@@ -271,6 +274,7 @@ def test_pair_walk_matches_exact_stationary(path):
 
 
 @pytest.mark.parametrize("path", ["general", "board"])
+@pytest.mark.slow
 def test_pair_walk_k2_equals_bi_walk(path):
     """At k=2 the pair move set — distinct (node, adjacent-other-district)
     pairs (grid_chain_sec11.py:117-130) — is in bijection with the bi move
@@ -317,6 +321,7 @@ def test_pair_walk_k2_equals_bi_walk(path):
 
 
 @pytest.mark.parametrize("base", [0.5, 2.0])
+@pytest.mark.slow
 def test_board_path_matches_exact_stationary(base):
     """The board (stencil) fast path faces the same exact-enumeration bar
     as the general kernel: empirical occupancy vs the power-iterated

@@ -22,6 +22,7 @@ def test_config_tags_match_reference_vocabulary():
     assert "2B333P90" in ftags  # int(100/0.3) == 333 truncation
 
 
+@pytest.mark.slow
 def test_run_config_artifacts_and_resume(tmp_path):
     out = str(tmp_path / "plots")
     cfg = ex.ExperimentConfig(family="frank", alignment=2, base=1 / .3,
@@ -54,6 +55,7 @@ def test_python_backend_runs(tmp_path):
     assert np.abs(data["part_sum"]).max() <= 200
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path):
     out = str(tmp_path / "plots")
     ck = str(tmp_path / "ckpt")
@@ -67,6 +69,7 @@ def test_checkpoint_roundtrip(tmp_path):
             np.asarray(data["state"].assignment)).all()
 
 
+@pytest.mark.slow
 def test_mid_config_resume_is_bit_identical(tmp_path):
     """A crash between checkpoint segments resumes exactly: the
     interrupted-and-resumed run reproduces the uninterrupted run
@@ -100,6 +103,7 @@ def test_mid_config_resume_is_bit_identical(tmp_path):
     np.testing.assert_array_equal(clean["part_sum"], resumed["part_sum"])
 
 
+@pytest.mark.slow
 def test_checkpoint_mismatch_and_stale_formats_ignored(tmp_path):
     """Resume must never crash on, or silently reuse, incompatible
     checkpoints: wrong config identity, old formats, too-long runs."""

@@ -30,6 +30,7 @@ def _districts_connected(g, assignment, k):
         assert nx.is_connected(gx.subgraph(nodes))
 
 
+@pytest.mark.slow
 def test_kpair_family_end_to_end(tmp_path):
     """k-district pair walk on the plain grid: board fast path, k=4."""
     cfg = ex.ExperimentConfig(family="kpair", alignment=0, base=0.8,
@@ -90,6 +91,7 @@ def test_dual_family_end_to_end(tmp_path):
                 <= (1 + 0.25) * ideal + 1e-6
 
 
+@pytest.mark.slow
 def test_temper_family_end_to_end(tmp_path):
     cfg = ex.ExperimentConfig(family="temper", alignment=0, base=1 / .3,
                               pop_tol=0.1, betas=(1.0, 0.6, 0.3),

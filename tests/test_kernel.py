@@ -214,6 +214,7 @@ def test_anneal_linear_beta_zero_accepts_all_valid():
     assert (np.asarray(s.accept_count) == 199).all()
 
 
+@pytest.mark.slow
 def test_anneal_linear_beta_ramps_to_max():
     # t0=0, ramp=1 => beta saturates at beta_max immediately: the annealed
     # chain must match a constant-beta chain distributionally (strongly
@@ -253,6 +254,7 @@ def test_frame_interface_constraint_holds():
         assert len(vals) == 2
 
 
+@pytest.mark.slow
 def test_invariants_pair_k8():
     """BASELINE config 2 at k=8: districts all alive, connected, balanced."""
     spec = fce.Spec(n_districts=8, proposal="pair", contiguity="patch")

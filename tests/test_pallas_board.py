@@ -246,6 +246,7 @@ def test_selection_is_uniform_over_valid():
     assert np.abs(counts[valid] - expect).max() < 5 * np.sqrt(expect)
 
 
+@pytest.mark.slow
 def test_simulator_matches_xla_board_distribution():
     """Transitive distribution check: the kernel is bit-exact to the
     simulator (above), and the simulator's trajectory statistics match

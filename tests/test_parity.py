@@ -97,6 +97,7 @@ def kernel_pair_trajectories(lat, k, seed, chains=8):
             res.history["wait"][:, BURN:])
 
 
+@pytest.mark.slow
 def test_kernel_matches_oracle_distributions():
     lat = fce.graphs.square_grid(6, 6)
     o_cut, o_b, o_w = oracle_trajectory(lat, seed=1)
@@ -116,6 +117,7 @@ def test_kernel_matches_oracle_distributions():
     assert abs(o_w.mean() - k_w.mean()) / o_w.mean() < 0.10
 
 
+@pytest.mark.slow
 def test_pair_kernel_matches_oracle_distributions():
     """The k-district pair walk agrees with the gerrychain-semantics
     oracle, including the distinct-PAIR |b_nodes| feeding geom_wait."""

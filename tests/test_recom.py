@@ -337,6 +337,7 @@ def test_cross_backend_stationary_statistics():
     assert abs(host_cuts.mean() - jcuts.mean()) / host_cuts.mean() < 0.05
 
 
+@pytest.mark.slow
 def test_tree_retries_recover_tight_epsilon():
     """At a tight tolerance a single tree often has no balanced edge; the
     bounded in-move retry must lift the per-move success rate well above

@@ -12,8 +12,12 @@ sampling noise.
 import os
 
 import numpy as np
+import pytest
 
 from flipcomplexityempirical_tpu import experiments as ex
+
+# full-scale replication cells: slow tier as a module
+pytestmark = pytest.mark.slow
 
 
 def test_frank_b30_full_scale_wait_sum(tmp_path):

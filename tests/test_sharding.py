@@ -32,6 +32,7 @@ def test_eight_devices_available():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.slow
 def test_sharded_run_bit_identical():
     g, dg, states, params, spec = setup_batch()
     res1 = fce.run_chains(dg, spec, params, states, n_steps=100)
@@ -186,6 +187,7 @@ def test_board_sharded_pair_train_step():
     assert_grid_districts_connected(b, k)
 
 
+@pytest.mark.slow
 def test_board_sharded_run_bit_identical():
     """The board fast path shards the chains axis transparently: 1 vs 8
     devices produce bit-identical histories and state."""

@@ -23,6 +23,7 @@ from test_enumeration import (build_masks, enumerate_states,
 
 
 @pytest.mark.parametrize("path", ["general", "board"])
+@pytest.mark.slow
 def test_single_rung_matches_plain_runner(path):
     g = fce.graphs.square_grid(6, 6)
     plan = fce.graphs.stripes_plan(g, 2)
@@ -59,6 +60,7 @@ def test_single_rung_matches_plain_runner(path):
     assert res.swap_attempts.sum() == 0
 
 
+@pytest.mark.slow
 def test_base1_deterministic_swaps_and_rung_reconstruction():
     """At base=1 the swap log-ratio is 0 > log(u), so every valid pair
     exchanges every round: beta_hist follows the deterministic even-odd
@@ -164,3 +166,61 @@ def test_rungs_match_exact_joint_stationary():
     for r, pi in ((0, pi_cold), (1, pi_hot)):
         assert_matches_stationary(rung[r][:, burn:].ravel(),
                                   states, pi, cuts)
+
+
+def test_host_rungs_pinned_to_device_chain_rungs():
+    """tempered._host_rungs is a numpy mirror of tempering.chain_rungs;
+    the swap bookkeeping silently depends on the two staying in lockstep
+    (ADVICE r4). Pin them on ladders WITH duplicate betas — the case
+    where a stable-sort divergence would first show — across random
+    permutations of rung-to-position assignments."""
+    import jax.numpy as jnp
+    from flipcomplexityempirical_tpu.sampling.tempered import _host_rungs
+    from flipcomplexityempirical_tpu.sampling.tempering import chain_rungs
+
+    rng = np.random.default_rng(3)
+    for ladder in ([2.0, 1.0, 1.0, 0.5],
+                   [1.0, 1.0, 1.0],
+                   [4.0, 2.0, 1.0, 0.5, 0.25]):
+        n_rungs = len(ladder)
+        for _ in range(20):
+            perm = np.stack([rng.permutation(ladder) for _ in range(6)])
+            beta = np.asarray(perm, np.float32).reshape(-1)
+            dev, _ = chain_rungs(jnp.asarray(beta), n_rungs)
+            np.testing.assert_array_equal(
+                _host_rungs(beta, n_rungs), np.asarray(dev))
+
+
+@pytest.mark.slow
+def test_tempered_mixes_bimodal_better_than_plain():
+    """The scientific payoff of BASELINE config 4, kept continuously
+    true (VERDICT r4): on the bimodal FRANK B333 cell, the TEMPER_BETAS
+    ladder's reconstructed cold-rung trajectories complete strictly more
+    well round trips per chain than plain beta=1 chains on the same
+    per-chain budget. Reduced-budget calibration (20k steps, seed 0):
+    tempered 13 completed round trips over 6 ladders vs plain 1 over 8
+    chains — asserted with a wide margin below."""
+    import importlib.util
+    import pathlib
+
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "replication" / "compare_tempering.py")
+    mspec = importlib.util.spec_from_file_location("compare_tempering",
+                                                   path)
+    mod = importlib.util.module_from_spec(mspec)
+    mspec.loader.exec_module(mod)
+
+    rec = mod.run_comparison(steps=20001, plain_chains=8, ladders=6,
+                             swap_every=50, seed=0, record_every=5)
+    plain_rt = np.asarray(rec["plain"]["round_trips"])
+    cold_rt = np.asarray(rec["tempered_cold_rung"]["round_trips"])
+    swap_rates = np.asarray(rec["tempered_cold_rung"]["swap_rates"])
+    # the ladder itself must be healthy end to end, or the cold rung is
+    # just a plain chain with extra steps
+    assert swap_rates.min() > 0.2
+    # strictly better mode mixing per chain, with margin: the calibrated
+    # ratio is ~17x, the assertion only demands 3x
+    assert cold_rt.mean() > 3 * max(plain_rt.mean(), 1 / len(plain_rt))
+    # and the plain arm reproduces its REPLICATION.md signature: chains
+    # relax one-way and (almost) never complete a round trip
+    assert plain_rt.mean() < 0.5

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""On-silicon Pallas bit-exactness check (VERDICT r4 item 2).
+
+Runs the Pallas board kernel COMPILED on the default backend (TPU via the
+axon tunnel when up) and in interpret mode, feeding both the SAME
+host-supplied random bits (``_host_bits``), and compares the full end
+state: board, district populations, histories, wait sums. Interpret mode
+executes the identical per-step jnp semantics that
+tests/test_pallas_board.py proves bit-exact against its transparent numpy
+simulator on CPU — so compiled-vs-interpret equality on the chip closes
+the chain: silicon kernel == simulator semantics.
+
+Prints one JSON line: {"exact": bool, "device": ..., mismatch detail}.
+Exit 0 on exact match, 1 on mismatch, 2 on error/unsupported.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import flipcomplexityempirical_tpu as fce
+
+    dev = jax.devices()[0]
+    h, w, chains, steps = 8, 16, 8, 41
+    g = fce.graphs.square_grid(h, w)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=0, spec=spec, base=1.4, pop_tol=0.3)
+
+    rng = np.random.default_rng(7)
+    bank = {}
+
+    def host_bits(chunk_idx, t, c, n):
+        if chunk_idx not in bank:
+            bank[chunk_idx] = (
+                rng.integers(0, 2**32, size=(t, c, n), dtype=np.uint32),
+                rng.integers(0, 2**32, size=(t, 2, c), dtype=np.uint32))
+        return bank[chunk_idx]
+
+    results = {}
+    for name, interp in (("compiled", False), ("interpret", True)):
+        res = fce.sampling.run_board_pallas(
+            bg, spec, params, st, n_steps=steps, chunk=10,
+            block_chains=chains, interpret=interp, _host_bits=host_bits)
+        s = res.host_state()
+        results[name] = {
+            "board": np.asarray(s.board),
+            "dist_pop": np.asarray(s.dist_pop),
+            "waits_sum": np.asarray(s.waits_sum),
+            "cut_count": np.asarray(res.history["cut_count"]),
+            "accepts": np.asarray(res.history["accepts"]),
+        }
+
+    a, b = results["compiled"], results["interpret"]
+    mism = {k: int(np.sum(a[k] != b[k])) for k in a}
+    exact = not any(mism.values())
+    print(json.dumps({"check": "pallas_compiled_vs_interpret",
+                      "exact": exact, "device": str(dev),
+                      "chains": chains, "steps": steps,
+                      "mismatches": mism}))
+    return 0 if exact else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001 - watchdog consumes the rc
+        print(json.dumps({"check": "pallas_compiled_vs_interpret",
+                          "error": repr(e)}))
+        sys.exit(2)

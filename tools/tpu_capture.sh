@@ -1,0 +1,56 @@
+#!/bin/bash
+# One full on-chip capture set, priority-ordered (VERDICT r4 next-1/2/3).
+# Assumes the probe just succeeded. Each record is written to bench_runs/
+# and committed IMMEDIATELY so a tunnel drop mid-set loses nothing.
+# A record that comes back "cpu_fallback" is kept on disk (*.fallback)
+# but NOT committed and aborts the set — the tunnel dropped again.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_runs
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+
+commit_retry() {
+  for _ in 1 2 3 4 5; do
+    git add "$@" && git commit -q -m "TPU watchdog: capture $(basename "$1")" && return 0
+    sleep 7
+  done
+  return 1
+}
+
+run_bench() { # name timeout args...
+  local name=$1 tmo=$2; shift 2
+  local out="bench_runs/${TS}_${name}.json" err="bench_runs/${TS}_${name}.err"
+  timeout "$tmo" python bench.py "$@" >"$out" 2>"$err"
+  local rc=$?
+  if [ $rc -ne 0 ] || [ ! -s "$out" ]; then
+    echo "capture $name: rc=$rc, aborting set" >&2
+    return 1
+  fi
+  if grep -q cpu_fallback "$out"; then
+    mv "$out" "$out.fallback"
+    echo "capture $name: tunnel dropped (cpu_fallback), aborting set" >&2
+    return 1
+  fi
+  commit_retry "$out" "$err"
+}
+
+# 1. THE scoreboard record: default board bench, both bodies
+run_bench default 900 || exit 1
+# 2. ESS-per-second axis (BASELINE wall-clock-to-target-ESS)
+run_bench ess 900 --ess || exit 1
+# 3. Pallas timing
+run_bench pallas 900 --pallas
+# 4. Pallas bit-exactness on silicon
+timeout 600 python tools/pallas_exact.py \
+  >"bench_runs/${TS}_pallas_exact.json" 2>"bench_runs/${TS}_pallas_exact.err"
+commit_retry "bench_runs/${TS}_pallas_exact.json" "bench_runs/${TS}_pallas_exact.err"
+# 5. Chain-count scaling (>=1e4-chain axis)
+run_bench c8192 1200 --chains 8192
+run_bench c16384 1800 --chains 16384
+# 6. General-path record refresh (round-2's 0.30x was this path)
+run_bench general 900 --general
+# 7. ESS with thinning (record_every ~ IAT)
+run_bench ess_thin 900 --ess --record-every 10
+touch bench_runs/CAPTURED_${TS}
+commit_retry bench_runs/CAPTURED_${TS}
+echo "capture set complete: ${TS}"
