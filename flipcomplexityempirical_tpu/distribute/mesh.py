@@ -52,7 +52,11 @@ def shard_chain_batch(mesh: Mesh, tree, axis: str = CHAINS_AXIS):
 def initialize_distributed(coordinator: str | None = None,
                            num_processes: int | None = None,
                            process_id: int | None = None) -> None:
-    """Multi-host bring-up over DCN (no-op single-host)."""
+    """Multi-host bring-up over DCN (no-op single-host).
+
+    Smoke-tested by tests/test_distributed_smoke.py: two localhost
+    processes form the cluster, build the global chains mesh, and run a
+    cross-process collective (--runslow tier)."""
     if coordinator is None:
         return
     jax.distributed.initialize(coordinator_address=coordinator,

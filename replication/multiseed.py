@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Multi-seed replication of the slow-base sec11 cells (VERDICT r4 next-4).
+
+The full-corpus table (REPLICATION.md) runs every reference cell ONCE; at
+the slow bases (B263 = mu, B695 = mu^2) single runs are mode-dominated
+and per-cell ratios span 0.58-1.27, justified qualitatively by the
+reference's own 15-cell spread. This script makes that quantitative: it
+runs ONE cell per slow base (alignment 0, P50) at 15 seeds x 8 chains,
+records every per-chain wait sum, and rank/KS-tests the seed distribution
+against the reference's 15 shipped per-base ``wait.txt`` scalars. If the
+spread is mode occupancy (as claimed) the two samples are exchangeable;
+a subtle ordered-phase acceptance bug would shift ours detectably.
+
+  python replication/multiseed.py run       # ~6 min CPU; writes the JSON
+  python replication/multiseed.py analyze   # KS/rank vs the reference
+
+The committed record is replication/seeds/multiseed_sec11.json;
+tests/test_replication.py re-analyzes it (and the reference corpus) on
+every --runslow run so the "consistent with the reference spread" claim
+stays continuously checked.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "seeds", "multiseed_sec11.json")
+MU = 2.63815853
+CELLS = {"B263": MU, "B695": MU ** 2}
+SEEDS = list(range(1, 16))
+REF_DIR = "/root/reference/New_plots/sec11"
+
+
+def run(record_path=RECORD, seeds=SEEDS, steps=100_000, chains=8,
+        scratch=None):
+    from flipcomplexityempirical_tpu.experiments.config import (
+        ExperimentConfig)
+    from flipcomplexityempirical_tpu.experiments.driver import run_config
+
+    scratch = scratch or os.path.join("/tmp", "multiseed_artifacts")
+    rec = {"steps": steps, "chains": chains, "alignment": 0,
+           "pop_tol": 0.5, "seeds": list(seeds), "cells": {}}
+    for name, base in CELLS.items():
+        per_seed = []
+        for s in seeds:
+            cfg = ExperimentConfig(family="sec11", alignment=0, base=base,
+                                   pop_tol=0.5, seed=s, total_steps=steps,
+                                   n_chains=chains)
+            data = run_config(cfg, os.path.join(scratch, f"s{s}"))
+            per_seed.append(np.asarray(data["waits_all"],
+                                       np.float64).tolist())
+            print(f"[multiseed] {name} seed {s}: chain0 "
+                  f"{per_seed[-1][0]:.4g} ({data['seconds']:.1f}s)",
+                  flush=True)
+        rec["cells"][name] = {"base": base, "waits_all": per_seed}
+    os.makedirs(os.path.dirname(record_path), exist_ok=True)
+    with open(record_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(f"wrote {record_path}")
+    return rec
+
+
+def _ref_waits(base_tag, ref_dir=REF_DIR):
+    vals = []
+    for f in sorted(glob.glob(os.path.join(ref_dir,
+                                           f"*{base_tag}P*wait.txt"))):
+        with open(f) as fh:
+            vals.append(float(fh.read().strip()))
+    return np.asarray(vals, np.float64)
+
+
+def ks_2sample(a, b):
+    """Two-sample Kolmogorov-Smirnov: statistic + asymptotic p-value
+    (scipy-free, Smirnov's formula — fine at these sample sizes)."""
+    a, b = np.sort(a), np.sort(b)
+    allv = np.concatenate([a, b])
+    cdf_a = np.searchsorted(a, allv, side="right") / len(a)
+    cdf_b = np.searchsorted(b, allv, side="right") / len(b)
+    d = float(np.abs(cdf_a - cdf_b).max())
+    en = np.sqrt(len(a) * len(b) / (len(a) + len(b)))
+    t = (en + 0.12 + 0.11 / en) * d
+    p = 2 * sum((-1) ** (k - 1) * np.exp(-2 * (k * t) ** 2)
+                for k in range(1, 101))
+    return d, float(min(max(p, 0.0), 1.0))
+
+
+def analyze(record_path=RECORD, ref_dir=None):
+    with open(record_path) as f:
+        rec = json.load(f)
+    ref_dir = ref_dir or REF_DIR
+    results = {}
+    for name, cell in rec["cells"].items():
+        ref = _ref_waits(name, ref_dir)
+        waits = np.asarray(cell["waits_all"], np.float64)  # (S, C)
+        chain0 = waits[:, 0]
+        d0, p0 = ks_2sample(chain0, ref)
+        dall, pall = ks_2sample(waits.ravel(), ref)
+        # rank of the reference median inside our seed distribution:
+        # mode-occupancy exchangeability puts it well inside the body
+        rank = float((chain0 < np.median(ref)).mean())
+        results[name] = {
+            "ref_cells": len(ref),
+            "ref_mean": float(ref.mean()), "ref_min": float(ref.min()),
+            "ref_max": float(ref.max()),
+            "seed_chain0_mean": float(chain0.mean()),
+            "seed_chain0_min": float(chain0.min()),
+            "seed_chain0_max": float(chain0.max()),
+            # mean agreement and seed noise: at B695 the seeds are TIGHT
+            # (the reference's wide per-base spread there is config-
+            # driven — pop tolerance — not run-to-run noise), so the
+            # center is the sharper consistency statement than KS shape
+            "mean_ratio": float(chain0.mean() / ref.mean()),
+            "seed_cv": float(chain0.std(ddof=1) / chain0.mean()),
+            "ks_chain0": {"D": d0, "p": p0},
+            "ks_all_chains": {"D": dall, "p": pall},
+            "ref_median_quantile_in_seeds": rank,
+        }
+    return results
+
+
+def cell_consistent(c: dict) -> bool:
+    """The single consistency gate (CLI and test share it): the KS test
+    does not REJECT at 1%, the seed distribution is centered on the
+    reference per-base mean, seed noise is bounded, and the reference
+    median sits inside the body of the seed distribution. The committed
+    record measures KS p = 0.31 (B263) / 0.0515 (B695); the B695 shape
+    difference is the tight-seeds-vs-config-spread effect described in
+    analyze(), so the binding constraint is the center."""
+    return (c["ks_chain0"]["p"] > 0.01
+            and abs(c["mean_ratio"] - 1) < 0.15
+            and c["seed_cv"] < 0.25
+            and 0.05 < c["ref_median_quantile_in_seeds"] < 0.95)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("cmd", choices=["run", "analyze"])
+    ap.add_argument("--record", default=RECORD)
+    ap.add_argument("--steps", type=int, default=100_000)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if args.cmd == "run":
+        run(args.record, steps=args.steps)
+    res = analyze(args.record)
+    print(json.dumps(res, indent=1))
+    ok = all(map(cell_consistent, res.values()))
+    print("seed spread consistent with reference per-base spread "
+          f"(KS p > 0.01, mean within 15%): {'YES' if ok else 'NO'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

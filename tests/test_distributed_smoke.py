@@ -1,0 +1,94 @@
+"""2-process localhost smoke test for the DCN bring-up path
+(distribute.initialize_distributed — VERDICT r4 next-9: it had no test,
+"if multi-host ever matters it will fail on first contact").
+
+Two subprocesses form a real jax.distributed cluster over a localhost
+coordinator (DCN stand-in), each contributing one virtual CPU device;
+they build the global 2-device chains mesh, run a psum over it, and
+process 0 asserts the collective saw both processes' contributions.
+This exercises coordinator handshake, cross-process device visibility,
+and a multi-process collective — everything the single-process virtual
+mesh tests cannot."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+# one virtual CPU device per process BEFORE jax import; the cluster mesh
+# then has 2 global devices, 1 local to each process
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+coord, pid = sys.argv[1], int(sys.argv[2])
+
+sys.path.insert(0, %(repo)r)
+from flipcomplexityempirical_tpu.distribute import initialize_distributed
+initialize_distributed(coordinator=coord, num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 2, jax.devices()
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from flipcomplexityempirical_tpu.distribute import make_mesh
+
+mesh = make_mesh(2)
+sharding = NamedSharding(mesh, P("chains"))
+
+@jax.jit
+def total(x):
+    return jnp.sum(x)
+
+# each process owns one shard of the global (2,) array, value = pid + 1
+local = np.asarray([float(pid + 1)])
+garr = jax.make_array_from_single_device_arrays(
+    (2,), sharding, [jax.device_put(local, jax.local_devices()[0])])
+out = total(garr)
+# the jitted global sum must see both shards: 1 + 2
+assert float(out) == 3.0, float(out)
+print(f"proc{pid} OK", flush=True)
+jax.distributed.shutdown()
+"""
+
+
+def _attempt(script, env):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    procs = [subprocess.Popen([sys.executable, "-c", script, coord,
+                               str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=env, text=True)
+             for pid in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    return procs, outs
+
+
+@pytest.mark.slow
+def test_two_process_dcn_bringup_and_collective():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _WORKER % {"repo": repo}
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs, outs = _attempt(script, env)
+    if any(p.returncode for p in procs):
+        # the bind-probe-then-release port pick has a TOCTOU window:
+        # another process can grab the port before the coordinator
+        # binds it; one retry on a fresh port removes the flake
+        procs, outs = _attempt(script, env)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc{pid} failed:\n{out}"
+        assert f"proc{pid} OK" in out

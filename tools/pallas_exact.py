@@ -26,7 +26,9 @@ def main():
     import flipcomplexityempirical_tpu as fce
 
     dev = jax.devices()[0]
-    h, w, chains, steps = 8, 16, 8, 41
+    # chains = block_chains = 128: the bench-proven Mosaic block shape
+    # (a tiny block can violate TPU sublane tiling); n = 8*16 = 128 lanes
+    h, w, chains, steps = 8, 16, 128, 41
     g = fce.graphs.square_grid(h, w)
     plan = fce.graphs.stripes_plan(g, 2)
     spec = fce.Spec(contiguity="patch")
