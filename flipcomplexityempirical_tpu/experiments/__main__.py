@@ -32,6 +32,11 @@ def main():
                          "from the last saved segment")
     ap.add_argument("--only", nargs="*", default=None,
                     help="config tags to run, e.g. 2B30P10")
+    ap.add_argument("--dual-source", choices=["quads", "voronoi"],
+                    default="quads",
+                    help="dual family geometry: jittered-quad lattice or "
+                         "irregular Voronoi cells (realistic topology); "
+                         "ignored by other families")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (jax.config, which works "
                          "even where JAX_PLATFORMS env is pre-pinned)")
@@ -50,10 +55,13 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     sweep = SWEEPS[args.family]
-    configs = list(sweep(total_steps=args.steps, n_chains=args.chains,
-                         backend=args.backend, contiguity=args.contiguity,
-                         seed=args.seed, record_every=args.record_every,
-                         checkpoint_every=args.checkpoint_every))
+    overrides = dict(total_steps=args.steps, n_chains=args.chains,
+                     backend=args.backend, contiguity=args.contiguity,
+                     seed=args.seed, record_every=args.record_every,
+                     checkpoint_every=args.checkpoint_every)
+    if args.family == "dual":
+        overrides["dual_source"] = args.dual_source
+    configs = list(sweep(**overrides))
     if args.only:
         configs = [c for c in configs if c.tag in set(args.only)]
     run_sweep(configs, args.out, checkpoint_dir=args.checkpoint_dir)

@@ -51,6 +51,8 @@ class ExperimentConfig:
     swap_every: int = 0       # temper: transitions between swap rounds
     dual_nx: int = 12         # dual: synthetic-precinct state is nx x ny
     dual_ny: int = 12
+    dual_source: str = "quads"  # dual: 'quads' (jittered lattice) |
+                                # 'voronoi' (irregular-degree cells)
     record_every: int = 1     # history thinning through the runners
 
     @property
@@ -64,6 +66,9 @@ class ExperimentConfig:
         # widened families prefix the family (artifact filenames and
         # checkpoint keys must not collide when sweeps share an output
         # or checkpoint directory) and their sweep-varying parameters
+        if self.family == "dual" and self.dual_source != "quads":
+            return (f"{self.family}-{self.dual_source[:3].upper()}-"
+                    f"K{self.n_districts}-{core}")
         if self.family in ("kpair", "dual"):
             return f"{self.family}-K{self.n_districts}-{core}"
         if self.family == "temper":

@@ -28,7 +28,7 @@ from .. import compat
 from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
                       square_grid, triangular_lattice, hex_lattice,
                       stripes_plan, from_geojson, synthetic_precincts,
-                      seed_votes, PARITY_LABELS)
+                      voronoi_precincts, seed_votes, PARITY_LABELS)
 from ..stats import partisan, polsby_popper
 from ..kernel import board as kboard
 from ..kernel.step import Spec, finalize_host
@@ -60,9 +60,15 @@ def build_graph_and_plan(cfg: ExperimentConfig):
         g = hex_lattice(cfg.lattice_m, cfg.lattice_n)
         plan = stripes_plan(g, 2, axis=cfg.alignment)
     elif cfg.family == "dual":
-        g, geo = from_geojson(
-            synthetic_precincts(cfg.dual_nx, cfg.dual_ny, seed=cfg.seed),
-            pop_property="POP")
+        if cfg.dual_source == "voronoi":
+            fc = voronoi_precincts(cfg.dual_nx * cfg.dual_ny,
+                                   seed=cfg.seed)
+        elif cfg.dual_source == "quads":
+            fc = synthetic_precincts(cfg.dual_nx, cfg.dual_ny,
+                                     seed=cfg.seed)
+        else:
+            raise ValueError(f"dual_source {cfg.dual_source!r}")
+        g, geo = from_geojson(fc, pop_property="POP")
         plan = stripes_plan(g, cfg.n_districts, axis=cfg.alignment)
     else:
         raise ValueError(f"family {cfg.family!r}")
@@ -614,7 +620,11 @@ def _ckpt_identity(cfg: ExperimentConfig) -> str:
             f"accept={cfg.accept}|base={cfg.base!r}|pop={cfg.pop_tol!r}|"
             f"kp={cfg.propose_parallel}|k={cfg.n_districts}|"
             f"grid={cfg.grid}|lat={cfg.lattice_m}x{cfg.lattice_n}|"
-            f"dual={cfg.dual_nx}x{cfg.dual_ny}|re={cfg.record_every}|"
+            # '@source' only for non-default geometry: keeps every
+            # checkpoint written before dual_source existed valid
+            f"dual={cfg.dual_nx}x{cfg.dual_ny}"
+            f"{'' if cfg.dual_source == 'quads' else '@' + cfg.dual_source}|"
+            f"re={cfg.record_every}|"
             f"betas={tuple(map(float, cfg.betas))!r}|"
             f"se={cfg.swap_every}")
 

@@ -193,3 +193,25 @@ def test_board_family_checkpoint_resume_bit_identical(tmp_path):
     np.testing.assert_array_equal(clean["part_sum"], resumed["part_sum"])
     np.testing.assert_array_equal(clean["cut_times"],
                                   resumed["cut_times"])
+
+
+def test_dual_voronoi_family_end_to_end(tmp_path):
+    """The dual family on the irregular Voronoi geometry
+    (--dual-source voronoi): distinct tag namespace, irregular degrees,
+    same artifact manifest + compactness scoring + contiguity/population
+    invariants as the quad state."""
+    cfg = ex.ExperimentConfig(family="dual", alignment=0, base=2.6,
+                              pop_tol=0.3, n_districts=4, dual_nx=7,
+                              dual_ny=7, dual_source="voronoi",
+                              total_steps=300, n_chains=3)
+    assert cfg.tag.startswith("dual-VOR-K4-")
+    out = str(tmp_path)
+    data = ex.run_config(cfg, out)
+    _assert_artifacts(cfg, out)
+    g, plan, geo = drv.build_graph_and_plan(cfg)
+    assert g.n_nodes == 49
+    assert g.deg.max() > 4  # genuinely irregular topology
+    for c in range(cfg.n_chains):
+        _districts_connected(g, data["assignments"][c], 4)
+    pp = data["polsby_popper"]
+    assert np.isfinite(pp).all() and (pp > 0).all() and (pp <= 1).all()
