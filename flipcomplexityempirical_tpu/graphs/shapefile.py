@@ -45,8 +45,19 @@ def _read_dbf(path: str) -> list:
     fields come back str, numeric fields int/float, blanks None."""
     with open(path, "rb") as f:
         buf = f.read()
+    if len(buf) < 33:
+        raise ValueError(f"{path}: truncated dBase file ({len(buf)} bytes)")
     n_rec = struct.unpack_from("<I", buf, 4)[0]
     hdr_size, rec_size = struct.unpack_from("<HH", buf, 8)
+    if hdr_size > len(buf) or hdr_size < 33:
+        raise ValueError(f"{path}: dBase header size {hdr_size} "
+                         f"inconsistent with file length {len(buf)}")
+    if rec_size < 1:  # spec minimum: the deletion flag byte
+        raise ValueError(f"{path}: dBase record size {rec_size} corrupt")
+    if hdr_size + n_rec * rec_size > len(buf) + 1:  # +1: some writers
+        raise ValueError(                           # omit the 0x1A EOF
+            f"{path}: dBase table truncated ({n_rec} records of "
+            f"{rec_size} bytes declared, {len(buf) - hdr_size} present)")
     fields = []
     off = 32
     while off < hdr_size - 1 and buf[off] != 0x0D:
@@ -97,10 +108,17 @@ def _read_shp(path: str) -> list:
     what from_geojson._rings iterates). Null shapes come back None."""
     with open(path, "rb") as f:
         buf = f.read()
+    if len(buf) < 100:
+        raise ValueError(f"{path}: truncated shapefile "
+                         f"({len(buf)} bytes < 100-byte header)")
     file_code, = struct.unpack_from(">i", buf, 0)
     if file_code != 9994:
         raise ValueError(f"{path}: not a shapefile (file code {file_code})")
     file_len_words, = struct.unpack_from(">i", buf, 24)
+    if 2 * file_len_words > len(buf):
+        raise ValueError(
+            f"{path}: truncated shapefile (header declares "
+            f"{2 * file_len_words} bytes, {len(buf)} present)")
     version, global_type = struct.unpack_from("<ii", buf, 28)
     if version != 1000:
         raise ValueError(f"{path}: unsupported shapefile version {version}")
@@ -115,6 +133,11 @@ def _read_shp(path: str) -> list:
         _rec_no, content_words = struct.unpack_from(">ii", buf, pos)
         pos += 8
         rec_end = pos + 2 * content_words
+        if rec_end > len(buf) or content_words < 2:
+            raise ValueError(
+                f"{path}: truncated or corrupt record at byte {pos - 8} "
+                f"(content length {content_words} words, file "
+                f"{len(buf)} bytes)")
         stype, = struct.unpack_from("<i", buf, pos)
         if stype == SHAPE_NULL:
             geoms.append(None)

@@ -259,3 +259,51 @@ def test_shapefile_bool_and_deleted_rows():
         g3, _ = graphs.from_geojson(fc3, pop_property="POP",
                                     name_property="NAME")
         assert g3.n_nodes == 9
+
+
+def test_shapefile_truncated_files_fail_loudly():
+    """Truncated .shp/.dbf must raise a clear ValueError naming the file,
+    not a cryptic struct/index error from parser internals."""
+    import tempfile, os
+    fc = graphs.voronoi_precincts(12, seed=1)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "s")
+        graphs.write_shapefile(p, fc)
+        shp = open(p + ".shp", "rb").read()
+        dbf = open(p + ".dbf", "rb").read()
+        for ext, full, cut in ((".shp", shp, 50), (".shp", shp, 150),
+                               (".dbf", dbf, 20), (".dbf", dbf, 40)):
+            with open(p + ext, "wb") as f:
+                f.write(full[:cut])
+            with pytest.raises(ValueError, match="truncated|inconsistent"):
+                graphs.read_shapefile(p)
+            with open(p + ext, "wb") as f:
+                f.write(full)
+        # cut exactly at a record boundary: the header's declared
+        # length must catch it (review finding: the per-record guard
+        # alone lets this silently return a prefix of the features)
+        import struct
+        pos, cuts = 100, []
+        while pos + 8 <= len(shp):
+            _, cw = struct.unpack_from(">ii", shp, pos)
+            pos += 8 + 2 * cw
+            cuts.append(pos)
+        with open(p + ".shp", "wb") as f:
+            f.write(shp[:cuts[2]])
+        with pytest.raises(ValueError, match="truncated"):
+            graphs.read_shapefile(p)
+        with open(p + ".shp", "wb") as f:
+            f.write(shp)
+        # corrupt dbf header fabricating records: rec_size=0 must be
+        # refused, not loop n_rec times over an unmoving cursor
+        bad = bytearray(dbf)
+        struct.pack_into("<I", bad, 4, 10**6)
+        struct.pack_into("<H", bad, 10, 0)
+        with open(p + ".dbf", "wb") as f:
+            f.write(bytes(bad))
+        with pytest.raises(ValueError, match="corrupt"):
+            graphs.read_shapefile(p)
+        with open(p + ".dbf", "wb") as f:
+            f.write(dbf)
+        # intact again after restores
+        assert len(graphs.read_shapefile(p)["features"]) == 12
