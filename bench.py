@@ -58,6 +58,12 @@ def main():
                     help="route through the Pallas VMEM-resident kernel "
                          "(kernel/pallas_board.py) instead of the XLA "
                          "board path")
+    ap.add_argument("--body", choices=["int8", "bits"], default=None,
+                    help="force ONE board body instead of timing both "
+                         "and reporting the faster (for per-body "
+                         "records, e.g. the v4-vs-v5 on-chip comparison); "
+                         "board path only, incompatible with "
+                         "--pallas/--general")
     ap.add_argument("--block-chains", type=int, default=128)
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="wrap the timed region in a jax.profiler trace "
@@ -141,7 +147,16 @@ def main():
                     parity_metrics=True, geom_waits=True,
                     record_interface=False)
 
+    if args.body is not None and (args.pallas or args.general):
+        print("bench: --body selects a board-path body; it cannot be "
+              "combined with --pallas or --general", file=sys.stderr)
+        sys.exit(2)
+
     use_board = kboard.supports(g, spec) and not args.general
+    if args.body is not None and not use_board:
+        print("bench: --body given but the board path does not support "
+              "this workload", file=sys.stderr)
+        sys.exit(2)
     variants = [None]
     if use_board:
         bg, states, params = fce.sampling.init_board(
@@ -156,7 +171,13 @@ def main():
                     block_chains=args.block_chains)
         else:
             from flipcomplexityempirical_tpu.kernel import bitboard
-            if bitboard.supported(bg, spec):
+            if args.body is not None:
+                if args.body == "bits" and not bitboard.supported(bg, spec):
+                    print("bench: --body bits unsupported for this "
+                          "workload", file=sys.stderr)
+                    sys.exit(2)
+                variants = [args.body == "bits"]
+            elif bitboard.supported(bg, spec):
                 # the bit-board and int8 bodies are bit-identical; time
                 # BOTH and report the faster (which body wins is a pure
                 # hardware/compiler question the benchmark answers)
@@ -223,6 +244,7 @@ def main():
                  else "board" if use_board else "general"),
         "chains": args.chains,
         "steps": args.steps,
+        "chunk": args.chunk,
         "grid": args.grid,
         "seconds": round(dt, 3),
         "repeats": max(repeats, 1),
@@ -232,7 +254,8 @@ def main():
         "accept_rate": float(np.asarray(s.accept_count).mean()
                              / (args.steps - 1)),
     }
-    if len(variants) > 1:
+    if use_board and not args.pallas and (len(variants) > 1
+                                          or args.body is not None):
         meta["body"] = "bitboard" if best else "int8"
 
     if args.ess:

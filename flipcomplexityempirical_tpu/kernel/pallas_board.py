@@ -21,8 +21,11 @@ Design (per grid step = one chain block):
 - per-chain gathers (district / degree / diff-degree at the selected
   node) become ONE masked reduction of a packed code plane
   (board*64 + deg*8 + diff_deg);
-- cut_times accumulates into int16 output refs (the runner folds them
-  into the int32 state, as the XLA chunk runner does);
+- cut_times accumulates into int32 output refs (the runner folds them
+  into the int32 state, as the XLA chunk runner does); every VMEM plane
+  is int32 — this toolchain's Mosaic rejects sub-32-bit rotates and
+  truncating vector stores, so i8/i32 conversion happens at the
+  pallas_call boundary;
 - the flip-bookkeeping log (pointer, sign) writes one (BC,) row per step;
   ``kernel.board.apply_flip_log`` replays it outside, unchanged.
 
@@ -85,8 +88,14 @@ def _masks(h: int, w: int):
 
 
 def _u01(bits):
-    """uint32 -> f32 uniform in (0, 1): 24-bit mantissa, never 0."""
-    return (jnp.right_shift(bits, jnp.uint32(8)).astype(jnp.float32)
+    """uint32 -> f32 uniform in (0, 1): 24-bit mantissa, never 0.
+
+    The top 24 bits fit int32 exactly (sign bit clear), and Mosaic has
+    no u32->f32 cast or u32->i32 convert, so the float conversion
+    bitcasts to int32 first.
+    """
+    shifted = jnp.right_shift(bits, jnp.uint32(8))
+    return (pltpu.bitcast(shifted, jnp.int32).astype(jnp.float32)
             + 1.0) * jnp.float32(1.0 / 16777218.0)
 
 
@@ -100,19 +109,22 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
             board_out, dist_pop_out, scal_out, ints_out,
             log_f_ref, log_s_ref,
             hist_cut_ref, hist_b_ref, hist_wait_ref, hist_acc_ref,
-            cut_e16_ref, cut_s16_ref):
+            cut_e_acc_ref, cut_s_acc_ref):
     n = h * w
     bc = board_in.shape[0]
     f32 = jnp.float32
 
     if not host_rng:
-        pltpu.prng_seed(seed_ref[0])
+        pltpu.prng_seed(seed_ref[pl.program_id(0)])
 
+    # every plane is int32 in VMEM: Mosaic (this toolchain) rejects
+    # sub-32-bit rotates, truncating stores, and u32 argmax/casts; the
+    # runner-side i8/i32 conversions happen outside the kernel
     board_out[:] = board_in[:]
-    cut_e16_ref[:] = jnp.zeros_like(cut_e16_ref)
-    cut_s16_ref[:] = jnp.zeros_like(cut_s16_ref)
+    cut_e_acc_ref[:] = jnp.zeros_like(cut_e_acc_ref)
+    cut_s_acc_ref[:] = jnp.zeros_like(cut_s_acc_ref)
 
-    m_e = mask_refs[0][:]      # (1, N) int8 each
+    m_e = mask_refs[0][:]      # (1, N) int32 each
     m_w = mask_refs[1][:]
     m_s = mask_refs[2][:]
     m_n = mask_refs[3][:]
@@ -136,14 +148,15 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
     def step(t, carry):
         (dp0, dp1, cur_wait, pending, cur_flip, cur_sign, tyield,
          move_clock, acc_cnt, exh_cnt, waits_sum) = carry
-        board = board_out[:]                    # (BC, N) int8
-        b32 = board.astype(jnp.int32)
+        board = board_out[:]                    # (BC, N) int32
+        b32 = board
 
         def rolled_same(shift, mask):
-            # value[i] = board[i + shift]  (pltpu.roll needs shift >= 0)
-            return jnp.where(
-                mask != 0,
-                (pltpu.roll(board, (-shift) % n, 1) == board), False)
+            # value[i] = board[i + shift]  (pltpu.roll needs shift >= 0);
+            # rolls run on the i32 copy (no sub-32-bit rotate in Mosaic)
+            # and the mask applies as boolean AND (a where() with a bool
+            # scalar branch lowers to an unsupported i8->i1 truncation)
+            return (mask != 0) & (pltpu.roll(b32, (-shift) % n, 1) == b32)
 
         s_e = rolled_same(1, m_e)
         s_w = rolled_same(-1, m_w)
@@ -157,8 +170,8 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
         same_deg = (s_e.astype(jnp.int32) + s_w + s_s + s_n)
         diff_deg = deg - same_deg
         b_mask = diff_deg > 0
-        cut_e = jnp.where(m_e != 0, ~s_e, False)
-        cut_s = jnp.where(m_s != 0, ~s_s, False)
+        cut_e = (m_e != 0) & ~s_e
+        cut_s = (m_s != 0) & ~s_s
 
         if spec.contiguity == "patch":
             # ring criterion: rook runs not linked through their diagonal
@@ -183,10 +196,10 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
 
         # ---- complete the pending wait from this state's boundary count
         if host_rng:
-            u_wait = _u01(bits_scal_ref[t, 0])
+            u_wait = _u01(bits_scal_ref[t, 0:1])[0]
         else:
             u_wait = _u01(pltpu.bitcast(
-                pltpu.prng_random_bits((1, bc)), jnp.uint32)[0])
+                pltpu.prng_random_bits((1, bc)), jnp.uint32))[0]
         if spec.geom_waits:
             p = b_count.astype(f32) / denom
             wnew = jnp.maximum(
@@ -201,8 +214,8 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
         hist_acc_ref[t, :] = acc_cnt
         log_f_ref[t, :] = cur_flip
         log_s_ref[t, :] = cur_sign
-        cut_e16_ref[:] = cut_e16_ref[:] + cut_e.astype(jnp.int16)
-        cut_s16_ref[:] = cut_s16_ref[:] + cut_s.astype(jnp.int16)
+        cut_e_acc_ref[:] = cut_e_acc_ref[:] + cut_e.astype(jnp.int32)
+        cut_s_acc_ref[:] = cut_s_acc_ref[:] + cut_s.astype(jnp.int32)
         waits_sum = waits_sum + cur_wait
         tyield = tyield + 1
 
@@ -214,8 +227,14 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
                 pltpu.prng_random_bits((bc, n)), jnp.uint32)
         score = jnp.where(valid, jnp.bitwise_or(sel_bits, jnp.uint32(1)),
                           jnp.uint32(0))
-        idx = jnp.argmax(score, axis=1).astype(jnp.int32)
-        any_valid = score.max(axis=1) > 0
+        # Mosaic has no uint32 argmax/max: XOR the sign bit to map uint32
+        # order onto int32 order, then argmax = max + first-index-of-max
+        # as two int32 reductions (same first-occurrence index).
+        s32 = pltpu.bitcast(score ^ jnp.uint32(0x80000000), jnp.int32)
+        smax = jnp.max(s32, axis=1)
+        idx = jnp.min(jnp.where(s32 == smax[:, None], iota_n, n),
+                      axis=1).astype(jnp.int32)
+        any_valid = smax > jnp.int32(-(2 ** 31))
 
         sel = iota_n == idx[:, None]
         codes = code_plane + b32 * 64 + diff_deg
@@ -227,10 +246,10 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
         dcut = deg_at - 2 * dd_at
 
         if host_rng:
-            u_acc = _u01(bits_scal_ref[t, 1])
+            u_acc = _u01(bits_scal_ref[t, 1:2])[0]
         else:
             u_acc = _u01(pltpu.bitcast(
-                pltpu.prng_random_bits((1, bc)), jnp.uint32)[0])
+                pltpu.prng_random_bits((1, bc)), jnp.uint32))[0]
         log_bound = (-beta * dcut.astype(f32) * log_base)
         logu = jnp.log(jnp.maximum(u_acc, f32(1e-12)))
         accept = any_valid & (logu < log_bound)
@@ -283,8 +302,6 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
     """One chunk: t_inner yields + transitions for all chains, blocked
     over ``block_chains``-sized groups. Returns the kernel outputs; the
     runner stitches them back into a BoardState."""
-    if t_inner > 32767:
-        raise ValueError("t_inner must be <= 32767 (int16 cut planes)")
     c, n = board.shape
     bc = block_chains
     nb = c // bc
@@ -302,7 +319,9 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
                             lambda b: (0, b, *([0] * (len(shape) - 2))))
 
     in_specs = [
-        pl.BlockSpec((1,), lambda b: (b,), memory_space=pltpu.SMEM),  # seed
+        # whole seeds vector in SMEM for every block (TPU rank-1 blocks
+        # must cover the array); the kernel indexes it by program_id
+        pl.BlockSpec((nb,), lambda b: (0,), memory_space=pltpu.SMEM),
         cdim(board.shape),                       # board
         rep(pop_plane.shape),                    # pop (1, N)
         rep(deg_plane.shape),                    # deg (1, N)
@@ -316,7 +335,7 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
          else rep((1, 1))),                      # bits scal (T, 2, C)
     ]
     out_shape = (
-        jax.ShapeDtypeStruct((c, n), jnp.int8),          # board
+        jax.ShapeDtypeStruct((c, n), jnp.int32),         # board
         jax.ShapeDtypeStruct((2, c), jnp.int32),         # dist_pop
         jax.ShapeDtypeStruct((2, c), jnp.float32),       # scalars out
         jax.ShapeDtypeStruct((7, c), jnp.int32),         # counters out
@@ -326,8 +345,8 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
         jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # hist b
         jax.ShapeDtypeStruct((t_inner, c), jnp.float32),  # hist wait
         jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # hist accepts
-        jax.ShapeDtypeStruct((c, n), jnp.int16),         # cut_e16
-        jax.ShapeDtypeStruct((c, n), jnp.int16),         # cut_s16
+        jax.ShapeDtypeStruct((c, n), jnp.int32),         # cut_e acc
+        jax.ShapeDtypeStruct((c, n), jnp.int32),         # cut_s acc
     )
     out_specs = (
         cdim((c, n)),
@@ -357,18 +376,20 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
                 dist_pop_in, scal_in_ref, ints_in_ref, bp_ref, bs_ref,
                 *outs)
 
-    return pl.pallas_call(
+    outs = pl.pallas_call(
         kern, grid=grid, in_specs=in_specs, out_specs=out_specs,
         out_shape=out_shape, interpret=interpret,
-    )(seeds, board, pop_plane, deg_plane, *masks8, dist_pop, scal_in,
-      ints_in, bits_plane, bits_scal)
+    )(seeds, board.astype(jnp.int32), pop_plane, deg_plane, *masks8,
+      dist_pop, scal_in, ints_in, bits_plane, bits_scal)
+    # back to the BoardState dtype outside the kernel
+    return (outs[0].astype(jnp.int8),) + tuple(outs[1:])
 
 
 def make_static_inputs(bg: BoardGraph):
     h, w = bg.h, bg.w
     masks = _masks(h, w)
     order = ("e", "w", "s", "n", "se", "sw", "ne", "nw")
-    masks8 = tuple(jnp.asarray(masks[k][None, :], jnp.int8) for k in order)
+    masks8 = tuple(jnp.asarray(masks[k][None, :], jnp.int32) for k in order)
     pop_plane = jnp.asarray(np.asarray(bg.pop)[None, :], jnp.int32)
     deg_plane = jnp.asarray(np.asarray(bg.deg)[None, :], jnp.int32)
     return pop_plane, deg_plane, masks8
@@ -401,7 +422,7 @@ def unpack_state(state: BoardState, bg, outs, t_inner: int) -> BoardState:
     """Merge kernel outputs back into a BoardState (tries_sum counts one
     draw per yield, as the board path does)."""
     (board, dist_pop, scal, ints, log_f, log_s, h_cut, h_b, h_wait, h_acc,
-     cut_e16, cut_s16) = outs
+     cut_e_acc, cut_s_acc) = outs
     return state.replace(
         board=board,
         dist_pop=jnp.stack([dist_pop[0], dist_pop[1]], axis=1),
@@ -418,8 +439,8 @@ def unpack_state(state: BoardState, bg, outs, t_inner: int) -> BoardState:
         exhausted_count=ints[6],
         waits_sum=state.waits_sum + scal[1],
         tries_sum=state.tries_sum + t_inner,
-        cut_times_e=state.cut_times_e + cut_e16,
-        cut_times_s=state.cut_times_s + cut_s16,
+        cut_times_e=state.cut_times_e + cut_e_acc,
+        cut_times_s=state.cut_times_s + cut_s_acc,
     )
 
 

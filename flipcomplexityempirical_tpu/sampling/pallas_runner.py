@@ -2,7 +2,7 @@
 
 Same contract as ``board_runner.run_board`` (RunResult, history keys, f64
 wait accumulation, record-final epilogue); per chunk the kernel returns
-its flip log and int16 cut planes, and the shared XLA pieces
+its flip log and int32 cut planes, and the shared XLA pieces
 (``kernel.board.apply_flip_log``, ``kernel.board.record_final``) finish
 the bookkeeping. On TPU the kernel draws its own random bits
 (``pltpu.prng_*``), seeded per (block, chunk) from the run seed — an

@@ -15,9 +15,12 @@ Exit 0 on exact match, 1 on mismatch, 2 on error/unsupported.
 """
 
 import json
+import os
 import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
