@@ -672,7 +672,8 @@ def _transition_pair(bg: BoardGraph, spec: Spec, params: StepParams,
 # Deferred flip bookkeeping: log -> (part_sum, last_flipped, num_flips)
 # ---------------------------------------------------------------------------
 
-def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0):
+def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0,
+                   slice_bytes=4 << 30):
     """Replay the reference's per-yield flip bookkeeping
     (grid_chain_sec11.py:396-400) from a chunk's (T, C) log with
     order-independent dense algebra. ``t0[c]`` is the absolute yield index
@@ -709,9 +710,29 @@ def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0):
 
     Chunk boundaries compose exactly through the carried last_flipped
     (asserted by tests/test_board.py::test_apply_flip_log_chunked_composition).
+
+    The one-hot einsum operands scale as (C, T, 4*wf) f32 — 16.8 GB at
+    C=16384, T=500 — which OOMed 16G HBM in the round-5 chain sweep. The
+    replay is therefore applied over T-sub-slices (the exact chunk
+    composition above) sized to bound the stacked column operand near
+    ``slice_bytes`` (default 4 GB); at the benchmark shape (C=4096,
+    T=500) the bound is not hit and the replay stays a single einsum.
     """
     tlen, c = log_f.shape
     n = part_sum.shape[1]
+    wf = n if n < 128 else 128                           # full lane width
+    hf = -(-n // wf)
+    # bytes per log row across BOTH one-hot operands: a_ind (C, T, hf)
+    # and the 4-stream b_all (C, T, 4*wf), f32 each
+    row_bytes = c * (hf + 4 * wf) * 4
+    slice_t = max(16, min(tlen, slice_bytes // row_bytes))
+    if slice_t < tlen:
+        for a in range(0, tlen, slice_t):
+            part_sum, last_flipped, num_flips = apply_flip_log(
+                part_sum, last_flipped, num_flips,
+                log_f[a:a + slice_t], log_s[a:a + slice_t], t0 + a,
+                slice_bytes=slice_bytes)
+        return part_sum, last_flipped, num_flips
     if n * tlen >= 2 ** 31:
         raise ValueError(
             f"composite sort key n*chunk = {n}*{tlen} overflows int32; "
@@ -741,8 +762,6 @@ def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0):
     w_nf = act.astype(f32)
     w_lf = jnp.where(act & is_last, (t_rel + 1).astype(f32), 0.0)
 
-    wf = n if n < 128 else 128                           # full lane width
-    hf = -(-n // wf)
     fr = jnp.floor_divide(f_s, wf)                       # -1 matches no x
     fc = jnp.remainder(f_s, wf)
     a_ind = (fr[:, :, None] == jnp.arange(hf)[None, None, :]).astype(f32)

@@ -232,6 +232,24 @@ def test_apply_flip_log_chunked_composition(rng):
                                           np.asarray(w_arr))
 
 
+def test_apply_flip_log_auto_slicing(rng):
+    """The HBM-bounded internal T-slicing (slice_bytes) is the identity:
+    a budget that forces the minimum 16-row slices reproduces the
+    single-einsum replay exactly (board.py round-5 C=16384 OOM fix)."""
+    tlen, c, n = 50, 4, 10
+    log_f, log_s = _random_log(rng, tlen, c, n)
+    t0 = np.asarray([0, 3, 7, 100], np.int32)
+    ps0 = np.zeros((c, n), np.int32)
+    lf0 = np.zeros((c, n), np.int32)
+    nf0 = np.zeros((c, n), np.int32)
+    args = (jnp.asarray(ps0), jnp.asarray(lf0), jnp.asarray(nf0),
+            jnp.asarray(log_f), jnp.asarray(log_s), jnp.asarray(t0))
+    whole = kb.apply_flip_log(*args)
+    sliced = kb.apply_flip_log(*args, slice_bytes=1)
+    for w_arr, g_arr in zip(whole, sliced):
+        np.testing.assert_array_equal(np.asarray(g_arr), np.asarray(w_arr))
+
+
 # ---------------------------------------------------------------------------
 # 3. exact invariants of a run
 # ---------------------------------------------------------------------------
