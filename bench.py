@@ -50,6 +50,12 @@ def main():
                          "warmup and timed runs share one compiled kernel")
     ap.add_argument("--base", type=float, default=2.63815853)
     ap.add_argument("--pop-tol", type=float, default=0.1)
+    ap.add_argument("--k", type=int, default=2,
+                    help="number of districts; k=2 runs the headline "
+                         "2-district bi walk, k>2 switches to the "
+                         "k-district pair walk (BASELINE config 2) on a "
+                         "k-stripes initial plan; the metric name and "
+                         "vs_baseline keep their per-chip flip meaning")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     ap.add_argument("--general", action="store_true",
                     help="force the general (gather) path even when the "
@@ -141,8 +147,10 @@ def main():
     from flipcomplexityempirical_tpu.kernel import board as kboard
 
     g = fce.graphs.square_grid(args.grid, args.grid)
-    plan = fce.graphs.stripes_plan(g, 2)
-    spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+    plan = fce.graphs.stripes_plan(g, args.k)
+    spec = fce.Spec(n_districts=args.k,
+                    proposal=("bi" if args.k == 2 else "pair"),
+                    contiguity="patch",
                     invalid="repropose", accept="cut",
                     parity_metrics=True, geom_waits=True,
                     record_interface=False)
@@ -150,6 +158,11 @@ def main():
     if args.body is not None and (args.pallas or args.general):
         print("bench: --body selects a board-path body; it cannot be "
               "combined with --pallas or --general", file=sys.stderr)
+        sys.exit(2)
+    if args.pallas and args.k != 2:
+        print("bench: the pallas path serves the 2-district bi walk only "
+              "(kernel/pallas_board.py check()); drop --pallas or --k",
+              file=sys.stderr)
         sys.exit(2)
 
     use_board = kboard.supports(g, spec) and not args.general
@@ -171,13 +184,15 @@ def main():
                     block_chains=args.block_chains)
         else:
             from flipcomplexityempirical_tpu.kernel import bitboard
+            bits_ok = (bitboard.supported(bg, spec)
+                       or bitboard.supported_pair(bg, spec))
             if args.body is not None:
-                if args.body == "bits" and not bitboard.supported(bg, spec):
+                if args.body == "bits" and not bits_ok:
                     print("bench: --body bits unsupported for this "
                           "workload", file=sys.stderr)
                     sys.exit(2)
                 variants = [args.body == "bits"]
-            elif bitboard.supported(bg, spec):
+            elif bits_ok:
                 # the bit-board and int8 bodies are bit-identical; time
                 # BOTH and report the faster (which body wins is a pure
                 # hardware/compiler question the benchmark answers)
@@ -246,6 +261,7 @@ def main():
         "steps": args.steps,
         "chunk": args.chunk,
         "grid": args.grid,
+        "k": args.k,
         "seconds": round(dt, 3),
         "repeats": max(repeats, 1),
         "repeat_policy": "best",
@@ -285,7 +301,8 @@ def main():
 
     print(json.dumps(meta), file=sys.stderr)
     headline = {
-        "metric": "flips_per_sec_per_chip_64x64",
+        "metric": ("flips_per_sec_per_chip_64x64" if args.k == 2 else
+                   f"flips_per_sec_per_chip_64x64_pair_k{args.k}"),
         "value": round(fps, 1),
         "unit": "flips/s",
         # a host-CPU stand-in cannot be compared to the per-chip TPU

@@ -1,0 +1,38 @@
+# Shared helpers for the TPU capture scripts (tpu_capture.sh,
+# tpu_followup_r5.sh). Source from a script whose cwd is the repo root
+# and which has set TS.
+#
+# commit_retry FILE...   - git add+commit with retries (tunnel scripts
+#                          race the session's own commits)
+# run_bench NAME TMO ARGS... - run bench.py, validate the record, rename
+#                          cpu_fallback output to *.fallback (a host
+#                          number must never sit under an on-chip record
+#                          name), commit on success. Returns 1 on any
+#                          failure so callers can abort or continue.
+
+commit_retry() {
+  for _ in 1 2 3 4 5; do
+    git add "$@" && git commit -q -m "TPU capture: $(basename "$1")
+
+No-Verification-Needed: benchmark-record artifacts only" && return 0
+    sleep 7
+  done
+  return 1
+}
+
+run_bench() { # name timeout args...
+  local name=$1 tmo=$2; shift 2
+  local out="bench_runs/${TS}_${name}.json" err="bench_runs/${TS}_${name}.err"
+  timeout "$tmo" python bench.py "$@" >"$out" 2>"$err"
+  local rc=$?
+  if [ $rc -ne 0 ] || [ ! -s "$out" ]; then
+    echo "capture $name: rc=$rc, no record" >&2
+    return 1
+  fi
+  if grep -q cpu_fallback "$out"; then
+    mv "$out" "$out.fallback"
+    echo "capture $name: tunnel dropped (cpu_fallback)" >&2
+    return 1
+  fi
+  commit_retry "$out" "$err"
+}

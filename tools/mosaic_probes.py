@@ -1,0 +1,54 @@
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BC, N, T = 128, 128, 4
+
+
+def run(name, kern, out_shape, ins):
+    try:
+        r = pl.pallas_call(
+            kern,
+            in_specs=[pl.BlockSpec(a.shape, (lambda sh: (lambda: tuple([0] * len(sh))))(a.shape)) for a in ins],
+            out_specs=pl.BlockSpec(out_shape.shape, lambda: tuple([0] * len(out_shape.shape))),
+            out_shape=out_shape,
+        )(*ins)
+        jax.block_until_ready(r)
+        print(f"{name}: OK")
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__} {str(e).splitlines()[0][:100]}")
+
+
+x = jnp.ones((BC, N), jnp.int32)
+
+
+# A. prng plane in loop, reduce, carry int
+def kA(x_ref, o_ref):
+    pltpu.prng_seed(3)
+    def step(t, c):
+        bits = pltpu.bitcast(pltpu.prng_random_bits((BC, N)), jnp.uint32)
+        s32 = pltpu.bitcast(bits ^ jnp.uint32(0x80000000), jnp.int32)
+        return c + jnp.max(s32, axis=1)
+    out = jax.lax.fori_loop(0, T, step, jnp.zeros((BC,), jnp.int32))
+    o_ref[0, :] = out
+
+run("prng-plane-loop-carry", kA, jax.ShapeDtypeStruct((1, BC), jnp.int32), (x,))
+
+
+# B. same but any_valid bool astype counters (the exact stage-54 shape)
+def kB(x_ref, o_ref):
+    pltpu.prng_seed(3)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (BC, N), 1)
+    def step(t, c):
+        bits = pltpu.bitcast(pltpu.prng_random_bits((BC, N)), jnp.uint32)
+        valid = x_ref[:] > 0
+        score = jnp.where(valid, jnp.bitwise_or(bits, jnp.uint32(1)), jnp.uint32(0))
+        s32 = pltpu.bitcast(score ^ jnp.uint32(0x80000000), jnp.int32)
+        smax = jnp.max(s32, axis=1)
+        any_valid = smax > jnp.int32(-(2 ** 31))
+        return c + any_valid.astype(jnp.int32)
+    out = jax.lax.fori_loop(0, T, step, jnp.zeros((BC,), jnp.int32))
+    o_ref[0, :] = out
+
+run("score-anyvalid-loop", kB, jax.ShapeDtypeStruct((1, BC), jnp.int32), (x,))

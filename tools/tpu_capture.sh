@@ -2,37 +2,14 @@
 # One full on-chip capture set, priority-ordered (VERDICT r4 next-1/2/3).
 # Assumes the probe just succeeded. Each record is written to bench_runs/
 # and committed IMMEDIATELY so a tunnel drop mid-set loses nothing.
-# A record that comes back "cpu_fallback" is kept on disk (*.fallback)
-# but NOT committed and aborts the set — the tunnel dropped again.
+# A record that comes back "cpu_fallback" is quarantined on disk
+# (*.fallback) but NOT committed and aborts the set — the tunnel dropped
+# again. Helpers are shared with tpu_followup_r5.sh via bench_lib.sh.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p bench_runs
 TS=$(date -u +%Y%m%dT%H%M%SZ)
-
-commit_retry() {
-  for _ in 1 2 3 4 5; do
-    git add "$@" && git commit -q -m "TPU watchdog: capture $(basename "$1")" && return 0
-    sleep 7
-  done
-  return 1
-}
-
-run_bench() { # name timeout args...
-  local name=$1 tmo=$2; shift 2
-  local out="bench_runs/${TS}_${name}.json" err="bench_runs/${TS}_${name}.err"
-  timeout "$tmo" python bench.py "$@" >"$out" 2>"$err"
-  local rc=$?
-  if [ $rc -ne 0 ] || [ ! -s "$out" ]; then
-    echo "capture $name: rc=$rc, aborting set" >&2
-    return 1
-  fi
-  if grep -q cpu_fallback "$out"; then
-    mv "$out" "$out.fallback"
-    echo "capture $name: tunnel dropped (cpu_fallback), aborting set" >&2
-    return 1
-  fi
-  commit_retry "$out" "$err"
-}
+. tools/bench_lib.sh
 
 # 1. THE scoreboard record: default board bench, both bodies
 run_bench default 900 || exit 1

@@ -1,34 +1,14 @@
 #!/bin/bash
 # Round-5 follow-up capture set, for the NEXT tunnel window. The primary
 # records (default/sweep/ess/general) are already committed; this runs
-# what the first window could not finish, in priority order. Each record
-# commits as it lands (same policy as tpu_capture.sh).
+# what the first window could not finish, in priority order. Helpers
+# (record validation, fallback quarantine, commit-per-record) are shared
+# with tpu_capture.sh via bench_lib.sh.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p bench_runs
 TS=$(date -u +%Y%m%dT%H%M%SZ)
-
-commit_retry() {
-  for _ in 1 2 3 4 5; do
-    git add "$@" && git commit -q -m "TPU follow-up: $(basename "$1")
-
-No-Verification-Needed: benchmark-record artifacts only" && return 0
-    sleep 7
-  done
-  return 1
-}
-
-run_bench() { # name timeout args...
-  local name=$1 tmo=$2; shift 2
-  local out="bench_runs/${TS}_${name}.json" err="bench_runs/${TS}_${name}.err"
-  timeout "$tmo" python bench.py "$@" >"$out" 2>"$err"
-  local rc=$?
-  if [ $rc -ne 0 ] || [ ! -s "$out" ] || grep -q cpu_fallback "$out"; then
-    echo "followup $name: rc=$rc or fallback; keeping evidence uncommitted" >&2
-    return 1
-  fi
-  commit_retry "$out" "$err"
-}
+. tools/bench_lib.sh
 
 # 1. C=16384 at the default chunk=500 - the flip-log slicing fix should
 #    now fit 16G HBM; compare against the committed chunk=250 record
@@ -37,12 +17,15 @@ run_bench c16384_chunk500 1800 --chains 16384
 run_bench body_int8 900 --body int8
 # 3. C=8192 epilogue amortization at chunk=1000
 run_bench c8192_chunk1000 1200 --chains 8192 --chunk 1000 --warmup 1001
-# 4. Mosaic probes the first window could not finish (prng-in-loop)
-timeout 600 python /tmp/probe4.py >"bench_runs/${TS}_probe4.txt" 2>&1
-# 5. Pallas compile retry + exactness (expected: Mosaic SIGABRT; any
+# 4. k-district pair walk on-chip records (BASELINE config 2)
+run_bench pair_k4 900 --k 4
+run_bench pair_k8 900 --k 8
+# 5. Mosaic probes the first window could not finish (prng-in-loop)
+timeout 600 python tools/mosaic_probes.py >"bench_runs/${TS}_probes.txt" 2>&1
+# 6. Pallas compile retry + exactness (expected: Mosaic SIGABRT; any
 #    change in outcome is news)
 timeout 600 python tools/pallas_exact.py \
   >"bench_runs/${TS}_pallas_exact.json" 2>"bench_runs/${TS}_pallas_exact.err"
-commit_retry "bench_runs/${TS}_probe4.txt" \
+commit_retry "bench_runs/${TS}_probes.txt" \
   "bench_runs/${TS}_pallas_exact.json" "bench_runs/${TS}_pallas_exact.err" || true
 echo "follow-up set done: ${TS}"
