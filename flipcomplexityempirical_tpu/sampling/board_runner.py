@@ -59,19 +59,25 @@ def _sum_pending(waits_total, pending_waits):
 
 def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
                        pending_waits, record_history, n_steps,
-                       record_every: int = 1) -> RunResult:
+                       record_every: int = 1,
+                       history_device: bool = False) -> RunResult:
     """Shared run epilogue for the board-path runners: record the final
     yield (no trailing transition), drain waits, assemble the RunResult.
     Under thinning the final yield joins the history only when it lands
-    on the record grid (its wait/bookkeeping effects apply regardless)."""
+    on the record grid (its wait/bookkeeping effects apply regardless).
+    ``history_device=True`` keeps the history as device arrays (for
+    device-side diagnostics, stats.ess_device) instead of copying each
+    chunk to host."""
     state, out_last = kboard.record_final(bg, spec, params, state)
     if record_history and (n_steps - 1) % record_every == 0:
-        out_last = jax.tree.map(np.asarray, out_last)
+        if not history_device:
+            out_last = jax.tree.map(np.asarray, out_last)
         for k, v in out_last.items():
             hist_parts.setdefault(k, []).append(v[:, None])
     state = drain_waits(state, pending_waits)
     waits_total = _sum_pending(waits_total, pending_waits)
-    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+    xp = jnp if history_device else np
+    history = ({k: xp.concatenate(v, axis=1) for k, v in hist_parts.items()}
                if record_history else {})
     return RunResult(state=state, history=history,
                      waits_total=waits_total, n_yields=n_steps)
@@ -83,13 +89,16 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                       record_history: bool = True,
                       chunk: Optional[int] = None,
                       bits: Optional[bool] = None,
-                      record_every: int = 1) -> RunResult:
+                      record_every: int = 1,
+                      history_device: bool = False) -> RunResult:
     """Advance ``n_transitions`` transitions, recording the same number of
     yields (each BEFORE its transition) — and NO trailing record, so
     segments compose without duplicate boundary yields: a full run is
     segments summing to n_steps - 1 transitions plus one
     ``kboard.record_final``. ``run_board`` is exactly that composition;
-    the experiment driver checkpoints between segments."""
+    the experiment driver checkpoints between segments.
+    ``history_device=True`` skips the per-chunk host copy and returns the
+    history as device arrays (costs (C, T_recorded) HBM per key)."""
     if record_every < 1:
         raise ValueError(f"record_every must be >= 1, got {record_every}")
     if chunk is None:
@@ -111,15 +120,17 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
         if record_history:
             # board chunks record BEFORE transitioning, so block-local
             # index 0 is already on the global grid
-            outs = jax.tree.map(np.asarray,
-                                thin_outs(outs, record_every, offset=0))
+            outs = thin_outs(outs, record_every, offset=0)
+            if not history_device:
+                outs = jax.tree.map(np.asarray, outs)
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (T, C) -> (C, T)
         state = drain_waits(state, pending_waits)
         done += this
 
     waits_total = _sum_pending(waits_total, pending_waits)
-    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
+    xp = jnp if history_device else np
+    history = ({k: xp.concatenate(v, axis=1) for k, v in hist_parts.items()}
                if record_history and hist_parts else {})
     return RunResult(state=state, history=history,
                      waits_total=waits_total, n_yields=n_transitions)
@@ -130,7 +141,8 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
               record_history: bool = True,
               chunk: Optional[int] = None,
               bits: Optional[bool] = None,
-              record_every: int = 1) -> RunResult:
+              record_every: int = 1,
+              history_device: bool = False) -> RunResult:
     """Run the batched board chain for ``n_steps`` yields (yield 0 is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
     ``bits`` overrides the bit-board body dispatch (perf toggle; the
@@ -139,8 +151,10 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
     every step), strided on device before the host copy."""
     seg = run_board_segment(bg, spec, params, state, n_steps - 1,
                             record_history=record_history, chunk=chunk,
-                            bits=bits, record_every=record_every)
+                            bits=bits, record_every=record_every,
+                            history_device=history_device)
     hist_parts = {k: [v] for k, v in seg.history.items()}
     return finalize_board_run(bg, spec, params, seg.state, hist_parts,
                               seg.waits_total, [], record_history,
-                              n_steps, record_every)
+                              n_steps, record_every,
+                              history_device=history_device)

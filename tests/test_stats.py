@@ -234,3 +234,43 @@ def test_election_with_signed_labels():
         columns={"pink": votes[:, 0], "purple": votes[:, 1]})(
             compat.Partition(g, child.assignment_array.copy(), {}))
     np.testing.assert_array_equal(r2.tallies, fresh.tallies)
+
+
+def _ar1(rng, c, t, phi):
+    e = rng.standard_normal((c, t))
+    x = np.zeros((c, t))
+    for i in range(1, t):
+        x[:, i] = phi * x[:, i - 1] + e[:, i]
+    return x * 30 + 700          # cut-count-like scale/offset
+
+
+@pytest.mark.parametrize("phi", [0.0, 0.7, 0.95])
+def test_ess_device_matches_host(rng, phi):
+    """stats.ess_device (f32, on-device FFT + masked Sokal window) agrees
+    with the host f64 estimator to <1% on bench-scale trajectories —
+    the tolerance bench.py's ess_host_check field monitors on silicon."""
+    x = _ar1(rng, 32, 1500, phi)
+    per_h, tot_h = stats.ess(x)
+    per_d, tot_d = stats.ess_device(x)
+    assert abs(float(tot_d) - tot_h) / tot_h < 0.01
+    np.testing.assert_allclose(np.asarray(per_d), per_h, rtol=0.02)
+
+
+def test_run_board_history_device_identity():
+    """history_device=True returns the SAME history as the host path —
+    device arrays instead of numpy, values identical."""
+    import jax
+    g = fce.graphs.square_grid(8, 8)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    runs = {}
+    for dev in (False, True):
+        bg, st, params = fce.sampling.init_board(
+            g, plan, n_chains=4, seed=0, spec=spec, base=1.3, pop_tol=0.4)
+        res = fce.sampling.run_board(bg, spec, params, st, n_steps=101,
+                                     chunk=25, history_device=dev)
+        runs[dev] = res.history
+    assert all(isinstance(v, jax.Array) for v in runs[True].values())
+    for k in runs[False]:
+        np.testing.assert_array_equal(np.asarray(runs[True][k]),
+                                      runs[False][k])

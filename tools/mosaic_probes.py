@@ -39,7 +39,6 @@ run("prng-plane-loop-carry", kA, jax.ShapeDtypeStruct((1, BC), jnp.int32), (x,))
 # B. same but any_valid bool astype counters (the exact stage-54 shape)
 def kB(x_ref, o_ref):
     pltpu.prng_seed(3)
-    iota = jax.lax.broadcasted_iota(jnp.int32, (BC, N), 1)
     def step(t, c):
         bits = pltpu.bitcast(pltpu.prng_random_bits((BC, N)), jnp.uint32)
         valid = x_ref[:] > 0

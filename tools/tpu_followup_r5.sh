@@ -20,6 +20,8 @@ run_bench c8192_chunk1000 1200 --chains 8192 --chunk 1000 --warmup 1001
 # 4. k-district pair walk on-chip records (BASELINE config 2)
 run_bench pair_k4 900 --k 4
 run_bench pair_k8 900 --k 8
+# 4b. ESS with on-device diagnostics (readback-free recorded pass)
+run_bench ess_device 900 --ess
 # 5. Mosaic probes the first window could not finish (prng-in-loop)
 timeout 600 python tools/mosaic_probes.py >"bench_runs/${TS}_probes.txt" 2>&1
 # 6. Pallas compile retry + exactness (expected: Mosaic SIGABRT; any
