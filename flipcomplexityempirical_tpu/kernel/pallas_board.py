@@ -119,7 +119,12 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
 
     # every plane is int32 in VMEM: Mosaic (this toolchain) rejects
     # sub-32-bit rotates, truncating stores, and u32 argmax/casts; the
-    # runner-side i8/i32 conversions happen outside the kernel
+    # runner-side i8/i32 conversions happen outside the kernel.
+    # Per-chain quantities are explicit (BC, 1) COLUMNS, never 1-D
+    # vectors: Mosaic's layout pass crashed (layout.h:320, implicit-dim
+    # rank check) when the PRNG-score-derived accept mask flowed through
+    # 1-D loop carries, and 2-D columns leave no implicit-dim layouts
+    # anywhere in the carry chain (PROFILE.md round-5 bisection).
     board_out[:] = board_in[:]
     cut_e_acc_ref[:] = jnp.zeros_like(cut_e_acc_ref)
     cut_s_acc_ref[:] = jnp.zeros_like(cut_s_acc_ref)
@@ -136,11 +141,11 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
     deg = deg_ref[:]           # (1, N) int32
     code_plane = deg * 8       # + board*64 + diff_deg, built per step
 
-    # per-chain scalar params, (BC,) f32 / int32 rows
-    log_base = scal_in[0]
-    beta = scal_in[1]
-    pop_lo = scal_in[2]
-    pop_hi = scal_in[3]
+    # per-chain scalar params, (BC, 1) f32 columns (chains-major input)
+    log_base = scal_in[:, 0:1]
+    beta = scal_in[:, 1:2]
+    pop_lo = scal_in[:, 2:3]
+    pop_hi = scal_in[:, 3:4]
     denom = f32(float(n) ** 2 - 1.0)
 
     iota_n = jax.lax.broadcasted_iota(jnp.int32, (bc, n), 1)
@@ -184,22 +189,22 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
             contig = jnp.ones_like(b_mask)
 
         popn = pop.astype(f32)
-        pop_of = jnp.where(board == 1, dp1[:, None], dp0[:, None])
-        pop_to = jnp.where(board == 1, dp0[:, None], dp1[:, None])
-        pop_ok = ((pop_of.astype(f32) - popn >= pop_lo[:, None])
-                  & (pop_to.astype(f32) + popn <= pop_hi[:, None]))
+        pop_of = jnp.where(board == 1, dp1, dp0)
+        pop_to = jnp.where(board == 1, dp0, dp1)
+        pop_ok = ((pop_of.astype(f32) - popn >= pop_lo)
+                  & (pop_to.astype(f32) + popn <= pop_hi))
         valid = b_mask & contig & pop_ok
 
-        b_count = b_mask.astype(jnp.int32).sum(axis=1)
-        cut_count = (cut_e.astype(jnp.int32).sum(axis=1)
-                     + cut_s.astype(jnp.int32).sum(axis=1))
+        b_count = b_mask.astype(jnp.int32).sum(axis=1, keepdims=True)
+        cut_count = (cut_e.astype(jnp.int32).sum(axis=1, keepdims=True)
+                     + cut_s.astype(jnp.int32).sum(axis=1, keepdims=True))
 
         # ---- complete the pending wait from this state's boundary count
         if host_rng:
-            u_wait = _u01(bits_scal_ref[t, 0:1])[0]
+            u_wait = _u01(bits_scal_ref[t][:, 0:1])
         else:
             u_wait = _u01(pltpu.bitcast(
-                pltpu.prng_random_bits((1, bc)), jnp.uint32))[0]
+                pltpu.prng_random_bits((bc, 1)), jnp.uint32))
         if spec.geom_waits:
             p = b_count.astype(f32) / denom
             wnew = jnp.maximum(
@@ -207,13 +212,14 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
                           / jnp.log1p(-p)), 0.0)
             cur_wait = jnp.where(pending != 0, wnew, cur_wait)
 
-        # ---- record yield t
-        hist_cut_ref[t, :] = cut_count
-        hist_b_ref[t, :] = b_count
-        hist_wait_ref[t, :] = cur_wait
-        hist_acc_ref[t, :] = acc_cnt
-        log_f_ref[t, :] = cur_flip
-        log_s_ref[t, :] = cur_sign
+        # ---- record yield t ((BC, 1) columns -> (BC,) row stores on the
+        # proven dynamic-sublane path)
+        hist_cut_ref[t, :] = cut_count[:, 0]
+        hist_b_ref[t, :] = b_count[:, 0]
+        hist_wait_ref[t, :] = cur_wait[:, 0]
+        hist_acc_ref[t, :] = acc_cnt[:, 0]
+        log_f_ref[t, :] = cur_flip[:, 0]
+        log_s_ref[t, :] = cur_sign[:, 0]
         cut_e_acc_ref[:] = cut_e_acc_ref[:] + cut_e.astype(jnp.int32)
         cut_s_acc_ref[:] = cut_s_acc_ref[:] + cut_s.astype(jnp.int32)
         waits_sum = waits_sum + cur_wait
@@ -231,25 +237,25 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
         # order onto int32 order, then argmax = max + first-index-of-max
         # as two int32 reductions (same first-occurrence index).
         s32 = pltpu.bitcast(score ^ jnp.uint32(0x80000000), jnp.int32)
-        smax = jnp.max(s32, axis=1)
-        idx = jnp.min(jnp.where(s32 == smax[:, None], iota_n, n),
-                      axis=1).astype(jnp.int32)
+        smax = jnp.max(s32, axis=1, keepdims=True)
+        idx = jnp.min(jnp.where(s32 == smax, iota_n, n),
+                      axis=1, keepdims=True).astype(jnp.int32)
         any_valid = smax > jnp.int32(-(2 ** 31))
 
-        sel = iota_n == idx[:, None]
+        sel = iota_n == idx
         codes = code_plane + b32 * 64 + diff_deg
-        code_at = jnp.where(sel, codes, 0).sum(axis=1)
-        pop_at = jnp.where(sel, pop, 0).sum(axis=1)
+        code_at = jnp.where(sel, codes, 0).sum(axis=1, keepdims=True)
+        pop_at = jnp.where(sel, pop, 0).sum(axis=1, keepdims=True)
         d_from = code_at // 64
         deg_at = (code_at // 8) % 8
         dd_at = code_at % 8
         dcut = deg_at - 2 * dd_at
 
         if host_rng:
-            u_acc = _u01(bits_scal_ref[t, 1:2])[0]
+            u_acc = _u01(bits_scal_ref[t][:, 1:2])
         else:
             u_acc = _u01(pltpu.bitcast(
-                pltpu.prng_random_bits((1, bc)), jnp.uint32))[0]
+                pltpu.prng_random_bits((bc, 1)), jnp.uint32))
         log_bound = (-beta * dcut.astype(f32) * log_base)
         logu = jnp.log(jnp.maximum(u_acc, f32(1e-12)))
         accept = any_valid & (logu < log_bound)
@@ -257,7 +263,7 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
         # ---- commit
         d_to = 1 - d_from
         board_out[:] = jnp.where(
-            sel & accept[:, None], d_to[:, None].astype(board.dtype), board)
+            sel & accept, d_to.astype(board.dtype), board)
         popv = jnp.where(accept, pop_at, 0)
         dp0 = dp0 + jnp.where(d_from == 0, -popv, popv)
         dp1 = dp1 + jnp.where(d_from == 0, popv, -popv)
@@ -270,24 +276,25 @@ def _kernel(spec: Spec, h: int, w: int, t_inner: int, host_rng: bool,
         return (dp0, dp1, cur_wait, pending, cur_flip, cur_sign, tyield,
                 move_clock, acc_cnt, exh_cnt, waits_sum)
 
-    init = (dist_pop_in[0], dist_pop_in[1], scal_in[4],
-            ints_in[0], ints_in[1], ints_in[2], ints_in[3], ints_in[4],
-            ints_in[5], ints_in[6],
-            jnp.zeros_like(scal_in[4]))
+    init = (dist_pop_in[:, 0:1], dist_pop_in[:, 1:2], scal_in[:, 4:5],
+            ints_in[:, 0:1], ints_in[:, 1:2], ints_in[:, 2:3],
+            ints_in[:, 3:4], ints_in[:, 4:5], ints_in[:, 5:6],
+            ints_in[:, 6:7],
+            jnp.zeros_like(scal_in[:, 4:5]))
     out = jax.lax.fori_loop(0, t_inner, step, init)
     (dp0, dp1, cur_wait, pending, cur_flip, cur_sign, tyield,
      move_clock, acc_cnt, exh_cnt, waits_sum) = out
-    dist_pop_out[0] = dp0
-    dist_pop_out[1] = dp1
-    scal_out[0] = cur_wait
-    scal_out[1] = waits_sum
-    ints_out[0] = pending
-    ints_out[1] = cur_flip
-    ints_out[2] = cur_sign
-    ints_out[3] = tyield
-    ints_out[4] = move_clock
-    ints_out[5] = acc_cnt
-    ints_out[6] = exh_cnt
+    dist_pop_out[:, 0:1] = dp0
+    dist_pop_out[:, 1:2] = dp1
+    scal_out[:, 0:1] = cur_wait
+    scal_out[:, 1:2] = waits_sum
+    ints_out[:, 0:1] = pending
+    ints_out[:, 1:2] = cur_flip
+    ints_out[:, 2:3] = cur_sign
+    ints_out[:, 3:4] = tyield
+    ints_out[:, 4:5] = move_clock
+    ints_out[:, 5:6] = acc_cnt
+    ints_out[:, 6:7] = exh_cnt
 
 
 @functools.partial(
@@ -326,19 +333,21 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
         rep(pop_plane.shape),                    # pop (1, N)
         rep(deg_plane.shape),                    # deg (1, N)
         *[rep(m.shape) for m in masks8],         # 8 masks (1, N)
-        pl.BlockSpec((2, bc), lambda b: (0, b)),  # dist_pop (2, C)
-        pl.BlockSpec((5, bc), lambda b: (0, b)),  # f32 scalars (5, C)
-        pl.BlockSpec((7, bc), lambda b: (0, b)),  # i32 counters (7, C)
+        # per-chain packed state is chains-major (C, k): the kernel reads
+        # (BC, 1) columns with no relayout (2-D-columns rule, see _kernel)
+        pl.BlockSpec((bc, 2), lambda b: (b, 0)),  # dist_pop (C, 2)
+        pl.BlockSpec((bc, 5), lambda b: (b, 0)),  # f32 scalars (C, 5)
+        pl.BlockSpec((bc, 7), lambda b: (b, 0)),  # i32 counters (C, 7)
         (tdim(bits_plane.shape) if host_rng
          else rep((1, 1))),                      # bits plane (T, C, N)
-        (pl.BlockSpec((t_inner, 2, bc), lambda b: (0, 0, b)) if host_rng
-         else rep((1, 1))),                      # bits scal (T, 2, C)
+        (pl.BlockSpec((t_inner, bc, 2), lambda b: (0, b, 0)) if host_rng
+         else rep((1, 1))),                      # bits scal (T, C, 2)
     ]
     out_shape = (
         jax.ShapeDtypeStruct((c, n), jnp.int32),         # board
-        jax.ShapeDtypeStruct((2, c), jnp.int32),         # dist_pop
-        jax.ShapeDtypeStruct((2, c), jnp.float32),       # scalars out
-        jax.ShapeDtypeStruct((7, c), jnp.int32),         # counters out
+        jax.ShapeDtypeStruct((c, 2), jnp.int32),         # dist_pop
+        jax.ShapeDtypeStruct((c, 2), jnp.float32),       # scalars out
+        jax.ShapeDtypeStruct((c, 7), jnp.int32),         # counters out
         jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # log_f
         jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # log_s
         jax.ShapeDtypeStruct((t_inner, c), jnp.int32),   # hist cut
@@ -350,9 +359,9 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
     )
     out_specs = (
         cdim((c, n)),
-        pl.BlockSpec((2, bc), lambda b: (0, b)),
-        pl.BlockSpec((2, bc), lambda b: (0, b)),
-        pl.BlockSpec((7, bc), lambda b: (0, b)),
+        pl.BlockSpec((bc, 2), lambda b: (b, 0)),
+        pl.BlockSpec((bc, 2), lambda b: (b, 0)),
+        pl.BlockSpec((bc, 7), lambda b: (b, 0)),
         tdim((t_inner, c)),
         tdim((t_inner, c)),
         tdim((t_inner, c)),
@@ -363,7 +372,14 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
         cdim((c, n)),
     )
 
-    if not host_rng:
+    # external contract stays (k, C) / (T, 2, C); chains-major is an
+    # XLA-level transpose on the way in and out of the kernel
+    dist_pop = dist_pop.T
+    scal_in = scal_in.T
+    ints_in = ints_in.T
+    if host_rng:
+        bits_scal = bits_scal.transpose(0, 2, 1)
+    else:
         bits_plane = jnp.zeros((1, 1), jnp.uint32)
         bits_scal = jnp.zeros((1, 1), jnp.uint32)
 
@@ -376,13 +392,26 @@ def run_pallas_chunk(spec: Spec, h: int, w: int, t_inner: int,
                 dist_pop_in, scal_in_ref, ints_in_ref, bp_ref, bs_ref,
                 *outs)
 
+    # the benchmark shape's scoped stack peaks at 16.47M (compiler error
+    # table, PROFILE.md), just over Mosaic's 16M default budget — and
+    # shrinking the chunk pipelines WORSE (25.45M at chunk=250), so the
+    # fix is an explicit budget: 2x the measured peak as headroom for
+    # chunk/shape tuning, still a quarter of the chip's 128M VMEM.
+    # Timed on-chip at this value (bench_runs/tpu_pallas_timing.json).
+    kwargs = {}
+    if not interpret:
+        params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams")
+        kwargs["compiler_params"] = params_cls(
+            vmem_limit_bytes=32 * 1024 * 1024)
     outs = pl.pallas_call(
         kern, grid=grid, in_specs=in_specs, out_specs=out_specs,
-        out_shape=out_shape, interpret=interpret,
+        out_shape=out_shape, interpret=interpret, **kwargs,
     )(seeds, board.astype(jnp.int32), pop_plane, deg_plane, *masks8,
       dist_pop, scal_in, ints_in, bits_plane, bits_scal)
-    # back to the BoardState dtype outside the kernel
-    return (outs[0].astype(jnp.int8),) + tuple(outs[1:])
+    # back to the BoardState dtype and the (k, C) packing outside the kernel
+    return ((outs[0].astype(jnp.int8), outs[1].T, outs[2].T, outs[3].T)
+            + tuple(outs[4:]))
 
 
 def make_static_inputs(bg: BoardGraph):

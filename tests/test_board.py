@@ -492,7 +492,8 @@ def test_empty_valid_set_self_loops_forever():
     assert (res.history["cut_count"] == res.history["cut_count"][:, :1]).all()
 
 
-@pytest.mark.parametrize("mode", ["corrected", "anneal"])
+@pytest.mark.parametrize(
+    "mode", [pytest.param("corrected", marks=pytest.mark.slow), "anneal"])
 def test_board_matches_general_path_extended_modes(mode):
     """Corrected (reversibility-ratio) acceptance and the reference's
     linear annealing schedule agree across paths."""

@@ -200,6 +200,7 @@ def test_shapefile_reader_rejects_non_polygon_and_bad_magic():
             graphs.read_shapefile(pt)
 
 
+@pytest.mark.slow
 def test_weighted_cut_chain_on_voronoi_state():
     """BASELINE config 5 on the realistic-topology stand-in: a k=4
     boundary-length-weighted chain on the Voronoi state runs end to end

@@ -48,7 +48,8 @@ def test_kpair_family_end_to_end(tmp_path):
         assert int(f.read()) > 0
 
 
-@pytest.mark.parametrize("family", ["tri", "hex"])
+@pytest.mark.parametrize(
+    "family", [pytest.param("tri", marks=pytest.mark.slow), "hex"])
 def test_lattice_families_end_to_end(tmp_path, family):
     cfg = ex.ExperimentConfig(family=family, alignment=1, base=0.3,
                               pop_tol=0.1, lattice_m=6, lattice_n=10,
