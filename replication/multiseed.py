@@ -1,23 +1,27 @@
 #!/usr/bin/env python
-"""Multi-seed replication of the slow-base sec11 cells (VERDICT r4 next-4).
+"""Multi-seed replication of the slow-base cells (VERDICT r4 next-4).
 
-The full-corpus table (REPLICATION.md) runs every reference cell ONCE; at
-the slow bases (B263 = mu, B695 = mu^2) single runs are mode-dominated
-and per-cell ratios span 0.58-1.27, justified qualitatively by the
-reference's own 15-cell spread. This script makes that quantitative: it
-runs ONE cell per slow base (alignment 0, P50) at 15 seeds x 8 chains,
-records every per-chain wait sum, and rank/KS-tests the seed distribution
-against the reference's 15 shipped per-base ``wait.txt`` scalars. If the
-spread is mode occupancy (as claimed) the two samples are exchangeable;
-a subtle ordered-phase acceptance bug would shift ours detectably.
+The full-corpus tables (REPLICATION.md) run every reference cell ONCE; at
+the slow bases (sec11 B263 = mu, B695 = mu^2, B1000; frank B333 — the
+bimodal regime) single runs are mode-dominated and per-cell ratios are
+wide, justified qualitatively by the reference's own per-base spread.
+This script makes that quantitative: it runs ONE cell per slow base
+(alignment 0, P50) at 15 seeds x 8 chains, records every per-chain wait
+sum, and rank/KS-tests the seed distribution against the reference's
+shipped per-base ``wait.txt`` scalars (15 cells/base sec11, 12 frank).
+If the spread is mode occupancy (as claimed) the two samples are
+exchangeable; a subtle ordered-phase acceptance bug would shift ours
+detectably.
 
-  python replication/multiseed.py run       # ~6 min CPU; writes the JSON
-  python replication/multiseed.py analyze   # KS/rank vs the reference
+  python replication/multiseed.py run                   # sec11 cells
+  python replication/multiseed.py run --family frank    # frank B333
+  python replication/multiseed.py run --cells B1000     # one cell, merged
+  python replication/multiseed.py analyze [--family ...]
 
-The committed record is replication/seeds/multiseed_sec11.json;
-tests/test_replication.py re-analyzes it (and the reference corpus) on
-every --runslow run so the "consistent with the reference spread" claim
-stays continuously checked.
+Committed records: replication/seeds/multiseed_sec11.json and
+multiseed_frank.json; tests/test_replication.py re-analyzes them (and
+the reference corpora) on every --runslow run so the "consistent with
+the reference spread" claim stays continuously checked.
 """
 
 import argparse
@@ -30,33 +34,86 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                      "seeds", "multiseed_sec11.json")
+_SEEDS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "seeds")
 MU = 2.63815853
-CELLS = {"B263": MU, "B695": MU ** 2}
 SEEDS = list(range(1, 16))
-REF_DIR = "/root/reference/New_plots/sec11"
+
+FAMILIES = {
+    "sec11": {
+        "cells": {"B263": MU, "B695": MU ** 2, "B1000": 10.0},
+        "ref_dir": "/root/reference/New_plots/sec11",
+        "ref_cells": 15,  # 3 alignments x 5 pops
+        "record": os.path.join(_SEEDS_DIR, "multiseed_sec11.json"),
+        "gates": {},
+    },
+    "frank": {
+        "cells": {"B333": 1 / 0.3},
+        "ref_dir": "/root/reference/plots/FRANK",
+        "ref_cells": 12,  # 3 alignments x 4 pops
+        "record": os.path.join(_SEEDS_DIR, "multiseed_frank.json"),
+        # B333 is BIMODAL (REPLICATION.md tempering section): seeds
+        # legitimately land in either mode, so its seed-noise bound is
+        # wider and its center bound reflects cross-mode variance of a
+        # 15-sample mean
+        "gates": {"B333": {"cv": 0.7, "mean": 0.35}},
+    },
+}
+
+# back-compat aliases (tests and older docs import these)
+RECORD = FAMILIES["sec11"]["record"]
+CELLS = FAMILIES["sec11"]["cells"]
+REF_DIR = FAMILIES["sec11"]["ref_dir"]
 
 
-def run(record_path=RECORD, seeds=SEEDS, steps=100_000, chains=8,
-        scratch=None):
+def run(record_path=None, seeds=SEEDS, steps=100_000, chains=8,
+        scratch=None, family="sec11", cells=None):
+    """Run the requested cells and MERGE them into the family record
+    (existing cells under other names are preserved, so one cell can be
+    added or regenerated without re-running the rest)."""
     from flipcomplexityempirical_tpu.experiments.config import (
         ExperimentConfig)
     from flipcomplexityempirical_tpu.experiments.driver import run_config
 
-    scratch = scratch or os.path.join("/tmp", "multiseed_artifacts")
+    fam = FAMILIES[family]
+    record_path = record_path or fam["record"]
+    if cells is not None:
+        unknown = sorted(set(cells) - set(fam["cells"]))
+        if unknown:
+            raise SystemExit(
+                f"unknown cell(s) {unknown} for family {family!r}; "
+                f"known: {sorted(fam['cells'])}")
+    todo = {k: v for k, v in fam["cells"].items()
+            if cells is None or k in cells}
+    scratch = scratch or os.path.join("/tmp", f"multiseed_{family}")
     rec = {"steps": steps, "chains": chains, "alignment": 0,
            "pop_tol": 0.5, "seeds": list(seeds), "cells": {}}
-    for name, base in CELLS.items():
+    if os.path.exists(record_path):
+        with open(record_path) as f:
+            old = json.load(f)
+        if (old["steps"], old["chains"], old["seeds"]) == (
+                steps, chains, list(seeds)):
+            rec["cells"].update(old["cells"])
+        elif set(old["cells"]) - set(todo):
+            # a partial rerun at different settings would silently erase
+            # the other cells' data — refuse; a FULL rerun may move the
+            # settings (every cell is regenerated under the new ones)
+            raise SystemExit(
+                f"{record_path} holds cells {sorted(old['cells'])} at "
+                f"(steps={old['steps']}, chains={old['chains']}, "
+                f"{len(old['seeds'])} seeds); rerunning only "
+                f"{sorted(todo)} at different settings would drop them. "
+                "Rerun all cells, match the settings, or use --record.")
+    for name, base in todo.items():
         per_seed = []
         for s in seeds:
-            cfg = ExperimentConfig(family="sec11", alignment=0, base=base,
+            cfg = ExperimentConfig(family=family, alignment=0, base=base,
                                    pop_tol=0.5, seed=s, total_steps=steps,
                                    n_chains=chains)
             data = run_config(cfg, os.path.join(scratch, f"s{s}"))
             per_seed.append(np.asarray(data["waits_all"],
                                        np.float64).tolist())
-            print(f"[multiseed] {name} seed {s}: chain0 "
+            print(f"[multiseed] {family} {name} seed {s}: chain0 "
                   f"{per_seed[-1][0]:.4g} ({data['seconds']:.1f}s)",
                   flush=True)
         rec["cells"][name] = {"base": base, "waits_all": per_seed}
@@ -91,10 +148,11 @@ def ks_2sample(a, b):
     return d, float(min(max(p, 0.0), 1.0))
 
 
-def analyze(record_path=RECORD, ref_dir=None):
+def analyze(record_path=None, ref_dir=None, family="sec11"):
+    record_path = record_path or FAMILIES[family]["record"]
     with open(record_path) as f:
         rec = json.load(f)
-    ref_dir = ref_dir or REF_DIR
+    ref_dir = ref_dir or FAMILIES[family]["ref_dir"]
     results = {}
     for name, cell in rec["cells"].items():
         ref = _ref_waits(name, ref_dir)
@@ -125,37 +183,49 @@ def analyze(record_path=RECORD, ref_dir=None):
     return results
 
 
-def cell_consistent(c: dict) -> bool:
+def cell_consistent(c: dict, gate: dict | None = None) -> bool:
     """The single consistency gate (CLI and test share it): the KS test
     does not REJECT at 1%, the seed distribution is centered on the
     reference per-base mean, seed noise is bounded, and the reference
     median sits inside the body of the seed distribution. The committed
-    record measures KS p = 0.31 (B263) / 0.0515 (B695); the B695 shape
-    difference is the tight-seeds-vs-config-spread effect described in
-    analyze(), so the binding constraint is the center."""
+    records measure KS p = 0.31 (B263) / 0.0515 (B695) / 0.59 (B1000) /
+    0.021 (B333); the shape differences at the ordered-phase bases are
+    the tight-seeds-vs-config-spread effect described in analyze(), so
+    the binding constraint is the center. ``gate`` widens the noise and
+    center bounds for cells declared in FAMILIES[...]["gates"]
+    (e.g. frank's bimodal B333)."""
+    gate = gate or {}
     return (c["ks_chain0"]["p"] > 0.01
-            and abs(c["mean_ratio"] - 1) < 0.15
-            and c["seed_cv"] < 0.25
+            and abs(c["mean_ratio"] - 1) < gate.get("mean", 0.15)
+            and c["seed_cv"] < gate.get("cv", 0.25)
             and 0.05 < c["ref_median_quantile_in_seeds"] < 0.95)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("cmd", choices=["run", "analyze"])
-    ap.add_argument("--record", default=RECORD)
+    ap.add_argument("--family", choices=sorted(FAMILIES), default="sec11")
+    ap.add_argument("--record", default=None)
+    ap.add_argument("--cells", nargs="+", default=None,
+                    help="subset of the family's cells to (re)run; "
+                         "others are preserved in the record")
     ap.add_argument("--steps", type=int, default=100_000)
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    record = args.record or FAMILIES[args.family]["record"]
     if args.cmd == "run":
-        run(args.record, steps=args.steps)
-    res = analyze(args.record)
+        run(record, steps=args.steps, family=args.family,
+            cells=args.cells)
+    res = analyze(record, family=args.family)
     print(json.dumps(res, indent=1))
-    ok = all(map(cell_consistent, res.values()))
+    gates = FAMILIES[args.family]["gates"]
+    ok = all(cell_consistent(c, gates.get(name))
+             for name, c in res.items())
     print("seed spread consistent with reference per-base spread "
-          f"(KS p > 0.01, mean within 15%): {'YES' if ok else 'NO'}")
+          f"(KS p > 0.01, centered): {'YES' if ok else 'NO'}")
     return 0 if ok else 1
 
 
