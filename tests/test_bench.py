@@ -1,0 +1,49 @@
+"""End-to-end smoke of bench.py, the scoreboard entry point.
+
+The driver records BENCH_r{N} by running ``python bench.py`` and parsing
+its single stdout JSON line, so a schema or CLI regression here costs a
+round's record (round-3 post-mortem: ``parsed: null``). This drives the
+real script in a subprocess at a tiny shape with explicit ``--cpu``
+(NOT the cpu-fallback path — that one is probe-driven and frozen) and
+pins the contract: exactly one JSON object on stdout, the documented
+fields, an explicit chain count honored verbatim, and per-run detail on
+stderr.
+
+Slow tier: the subprocess pays a fresh JAX import + compile (~40 s).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+@pytest.mark.slow
+def test_bench_cpu_record_schema_and_explicit_chains():
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--cpu", "--chains", "8", "--steps", "21",
+         "--warmup", "11", "--chunk", "10"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "flips_per_sec_per_chip_64x64"
+    assert rec["unit"] == "flips/s"
+    assert rec["value"] > 0
+    # explicit --cpu is a local verification run, not the probe-driven
+    # fallback: no cpu_fallback tag, and the ratio stays numeric
+    assert "cpu_fallback" not in rec
+    # mirror bench.py's emission exactly (round to 4 decimals) — a
+    # relative tolerance is tighter than the rounding grid at this shape
+    assert rec["vs_baseline"] == round(rec["value"] / 1.25e6, 4)
+    assert rec["repeat_policy"] == "best"
+    detail = [json.loads(ln) for ln in proc.stderr.splitlines()
+              if ln.startswith("{")]
+    assert detail, "per-run detail JSON expected on stderr"
+    assert detail[-1]["chains"] == 8, "explicit --chains must win"

@@ -56,6 +56,7 @@ def test_invariants_bi(contig):
     check_invariants(dg, res.host_state(), 2)
 
 
+@pytest.mark.slow
 def test_invariants_pair_k4():
     spec = fce.Spec(n_districts=4, proposal="pair", contiguity="patch")
     g, dg, res = run_small(spec, n=10, k=4, steps=300, tol=0.5)

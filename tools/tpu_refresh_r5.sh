@@ -1,8 +1,10 @@
 #!/bin/bash
-# One-shot refresh for the round-5 tail: if the tunnel reopens, capture a
-# fresh default-config record (the default now resolves to the C=8192
-# peak) and exit. The full capture set is already committed; this only
-# adds a confirming record at the new default.
+# One-shot refresh for the round-5 tail: if the tunnel reopens, capture
+# (1) a fresh default-config record (the default now resolves to the
+# C=8192 peak), (2) the two frontier probes the first window lost to the
+# tunnel drop (8192@chunk600, 10240), and (3) a --pallas record at the
+# shipped 32MiB VMEM budget. The primary set is already committed; these
+# only confirm/extend it, so the probes' failures do not block exit.
 set -u
 cd "$(dirname "$0")/.."
 . tools/bench_lib.sh
@@ -12,7 +14,12 @@ while true; do
       "import jax,sys; sys.exit(0 if jax.devices()[0].platform!='cpu' else 1)" \
       >/dev/null 2>&1; then
     TS=$(date -u +%Y%m%dT%H%M%SZ)
-    run_bench default_refresh 900 && exit 0
+    if run_bench default_refresh 900; then
+      run_bench frontier_c8192_chunk600 900 --chains 8192 --chunk 600 --warmup 601 || true
+      run_bench frontier_c10240 900 --chains 10240 || true
+      run_bench pallas_refresh 900 --pallas || true
+      exit 0
+    fi
     # chip up but the bench failed (regression, commit failure, tunnel
     # dropped mid-run): cap the burn at 3 attempts, backing off between
     fails=$((fails + 1))
