@@ -142,6 +142,16 @@ def main():
                   "measurement (the TPU number this stands in for is NOT "
                   "comparable to vs_baseline's per-chip target)",
                   file=sys.stderr)
+            if args.pallas:
+                # the Pallas path only exists compiled (interpret mode is
+                # a test vehicle ~1000x too slow to measure); a CPU
+                # stand-in would crash in pallas_call, so fail cleanly
+                # instead of emitting a traceback (observed when the
+                # tunnel dropped between a capture and its rerun)
+                print("bench: --pallas requires the accelerator; no CPU "
+                      "fallback exists for the compiled Pallas kernel",
+                      file=sys.stderr)
+                sys.exit(3)
             cpu_fallback = True
             args.cpu = True
             if args.chains is None:
@@ -169,6 +179,11 @@ def main():
     if args.body is not None and (args.pallas or args.general):
         print("bench: --body selects a board-path body; it cannot be "
               "combined with --pallas or --general", file=sys.stderr)
+        sys.exit(2)
+    if args.pallas and args.cpu:
+        print("bench: --pallas cannot run on the CPU backend (pallas_call "
+              "supports interpret mode only there, which is not a "
+              "measurement)", file=sys.stderr)
         sys.exit(2)
     if args.pallas and args.k != 2:
         print("bench: the pallas path serves the 2-district bi walk only "

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 tail watchdog. The first two windows (round start) landed the
+# full record set; the second window's tail showed the tunnel DEGRADING
+# before it dropped (default 17.4M vs the standing 20.2M, pallas 0.90M
+# vs its 4.78M record — PROFILE.md "round-5 refresh" section). So from
+# here on: every time the tunnel reopens, capture a fresh quiet-host
+# default record (latest-wins evidence of the chip's current state, and
+# insurance that a near-round-end record exists), and re-time the pallas
+# path ONCE on a healthy window to resolve its anomalous 0.90M reading.
+# Runs until the driver kills it at round end; caps the default stream
+# at 8 captures to bound commit clutter.
+set -u
+cd "$(dirname "$0")/.."
+. tools/bench_lib.sh
+while true; do
+  if [ "$(ls bench_runs/*_tail_default.json 2>/dev/null | wc -l)" -ge 8 ]; then
+    exit 0
+  fi
+  if timeout 150 python -c \
+      "import jax,sys; sys.exit(0 if jax.devices()[0].platform!='cpu' else 1)" \
+      >/dev/null 2>&1; then
+    TS=$(date -u +%Y%m%dT%H%M%SZ)
+    run_bench tail_default 900 || true
+    # pallas re-time only until one post-anomaly number exists; gate on
+    # the default capture having measured healthy (>=15x) so we time the
+    # kernel, not a dying tunnel
+    if ! ls bench_runs/*_tail_pallas.json >/dev/null 2>&1 \
+        && [ -s "bench_runs/${TS}_tail_default.json" ] \
+        && python - "bench_runs/${TS}_tail_default.json" <<'EOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+sys.exit(0 if (rec.get("vs_baseline") or 0) >= 15.0 else 1)
+EOF
+    then
+      run_bench tail_pallas 900 --pallas || true
+    fi
+    sleep 2700
+  else
+    sleep 420
+  fi
+done
