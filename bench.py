@@ -137,11 +137,6 @@ def main():
             tail = err.decode(errors="replace").strip().splitlines()[-3:]
             for line in tail:
                 print(f"bench probe: {line}", file=sys.stderr)
-            print("bench: accelerator backend unreachable or fell back "
-                  "to CPU (device probe); emitting a CPU-tagged "
-                  "measurement (the TPU number this stands in for is NOT "
-                  "comparable to vs_baseline's per-chip target)",
-                  file=sys.stderr)
             if args.pallas:
                 # the Pallas path only exists compiled (interpret mode is
                 # a test vehicle ~1000x too slow to measure); a CPU
@@ -152,6 +147,11 @@ def main():
                       "fallback exists for the compiled Pallas kernel",
                       file=sys.stderr)
                 sys.exit(3)
+            print("bench: accelerator backend unreachable or fell back "
+                  "to CPU (device probe); emitting a CPU-tagged "
+                  "measurement (the TPU number this stands in for is NOT "
+                  "comparable to vs_baseline's per-chip target)",
+                  file=sys.stderr)
             cpu_fallback = True
             args.cpu = True
             if args.chains is None:
