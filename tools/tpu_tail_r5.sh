@@ -3,37 +3,70 @@
 # full record set; the second window's tail showed the tunnel DEGRADING
 # before it dropped (default 17.4M vs the standing 20.2M, pallas 0.90M
 # vs its 4.78M record — PROFILE.md "round-5 refresh" section). So from
-# here on: every time the tunnel reopens, capture a fresh quiet-host
-# default record (latest-wins evidence of the chip's current state, and
-# insurance that a near-round-end record exists), and re-time the pallas
-# path ONCE on a healthy window to resolve its anomalous 0.90M reading.
-# Runs until the driver kills it at round end; caps the default stream
-# at 8 captures to bound commit clutter.
+# here on, every time the tunnel reopens:
+#   1. capture a fresh quiet-host default record (latest-wins evidence
+#      of the chip's current state, and insurance that a near-round-end
+#      record exists; the committed stream is capped at 8 — past the
+#      cap the default run still happens as an uncommitted tmpfile
+#      health probe, because the one-shot gate needs a fresh reading);
+#   2. if the window is HEALTHY (default read >=15x), run each missing
+#      one-shot: a pallas re-timing (resolves the anomalous 0.90M), a
+#      device-side ESS capture at the C=8192 throughput peak (the
+#      standing 1.93M ESS/s record is C=4096 — ESS scales ~linearly in
+#      chains, so the peak config should roughly double it), and a
+#      k=4 pair-walk record at C=8192 (its standing record is C=4096).
+#      Each one-shot carries its own vs_baseline acceptance floor (well
+#      below the expected healthy reading, well above the anomaly), so
+#      a window that degrades MID-SET quarantines the low reading
+#      (*.suspect) and the one-shot is retried on a later window
+#      instead of locking in another anomalous record.
+# Failed/fallback/suspect/uncommitted captures are quarantined by
+# run_bench (see bench_lib.sh), so only real committed records satisfy
+# the have()/count gates. Runs until the driver kills it at round end.
 set -u
 cd "$(dirname "$0")/.."
 . tools/bench_lib.sh
+
+have() { # a non-empty committed-shape record exists for this one-shot
+  for f in bench_runs/*"_$1.json"; do
+    [ -s "$f" ] && grep -q '"value"' "$f" && return 0
+  done
+  return 1
+}
+
+# window health = this window's default capture read >=15x (shared
+# vs_baseline gate lives in bench_lib.sh next to run_bench's floor)
+healthy() { vsb_at_least "$1" 15.0; }
+
 while true; do
-  if [ "$(ls bench_runs/*_tail_default.json 2>/dev/null | wc -l)" -ge 8 ]; then
+  n_def=$(find bench_runs -maxdepth 1 -name '*_tail_default.json' -size +1c | wc -l)
+  if [ "$n_def" -ge 8 ] && have tail_pallas && have tail_ess8192 \
+      && have tail_pair_k4_c8192; then
     exit 0
   fi
   if timeout 150 python -c \
       "import jax,sys; sys.exit(0 if jax.devices()[0].platform!='cpu' else 1)" \
       >/dev/null 2>&1; then
     TS=$(date -u +%Y%m%dT%H%M%SZ)
-    run_bench tail_default 900 || true
-    # pallas re-time only until one post-anomaly number exists; gate on
-    # the default capture having measured healthy (>=15x) so we time the
-    # kernel, not a dying tunnel
-    if ! ls bench_runs/*_tail_pallas.json >/dev/null 2>&1 \
-        && [ -s "bench_runs/${TS}_tail_default.json" ] \
-        && python - "bench_runs/${TS}_tail_default.json" <<'EOF'
-import json, sys
-rec = json.load(open(sys.argv[1]))
-sys.exit(0 if (rec.get("vs_baseline") or 0) >= 15.0 else 1)
-EOF
-    then
-      run_bench tail_pallas 900 --pallas || true
+    if [ "$n_def" -lt 8 ]; then
+      run_bench tail_default 900 || true
+      health="bench_runs/${TS}_tail_default.json"
+      # a validated record whose commit lost the git race is still a
+      # true health reading — accept the quarantined file for gating
+      [ -s "$health" ] || health="$health.uncommitted"
+    else
+      # cap reached: measure health without growing the committed stream
+      health=$(mktemp /tmp/tail_health.XXXXXX)
+      timeout 900 python bench.py >"$health" 2>/dev/null || true
     fi
+    if healthy "$health"; then
+      have tail_pallas || run_bench_min 2.0 tail_pallas 900 --pallas || true
+      have tail_ess8192 \
+        || run_bench_min 12.0 tail_ess8192 1200 --ess --chains 8192 || true
+      have tail_pair_k4_c8192 \
+        || run_bench_min 6.0 tail_pair_k4_c8192 900 --k 4 --chains 8192 || true
+    fi
+    case "$health" in /tmp/*) rm -f "$health";; esac
     sleep 2700
   else
     sleep 420
