@@ -1,0 +1,89 @@
+"""Replica exchange on the bimodal Frankengraph cell.
+
+The FRANK B333 regime (base = 1/0.3, compactness-favoring) is bimodal:
+plain chains sit in one cut-count well for a long time
+(Frankenstein_chain.py's hardest cell; REPLICATION.md "Tempering the
+B333 bimodal regime"). A beta ladder with replica-exchange swaps lets
+the hot rungs carry the ladder across the barrier — this script runs
+both arms on the same per-chain step budget and counts round trips
+between the wells of the RECONSTRUCTED cold-rung (beta = 1) trajectory.
+
+    python examples/02_replica_exchange.py                 # ~1 min CPU
+    python examples/02_replica_exchange.py --steps 100001  # full budget
+
+(The committed full-budget comparison lives at
+replication/temper/compare_S100001.json — regenerate it with
+replication/compare_tempering.py.)
+"""
+
+import argparse
+import os
+import sys
+
+# run as a script from anywhere: the package lives at the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20001)
+    ap.add_argument("--ladders", type=int, default=4)
+    ap.add_argument("--swap-every", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (default: whatever jax.devices() finds, e.g. the TPU)")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+    import flipcomplexityempirical_tpu as fce
+    from flipcomplexityempirical_tpu.experiments.config import TEMPER_BETAS
+    from flipcomplexityempirical_tpu.sampling import (
+        init_tempered, per_rung_history, run_tempered)
+    from flipcomplexityempirical_tpu.stats import round_trips
+
+    g = fce.graphs.frankengraph()
+    plan = fce.graphs.frank_plan(g, alignment=0)
+    spec = fce.Spec(contiguity="patch", parity_metrics=True,
+                    geom_waits=True)
+    base, pop_tol = 1 / 0.3, 0.1
+    lo, hi = 40.0, 60.0          # the two cut-count wells
+
+    # plain arm: independent chains at the physical target (beta = 1)
+    dg, st, params = fce.init_batch(
+        g, plan, n_chains=args.ladders, seed=args.seed, spec=spec,
+        base=base, pop_tol=pop_tol)
+    res_p = fce.run_chains(dg, spec, params, st, n_steps=args.steps)
+    cut_p = np.asarray(res_p.history["cut_count"], np.float64)
+
+    # tempered arm: same number of ladders, 10 rungs each, swaps every
+    # swap_every transitions; the observable is the cold rung's
+    # trajectory reconstructed through the swap record
+    h, st_t, params_t = init_tempered(
+        g, plan, betas=list(TEMPER_BETAS), n_ladders=args.ladders,
+        seed=args.seed, spec=spec, base=base, pop_tol=pop_tol)
+    res_t = run_tempered(h, spec, params_t, st_t, n_steps=args.steps,
+                         betas=list(TEMPER_BETAS), n_ladders=args.ladders,
+                         swap_every=args.swap_every, swap_seed=args.seed)
+    cut_c = per_rung_history(res_t, "cut_count")[0].astype(np.float64)
+
+    rt_p = round_trips(cut_p, lo, hi)
+    rt_c = round_trips(cut_c, lo, hi)
+    sr = res_t.swap_rates()
+    print(f"FRANK B333, {args.steps - 1} steps, {args.ladders} plain "
+          f"chains vs {args.ladders} ladders x {len(TEMPER_BETAS)} rungs")
+    print(f"  plain    round trips/chain : {rt_p.tolist()} "
+          f"(mean {rt_p.mean():.2f})")
+    print(f"  tempered round trips/ladder: {rt_c.tolist()} "
+          f"(mean {rt_c.mean():.2f}, cold rung)")
+    print(f"  swap accept rates (cold->hot adjacent pairs): "
+          f"{np.round(sr, 3).tolist()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""The examples/ scripts run end-to-end and print their summaries.
+
+Each example is exercised as a real subprocess (its own interpreter, CPU
+backend via the script's own --cpu flag — the conftest's in-process CPU
+forcing does not reach subprocesses) at reduced sizes. Slow tier: each
+run pays a fresh jax import + compile.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+CASES = [
+    ("01_quickstart.py",
+     ["--cpu", "--grid", "16", "--chains", "8", "--steps", "501"],
+     "board fast path"),
+    ("02_replica_exchange.py",
+     ["--cpu", "--steps", "501", "--ladders", "2"],
+     "swap accept rates"),
+    ("03_dual_geometry.py",
+     ["--cpu", "--precincts", "36", "--chains", "4", "--steps", "501"],
+     "Polsby-Popper"),
+    ("04_diagnostics.py",
+     ["--cpu", "--chains", "4", "--steps", "501", "--burn", "100"],
+     "bottleneck ratio"),
+    ("05_multi_device.py",
+     ["--devices", "2", "--inner-steps", "10", "--rounds", "1"],
+     "cross-device beta swaps"),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script,args,needle",
+                         CASES, ids=[c[0] for c in CASES])
+def test_example_runs(script, args, needle):
+    env = dict(os.environ)
+    # the scripts force CPU themselves (--cpu / virtual devices); drop the
+    # conftest's 8-virtual-device flag so each example controls its own
+    # backend exactly as a user invocation would
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script)] + args,
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, (script, r.stdout[-2000:], r.stderr[-2000:])
+    assert needle in r.stdout, (script, needle, r.stdout[-2000:])
