@@ -31,6 +31,9 @@ CASES = [
     ("05_multi_device.py",
      ["--devices", "2", "--inner-steps", "10", "--rounds", "1"],
      "cross-device beta swaps"),
+    ("06_recom.py",
+     ["--cpu", "--grid", "12", "--chains", "4", "--moves", "5"],
+     "executed moves/chain"),
 ]
 
 
