@@ -100,6 +100,13 @@ def main():
                     help="history thinning for the --ess recorded pass "
                          "(device-side stride; cuts the history readback "
                          "by the factor at large chain counts)")
+    ap.add_argument("--ess-host", action="store_true",
+                    help="force the host-copy f64 ESS estimator for the "
+                         "--ess recorded pass (streams the history to "
+                         "host per chunk instead of holding it "
+                         "device-resident; use for horizons whose "
+                         "(chains, steps) x 4-key f32 history would not "
+                         "fit HBM)")
     args = ap.parse_args()
     if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
             or args.warmup - 1 < args.chunk):
@@ -244,11 +251,13 @@ def main():
             g, plan, n_chains=args.chains, seed=0, spec=spec,
             base=args.base, pop_tol=args.pop_tol)
 
-        def run(states, n_steps, variant=None, record=False):
+        def run(states, n_steps, variant=None, record=False,
+                device_hist=False):
             return fce.run_chains(
                 dg, spec, params, states, n_steps=n_steps,
                 record_history=record, chunk=args.chunk,
-                record_every=args.record_every if record else 1)
+                record_every=args.record_every if record else 1,
+                history_device=device_hist)
 
     # compile + mix in (reach steady-state boundary sizes); same chunk as
     # the timed run so the timed region reuses the compiled kernel
@@ -313,16 +322,22 @@ def main():
     if args.ess:
         # recorded pass at the winning variant: effective samples of the
         # cut trajectory per wall-clock second (independent chains add).
-        # On the board path the history stays DEVICE-resident and the
-        # Sokal-windowed ESS is computed on device (stats.ess_device) —
-        # the timed region then measures sampling + diagnostics, not a
-        # (C, T) x 4 history readback (on a tunneled chip the readback
-        # alone was 18.8s vs 0.7s of chain, round-5 records). The host
-        # f64 estimator cross-checks the device value OUTSIDE the timed
-        # window ("ess_host_check": relative difference).
+        # On the board AND general paths the history stays
+        # DEVICE-resident and the Sokal-windowed ESS is computed on
+        # device (stats.ess_device) — the timed region then measures
+        # sampling + diagnostics, not a (C, T) x 4 history readback (on
+        # a tunneled chip the readback alone was 18.8s vs 0.7s of chain,
+        # round-5 records). The host f64 estimator cross-checks the
+        # device value OUTSIDE the timed window ("ess_host_check":
+        # relative difference).
         from flipcomplexityempirical_tpu.stats import ess as ess_fn
         from flipcomplexityempirical_tpu.stats import ess_device
-        dev_hist = use_board and not args.pallas
+        # both the board and general runners can keep the history
+        # device-resident for on-device diagnostics; the pallas runner
+        # still reads back, --ess-host opts out (HBM-bound horizons),
+        # and CPU runs use the host f64 estimator so fallback records
+        # stay comparable to the pre-device-diagnostics ones
+        dev_hist = not (args.pallas or args.cpu or args.ess_host)
         # compile the collect=True kernel AND the ESS kernel outside the
         # timed window — at the TIMED history length (jit specializes on
         # T; warming at the warmup length would push the n_fft=2T FFT
@@ -334,6 +349,10 @@ def main():
         else:
             warm = run(states, args.warmup, best, record=True)
         jax.block_until_ready(jax.tree.leaves(warm.state)[0])
+        # release the warm-up's full-length device history BEFORE the
+        # timed run allocates its own — holding both doubles the
+        # history's HBM watermark exactly at the headline measurement
+        del warm
         t0 = time.perf_counter()
         if dev_hist:
             res_h = run(states, args.steps, best, record=True,

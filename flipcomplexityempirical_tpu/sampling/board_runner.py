@@ -18,8 +18,9 @@ from ..graphs.lattice import LatticeGraph
 from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
-from .runner import (RunResult, default_label_values, pick_chunk,
-                     pop_bounds, snap_chunk_to, thin_outs)
+from .runner import (RunResult, assemble_history, default_label_values,
+                     maybe_host, pick_chunk, pop_bounds, snap_chunk_to,
+                     thin_outs)
 
 
 def init_board(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
@@ -70,15 +71,12 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
     chunk to host."""
     state, out_last = kboard.record_final(bg, spec, params, state)
     if record_history and (n_steps - 1) % record_every == 0:
-        if not history_device:
-            out_last = jax.tree.map(np.asarray, out_last)
+        out_last = maybe_host(out_last, history_device)
         for k, v in out_last.items():
             hist_parts.setdefault(k, []).append(v[:, None])
     state = drain_waits(state, pending_waits)
     waits_total = _sum_pending(waits_total, pending_waits)
-    xp = jnp if history_device else np
-    history = ({k: xp.concatenate(v, axis=1) for k, v in hist_parts.items()}
-               if record_history else {})
+    history = assemble_history(hist_parts, record_history, history_device)
     return RunResult(state=state, history=history,
                      waits_total=waits_total, n_yields=n_steps)
 
@@ -120,18 +118,15 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
         if record_history:
             # board chunks record BEFORE transitioning, so block-local
             # index 0 is already on the global grid
-            outs = thin_outs(outs, record_every, offset=0)
-            if not history_device:
-                outs = jax.tree.map(np.asarray, outs)
+            outs = maybe_host(thin_outs(outs, record_every, offset=0),
+                              history_device)
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (T, C) -> (C, T)
         state = drain_waits(state, pending_waits)
         done += this
 
     waits_total = _sum_pending(waits_total, pending_waits)
-    xp = jnp if history_device else np
-    history = ({k: xp.concatenate(v, axis=1) for k, v in hist_parts.items()}
-               if record_history and hist_parts else {})
+    history = assemble_history(hist_parts, record_history, history_device)
     return RunResult(state=state, history=history,
                      waits_total=waits_total, n_yields=n_transitions)
 

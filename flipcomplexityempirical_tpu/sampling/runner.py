@@ -28,7 +28,9 @@ from ..kernel.step import Spec, StepParams
 @dataclasses.dataclass
 class RunResult:
     state: ChainState            # batched final state (device)
-    history: dict                # name -> np.ndarray (C, T) when recorded
+    history: dict                # name -> (C, T) array when recorded:
+                                 # np.ndarray, or jax.Array under
+                                 # history_device=True
     waits_total: np.ndarray      # float64 (C,) host-accumulated sum of waits
     n_yields: int
 
@@ -117,6 +119,22 @@ def _record_initial(dg: DeviceGraph, spec: Spec, params: StepParams,
                     in_axes=(paxes, 0))(params, states)
 
 
+def maybe_host(outs, history_device: bool):
+    """History block host copy, skipped when the history is to stay
+    device-resident (shared by the general and board runners)."""
+    return outs if history_device else jax.tree.map(np.asarray, outs)
+
+
+def assemble_history(hist_parts, record_history: bool,
+                     history_device: bool) -> dict:
+    """Concatenate per-chunk history parts along T with the backend the
+    ``history_device`` contract promises (jnp arrays vs numpy)."""
+    if not (record_history and hist_parts):
+        return {}
+    xp = jnp if history_device else np
+    return {k: xp.concatenate(v, axis=1) for k, v in hist_parts.items()}
+
+
 def thin_outs(outs: dict, every: int, offset: Optional[int] = None):
     """Device-side stride of a chunk's (T, C) history block BEFORE host
     transfer: keeps a 1e4-chain x 1e5-step recorded run inside host RAM
@@ -143,7 +161,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                record_history: bool = True,
                chunk: Optional[int] = None,
                record_initial: bool = True,
-               record_every: int = 1) -> RunResult:
+               record_every: int = 1,
+               history_device: bool = False) -> RunResult:
     """Run the batched chain for ``n_steps`` yields (the first yield is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
 
@@ -155,6 +174,14 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     accumulators — cut_times, flip counts, waits — still advance every
     step; only the returned history is strided). When continuing a run,
     segment lengths divisible by k keep the grid uniform across segments.
+
+    ``history_device=True`` skips the per-chunk host copy and returns the
+    history as device arrays (costs (C, T_recorded) HBM per key) — the
+    input to device-side diagnostics (stats.ess_device), same contract
+    as the board runner's flag. On a tunneled chip the history readback
+    alone dwarfed the sampling wall clock (PROFILE.md round-5 ESS
+    records), and the general path serves exactly the graphs the big
+    sweeps run on (sec11, frank, dual).
     """
     n_chains = states.assignment.shape[0]
     if record_every < 1:
@@ -166,8 +193,11 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
 
     if record_initial:
         states, out0 = _record_initial(dg, spec, params, states)
-        hist_parts = {k: [np.asarray(v)[:, None]] for k, v in out0.items()} \
-            if record_history else None
+        if record_history:
+            out0 = maybe_host(out0, history_device)
+            hist_parts = {k: [v[:, None]] for k, v in out0.items()}
+        else:
+            hist_parts = None
         done = 1
     else:
         hist_parts = {} if record_history else None
@@ -182,14 +212,13 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         states, outs = _run_chunk(dg, spec, params, states, this,
                                   collect=record_history)
         if record_history:
-            outs = jax.tree.map(np.asarray, thin_outs(outs, record_every))
+            outs = maybe_host(thin_outs(outs, record_every), history_device)
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (chunk, C)->(C,)
         waits_total += np.asarray(states.waits_sum, np.float64)
         states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
         done += this
 
-    history = ({k: np.concatenate(v, axis=1) for k, v in hist_parts.items()}
-               if record_history else {})
+    history = assemble_history(hist_parts, record_history, history_device)
     return RunResult(state=states, history=history,
                      waits_total=waits_total, n_yields=n_steps)

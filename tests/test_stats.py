@@ -274,3 +274,30 @@ def test_run_board_history_device_identity():
     for k in runs[False]:
         np.testing.assert_array_equal(np.asarray(runs[True][k]),
                                       runs[False][k])
+
+
+def test_run_chains_history_device_identity():
+    """The general runner's history_device=True returns the SAME history
+    as the host path (device arrays, values identical), across chunk
+    boundaries, the initial record, and record_every thinning — the
+    device-diagnostics input for the graphs the big sweeps run on
+    (sec11/frank/dual are not board-eligible)."""
+    import jax
+    g = fce.graphs.square_grid(6, 6)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    for every in (1, 5):
+        runs = {}
+        for dev in (False, True):
+            dg, st, params = fce.init_batch(
+                g, plan, n_chains=4, seed=0, spec=spec, base=1.3,
+                pop_tol=0.4)
+            res = fce.run_chains(dg, spec, params, st, n_steps=101,
+                                 chunk=25, record_every=every,
+                                 history_device=dev)
+            runs[dev] = res.history
+        assert all(isinstance(v, jax.Array) for v in runs[True].values())
+        assert set(runs[True]) == set(runs[False])
+        for k in runs[False]:
+            np.testing.assert_array_equal(np.asarray(runs[True][k]),
+                                          runs[False][k])
