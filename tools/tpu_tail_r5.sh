@@ -41,7 +41,7 @@ healthy() { vsb_at_least "$1" 15.0; }
 while true; do
   n_def=$(find bench_runs -maxdepth 1 -name '*_tail_default.json' -size +1c | wc -l)
   if [ "$n_def" -ge 8 ] && have tail_pallas && have tail_ess8192 \
-      && have tail_pair_k4_c8192; then
+      && have tail_pair_k4_c8192 && have tail_ess_general; then
     exit 0
   fi
   if timeout 150 python -c \
@@ -65,6 +65,10 @@ while true; do
         || run_bench_min 12.0 tail_ess8192 1200 --ess --chains 8192 || true
       have tail_pair_k4_c8192 \
         || run_bench_min 6.0 tail_pair_k4_c8192 900 --k 4 --chains 8192 || true
+      # exercises the round-5 general-path device-resident history on
+      # silicon (flips floor well under the path's stable 0.30x record)
+      have tail_ess_general \
+        || run_bench_min 0.2 tail_ess_general 1200 --general --ess || true
     fi
     case "$health" in /tmp/*) rm -f "$health";; esac
     sleep 2700
