@@ -41,7 +41,8 @@ SEEDS = list(range(1, 16))
 
 FAMILIES = {
     "sec11": {
-        "cells": {"B263": MU, "B695": MU ** 2, "B1000": 10.0},
+        "cells": {"B263": MU, "B400": 4.0, "B695": MU ** 2,
+                  "B1000": 10.0},
         "ref_dir": "/root/reference/New_plots/sec11",
         "ref_cells": 15,  # 3 alignments x 5 pops
         "record": os.path.join(_SEEDS_DIR, "multiseed_sec11.json"),
