@@ -56,3 +56,65 @@ def ess_device(x, c: float = 5.0):
     tau = jnp.maximum(2.0 * (rho * window[None, :]).sum(axis=1) - 1.0, 1.0)
     per = t / tau
     return per, per.sum()
+
+
+@jax.jit
+def conductance_profile_device(x, thresholds):
+    """Device twin of ``bottleneck.conductance_profile`` for a (C, T)
+    device history: Phi(S_r) over level sets S_r = {f <= r}, the paper's
+    bottleneck-ratio estimator, without the history readback.
+
+    ``thresholds`` must be a sorted concrete array (jit shapes the
+    bincounts by its static length; the host default of "unique observed
+    values" is data-dependent and cannot be shaped — pass e.g.
+    ``jnp.arange(lo, hi + 1)`` for integer observables like cut counts,
+    or a linspace). For f32-representable observables (every integer
+    trajectory this framework records) the occupancy and crossing counts
+    are exact and only the final division is f32 vs the host's f64
+    (tests pin parity). A continuous observable is BINNED in f32 here vs
+    f64 on host, so samples within f32 epsilon of a threshold may land
+    on the other side of it — prefer thresholds away from data values in
+    that regime.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    if x.ndim == 1:
+        x = x[None, :]
+    if x.shape[1] < 2:
+        # static shape: raise at trace time like the host path, instead
+        # of 0/0 -> all-NaN masquerading as the frozen-observable verdict
+        raise ValueError("need T >= 2 transitions")
+    thresholds = jnp.asarray(thresholds, jnp.float32)
+    nb = thresholds.shape[0]
+    cur = x[:, :-1].ravel()
+    nxt = x[:, 1:].ravel()
+    n_trans = cur.shape[0]
+    # bin once: b(v) = first threshold index >= v, so v <= thresholds[i]
+    # iff b(v) <= i (same trick as the host path)
+    bc = jnp.searchsorted(thresholds, cur, side="left")
+    bn = jnp.searchsorted(thresholds, nxt, side="left")
+    occ = jnp.cumsum(jnp.bincount(bc, length=nb + 1)[:nb]) / n_trans
+    # transitions crossing out of S_i (b(cur) <= i < b(nxt)) accumulate
+    # via a difference array; non-crossing rows park in the dropped slot
+    out = bc < bn
+    diff = (jnp.bincount(jnp.where(out, bc, nb), length=nb + 1)
+            - jnp.bincount(jnp.where(out, bn, nb), length=nb + 1))
+    crossings = jnp.cumsum(diff[:nb]).astype(jnp.float32)
+    two_sided = (occ > 0.0) & (occ < 1.0)
+    denom = jnp.minimum(occ, 1.0 - occ)
+    phi = jnp.where(two_sided,
+                    (crossings / n_trans) / jnp.where(two_sided, denom, 1.0),
+                    jnp.nan)
+    return thresholds, phi
+
+
+@jax.jit
+def bottleneck_ratio_device(x, thresholds):
+    """Device twin of ``bottleneck.bottleneck_ratio``: ``(phi_star,
+    r_star)`` = the minimum Phi(S_r) and its threshold, ``(nan, nan)``
+    when no level set is two-sided (frozen observable)."""
+    thresholds, phi = conductance_profile_device(x, thresholds)
+    filled = jnp.where(jnp.isnan(phi), jnp.inf, phi)
+    i = jnp.argmin(filled)
+    bad = jnp.isinf(filled[i])
+    return (jnp.where(bad, jnp.nan, phi[i]),
+            jnp.where(bad, jnp.nan, thresholds[i]))

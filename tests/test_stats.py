@@ -301,3 +301,55 @@ def test_run_chains_history_device_identity():
         for k in runs[False]:
             np.testing.assert_array_equal(np.asarray(runs[True][k]),
                                           runs[False][k])
+
+
+def test_bottleneck_device_matches_host():
+    """conductance_profile_device / bottleneck_ratio_device agree with the
+    host f64 estimators on shared explicit thresholds: the counts are
+    exact integer arithmetic on both sides, so only the final f32 divide
+    differs. Covers a metastable two-well walk, a frozen observable
+    (NaN contract), and 1-D input promotion."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    # two-well walk: values cluster near 10 and 30 with rare crossings
+    c, t = 6, 400
+    wells = rng.integers(0, 2, size=(c, 1)) * 20 + 10
+    x = wells + rng.integers(-3, 4, size=(c, t))
+    flips = rng.random((c, t)) < 0.01
+    x = np.where(np.cumsum(flips, axis=1) % 2 == 1, 40 - x, x).astype(
+        np.float64)
+    thr = np.arange(x.min(), x.max() + 1, dtype=np.float64)
+
+    th_h, phi_h = stats.conductance_profile(x, thr)
+    th_d, phi_d = stats.conductance_profile_device(jnp.asarray(x), thr)
+    np.testing.assert_array_equal(np.asarray(th_d), th_h)
+    np.testing.assert_array_equal(np.isnan(np.asarray(phi_d)),
+                                  np.isnan(phi_h))
+    m = ~np.isnan(phi_h)
+    np.testing.assert_allclose(np.asarray(phi_d)[m], phi_h[m], rtol=1e-5)
+
+    ph_h, r_h = stats.bottleneck_ratio(x, thr)
+    ph_d, r_d = stats.bottleneck_ratio_device(jnp.asarray(x), thr)
+    assert float(r_d) == r_h
+    np.testing.assert_allclose(float(ph_d), ph_h, rtol=1e-5)
+
+    # frozen observable: every level set one-sided -> (nan, nan)
+    frozen = np.full((3, 50), 7.0)
+    ph_d, r_d = stats.bottleneck_ratio_device(jnp.asarray(frozen),
+                                              np.array([7.0]))
+    assert np.isnan(float(ph_d)) and np.isnan(float(r_d))
+
+    # 1-D promotion matches host
+    ph_h, r_h = stats.bottleneck_ratio(x[0], thr)
+    ph_d, r_d = stats.bottleneck_ratio_device(jnp.asarray(x[0]), thr)
+    np.testing.assert_allclose(float(ph_d), ph_h, rtol=1e-5)
+    assert float(r_d) == r_h
+
+
+def test_bottleneck_device_rejects_single_yield():
+    """T=1 raises at trace time (host parity), rather than returning the
+    frozen-observable (nan, nan) verdict for a mis-sliced history."""
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match="T >= 2"):
+        stats.conductance_profile_device(jnp.zeros((3, 1)),
+                                         np.array([0.0]))
