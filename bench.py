@@ -361,15 +361,32 @@ def main():
         else:
             res_h = run(states, args.steps, best, record=True)
             jax.block_until_ready(jax.tree.leaves(res_h.state)[0])
-            _, ess_total = ess_fn(np.asarray(res_h.history["cut_count"],
-                                             np.float64))
+            hist64 = np.asarray(res_h.history["cut_count"], np.float64)
+            _, ess_total = ess_fn(hist64)
         d_rec = time.perf_counter() - t0
+        # OUTSIDE the timed window (ESS/s stays comparable to earlier
+        # records): the correctness-bar bottleneck ratio of the same
+        # recorded trajectory, on the estimator matching the history's
+        # residency (cut counts are integers, so both bin identically)
+        if dev_hist:
+            from flipcomplexityempirical_tpu.stats import (
+                bottleneck_ratio_device)
+            hist = res_h.history["cut_count"]
+            thr = jnp.arange(float(hist.min()), float(hist.max()) + 1.0)
+            phi, r_star = (float(v)
+                           for v in bottleneck_ratio_device(hist, thr))
+        else:
+            from flipcomplexityempirical_tpu.stats import bottleneck_ratio
+            phi, r_star = bottleneck_ratio(hist64)
         meta_ess = {
             "metric": "cut_ess_per_sec",
             "ess_total": round(float(ess_total), 1),
             "recorded_seconds": round(d_rec, 3),
             "value": round(float(ess_total) / d_rec, 2),
             "ess_on_device": dev_hist,
+            # null (not NaN, which is invalid JSON) for a frozen observable
+            "bottleneck_phi": (None if np.isnan(phi) else round(phi, 6)),
+            "bottleneck_r": (None if np.isnan(r_star) else r_star),
         }
         if dev_hist:
             _, host_total = ess_fn(np.asarray(res_h.history["cut_count"],

@@ -69,12 +69,13 @@ def conductance_profile_device(x, thresholds):
     values" is data-dependent and cannot be shaped — pass e.g.
     ``jnp.arange(lo, hi + 1)`` for integer observables like cut counts,
     or a linspace). For f32-representable observables (every integer
-    trajectory this framework records) the occupancy and crossing counts
-    are exact and only the final division is f32 vs the host's f64
-    (tests pin parity). A continuous observable is BINNED in f32 here vs
-    f64 on host, so samples within f32 epsilon of a threshold may land
-    on the other side of it — prefer thresholds away from data values in
-    that regime.
+    trajectory this framework records) the occupancy/crossing counts and
+    the two-sided mask are exact int32 arithmetic (valid up to 2^31
+    transitions = C*(T-1)) and only ONE final division is f32 vs the
+    host's f64 (tests pin parity). A continuous observable is BINNED in
+    f32 here vs f64 on host, so samples within f32 epsilon of a
+    threshold may land on the other side of it — prefer thresholds away
+    from data values in that regime.
     """
     x = jnp.asarray(x, jnp.float32)
     if x.ndim == 1:
@@ -92,18 +93,26 @@ def conductance_profile_device(x, thresholds):
     # iff b(v) <= i (same trick as the host path)
     bc = jnp.searchsorted(thresholds, cur, side="left")
     bn = jnp.searchsorted(thresholds, nxt, side="left")
-    occ = jnp.cumsum(jnp.bincount(bc, length=nb + 1)[:nb]) / n_trans
+    counts = jnp.cumsum(jnp.bincount(bc, length=nb + 1)[:nb])
     # transitions crossing out of S_i (b(cur) <= i < b(nxt)) accumulate
     # via a difference array; non-crossing rows park in the dropped slot
     out = bc < bn
     diff = (jnp.bincount(jnp.where(out, bc, nb), length=nb + 1)
             - jnp.bincount(jnp.where(out, bn, nb), length=nb + 1))
-    crossings = jnp.cumsum(diff[:nb]).astype(jnp.float32)
-    two_sided = (occ > 0.0) & (occ < 1.0)
-    denom = jnp.minimum(occ, 1.0 - occ)
-    phi = jnp.where(two_sided,
-                    (crossings / n_trans) / jnp.where(two_sided, denom, 1.0),
-                    jnp.nan)
+    crossings = jnp.cumsum(diff[:nb])
+    # the two-sided mask and the denominator stay EXACT integers — an
+    # occupancy division in f32 would round a level set missing only a
+    # few of >2^24 transitions to exactly 1.0 and mask a finite phi the
+    # host estimator reports (the headline config is 24.6M transitions).
+    # Host algebra (c/n)/min(m/n, (n-m)/n) == c/min(m, n-m): one final
+    # f32 divide carries the only rounding
+    two_sided = (counts > 0) & (counts < n_trans)
+    min_count = jnp.minimum(counts, n_trans - counts)
+    phi = jnp.where(
+        two_sided,
+        crossings.astype(jnp.float32)
+        / jnp.where(two_sided, min_count, 1).astype(jnp.float32),
+        jnp.nan)
     return thresholds, phi
 
 
