@@ -377,7 +377,12 @@ def main():
                            for v in bottleneck_ratio_device(hist, thr))
         else:
             from flipcomplexityempirical_tpu.stats import bottleneck_ratio
-            phi, r_star = bottleneck_ratio(hist64)
+            # same integer level-set grid as the device path — the host
+            # default would fall back to a 257-point linspace past 256
+            # distinct values, making records non-comparable across
+            # ess_on_device true/false
+            phi, r_star = bottleneck_ratio(
+                hist64, np.arange(hist64.min(), hist64.max() + 1.0))
         meta_ess = {
             "metric": "cut_ess_per_sec",
             "ess_total": round(float(ess_total), 1),

@@ -127,3 +127,39 @@ def bottleneck_ratio_device(x, thresholds):
     bad = jnp.isinf(filled[i])
     return (jnp.where(bad, jnp.nan, phi[i]),
             jnp.where(bad, jnp.nan, thresholds[i]))
+
+
+@jax.jit
+def gelman_rubin_device(x):
+    """Device twin of ``diagnostics.gelman_rubin`` (split R-hat): chains
+    halved, within/between variances, sqrt(var_plus / W) — the
+    convergence reading of a device-resident history without readback.
+    f32 vs the host's f64; the frozen contracts match (1.0 when every
+    half-chain is constant AND they agree, inf when constant halves
+    disagree)."""
+    x = jnp.asarray(x, jnp.float32)
+    if x.ndim == 1:
+        x = x[None, :]
+    t = x.shape[1]
+    half = t // 2
+    if half < 2:
+        raise ValueError("need T >= 4 for split R-hat")
+    halves = jnp.concatenate([x[:, :half], x[:, t - half:]], axis=0)
+    n = halves.shape[1]
+    means = halves.mean(axis=1)
+    variances = halves.var(axis=1, ddof=1)
+    w = variances.mean()
+    b = n * means.var(ddof=1)
+    var_plus = (n - 1) / n * w + b / n
+    # frozen contract under f32+jit: XLA's fused variance leaves
+    # eps-scale residue on constant inputs (observed ~1e-15 for b on
+    # identical 3.0s), so BOTH zero tests carry a scale-relative
+    # tolerance, and agreement is judged on the SPREAD of the half-chain
+    # means rather than on b's residue. A genuinely mixing observable
+    # has w and spread orders of magnitude above these floors.
+    scale = jnp.abs(halves).max()
+    frozen = w <= 1e-6 * scale * scale + 1e-30
+    spread = means.max() - means.min()
+    return jnp.where(
+        ~frozen, jnp.sqrt(var_plus / jnp.where(frozen, 1.0, w)),
+        jnp.where(spread > 1e-6 * scale, jnp.inf, 1.0))

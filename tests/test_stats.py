@@ -353,3 +353,33 @@ def test_bottleneck_device_rejects_single_yield():
     with pytest.raises(ValueError, match="T >= 2"):
         stats.conductance_profile_device(jnp.zeros((3, 1)),
                                          np.array([0.0]))
+
+
+def test_gelman_rubin_device_matches_host():
+    """Split R-hat device twin: f32 parity with the host f64 estimator
+    plus both frozen contracts (agreeing constants -> 1.0, disagreeing
+    constants -> inf)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(11)
+    # metastable: chains offset by wells -> R-hat far from 1
+    x = (rng.integers(0, 2, size=(6, 1)) * 20
+         + rng.normal(0, 2, size=(6, 200))).astype(np.float64)
+    np.testing.assert_allclose(
+        float(stats.gelman_rubin_device(jnp.asarray(x))),
+        stats.gelman_rubin(x), rtol=1e-5)
+    # well-mixed: close to 1 on both
+    y = rng.normal(0, 1, size=(6, 500))
+    np.testing.assert_allclose(
+        float(stats.gelman_rubin_device(jnp.asarray(y))),
+        stats.gelman_rubin(y), rtol=1e-5)
+    frozen_agree = np.full((4, 50), 3.0)
+    assert float(stats.gelman_rubin_device(jnp.asarray(frozen_agree))) == 1.0
+    # f32-inexact constant: the fused-variance residue must not bypass
+    # the frozen contract through a tiny nonzero w
+    frozen_tenth = np.full((4, 50), 0.1)
+    assert float(stats.gelman_rubin_device(jnp.asarray(frozen_tenth))) == 1.0
+    frozen_disagree = np.repeat([[1.0], [2.0]], 50, axis=1)
+    assert np.isinf(float(stats.gelman_rubin_device(
+        jnp.asarray(frozen_disagree))))
+    with pytest.raises(ValueError, match="T >= 4"):
+        stats.gelman_rubin_device(jnp.zeros((2, 3)))
