@@ -1,0 +1,129 @@
+"""The TPU-capture helpers (tools/bench_lib.sh) hold the round's
+benchmark-record integrity: every failure shape must be quarantined by
+rename so record globs and the tail watchdog's completion gates only
+ever see real committed records, and capture commits must be
+pathspec'd so they never sweep up unrelated staged work. Driven here
+against a stubbed bench.py in a throwaway git repo — exactly the
+scenario matrix the round-5 reviews demanded (failed / cpu_fallback /
+below-floor / commit-race / success)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STUB = """\
+import json, os, sys
+mode = os.environ.get("STUB", "ok")
+if mode == "fail":
+    sys.exit(1)
+if mode == "fallback":
+    print(json.dumps({"value": 1, "vs_baseline": None,
+                      "cpu_fallback": True})); sys.exit(0)
+if mode == "low":
+    print(json.dumps({"value": 1, "vs_baseline": 0.72})); sys.exit(0)
+print(json.dumps({"value": 1, "vs_baseline": 16.3}))
+"""
+
+
+@pytest.fixture()
+def sandbox(tmp_path):
+    for tool in ("git", "bash", "timeout", "python"):
+        if shutil.which(tool) is None:
+            pytest.skip(f"{tool} unavailable (bench_lib.sh hardcodes it)")
+    (tmp_path / "bench_runs").mkdir()
+    (tmp_path / "bench.py").write_text(STUB)
+    shutil.copy(os.path.join(REPO, "tools", "bench_lib.sh"),
+                tmp_path / "bench_lib.sh")
+    run = lambda *cmd: subprocess.run(cmd, cwd=tmp_path, check=True,
+                                      capture_output=True)
+    run("git", "init", "-q", ".")
+    run("git", "config", "user.email", "t@t")
+    run("git", "config", "user.name", "t")
+    (tmp_path / "README").write_text("x")
+    run("git", "add", "README")
+    run("git", "commit", "-q", "-m", "init")
+    return tmp_path
+
+
+def drive(sandbox, stub_mode, cmd):
+    """Source bench_lib.sh and run one helper invocation under the stub."""
+    env = dict(os.environ, STUB=stub_mode, TS="TEST")
+    return subprocess.run(
+        ["bash", "-c", f". ./bench_lib.sh; {cmd}"],
+        cwd=sandbox, env=env, capture_output=True, text=True)
+
+
+def bench_files(sandbox):
+    return sorted(os.listdir(sandbox / "bench_runs"))
+
+
+def test_failure_shapes_are_quarantined(sandbox):
+    r = drive(sandbox, "fail", "run_bench t1 60")
+    assert r.returncode == 1
+    assert "TEST_t1.json.failed" in bench_files(sandbox)
+    assert "TEST_t1.json" not in bench_files(sandbox)
+
+    r = drive(sandbox, "fallback", "run_bench t2 60")
+    assert r.returncode == 1
+    assert "TEST_t2.json.fallback" in bench_files(sandbox)
+
+    r = drive(sandbox, "low", "run_bench_min 2.0 t3 60")
+    assert r.returncode == 1
+    assert "TEST_t3.json.suspect" in bench_files(sandbox)
+
+    # no quarantined shape satisfies a record glob
+    import glob
+    assert glob.glob(str(sandbox / "bench_runs" / "*_t1.json")) == []
+
+
+def test_success_commits_only_the_record(sandbox):
+    # unrelated staged work must survive a capture commit untouched
+    (sandbox / "unrelated.txt").write_text("wip")
+    subprocess.run(["git", "add", "unrelated.txt"], cwd=sandbox, check=True)
+    r = drive(sandbox, "ok", "run_bench_min 2.0 t4 60")
+    assert r.returncode == 0, r.stderr
+    assert "TEST_t4.json" in bench_files(sandbox)
+    show = subprocess.run(
+        ["git", "show", "--stat", "--format=%s", "HEAD"],
+        cwd=sandbox, capture_output=True, text=True).stdout
+    # the commit SUBJECT also contains the basename, so assert the
+    # stat PATH — a pathspec regression must not hide behind it
+    assert "bench_runs/TEST_t4.json" in show
+    assert "unrelated" not in show
+    status = subprocess.run(["git", "status", "--short"], cwd=sandbox,
+                            capture_output=True, text=True).stdout
+    assert "A  unrelated.txt" in status
+
+
+def test_floor_only_applies_when_set(sandbox):
+    r = drive(sandbox, "low", "run_bench t5 60")
+    assert r.returncode == 0, r.stderr   # bare run_bench has no floor
+    assert "TEST_t5.json" in bench_files(sandbox)
+
+
+def test_commit_race_quarantines_uncommitted(sandbox):
+    """commit_retry exhaustion (here: a held index.lock) must rename
+    the valid record to *.uncommitted so the watchdog gates retry it
+    next window instead of counting an uncommitted file as done."""
+    (sandbox / ".git" / "index.lock").write_text("")
+    # shim sleep so the 5 retry backoffs are instant
+    r = drive(sandbox, "ok", "sleep(){ :; }; run_bench t6 60")
+    assert r.returncode == 1
+    assert "TEST_t6.json.uncommitted" in bench_files(sandbox)
+    assert "TEST_t6.json" not in bench_files(sandbox)
+
+
+def test_vsb_at_least_gate(sandbox):
+    f = sandbox / "bench_runs" / "x.json"
+    for content, floor, expect in (
+            ('{"vs_baseline": 16.4}', "15", 0),
+            ('{"vs_baseline": 13.9}', "15", 1),
+            ('{"vs_baseline": null}', "1", 1),
+            ("", "1", 1)):
+        f.write_text(content)
+        r = drive(sandbox, "ok", f"vsb_at_least bench_runs/x.json {floor}")
+        assert r.returncode == expect, (content, floor, r.returncode)
