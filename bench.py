@@ -370,11 +370,10 @@ def main():
         # residency (cut counts are integers, so both bin identically)
         if dev_hist:
             from flipcomplexityempirical_tpu.stats import (
-                bottleneck_ratio_device)
+                bottleneck_ratio_device, integer_thresholds)
             hist = res_h.history["cut_count"]
-            thr = jnp.arange(float(hist.min()), float(hist.max()) + 1.0)
-            phi, r_star = (float(v)
-                           for v in bottleneck_ratio_device(hist, thr))
+            phi, r_star = (float(v) for v in bottleneck_ratio_device(
+                hist, integer_thresholds(hist)))
         else:
             from flipcomplexityempirical_tpu.stats import bottleneck_ratio
             # same integer level-set grid as the device path — the host

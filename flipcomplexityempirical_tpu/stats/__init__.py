@@ -21,11 +21,12 @@ from .partisan import (
 from .compactness import polsby_popper, cut_edge_count, perimeter_area
 from .device import (bottleneck_ratio_device,
                      conductance_profile_device, ess_device,
-                     gelman_rubin_device)
+                     gelman_rubin_device, integer_thresholds)
 
 __all__ = [
     "autocorrelation", "integrated_autocorr_time", "ess", "ess_device", "bottleneck_ratio_device",
-    "conductance_profile_device", "gelman_rubin_device", "gelman_rubin",
+    "conductance_profile_device", "gelman_rubin_device",
+    "integer_thresholds", "gelman_rubin",
     "autocorr_mixing_time", "round_trips", "well_crossings",
     "conductance_profile", "bottleneck_ratio",
     "district_vote_tallies", "mean_median", "efficiency_gap", "seats_won",

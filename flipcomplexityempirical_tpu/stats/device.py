@@ -163,3 +163,17 @@ def gelman_rubin_device(x):
     return jnp.where(
         ~frozen, jnp.sqrt(var_plus / jnp.where(frozen, 1.0, w)),
         jnp.where(spread > 1e-6 * scale, jnp.inf, 1.0))
+
+
+def integer_thresholds(x):
+    """Concrete integer level-set grid spanning a device history's range
+    — the required ``thresholds`` boilerplate for integer observables
+    (cut counts), shared by bench.py and the examples. One fused min/max
+    readback: jit shapes the profile's bincounts by the grid's STATIC
+    length, so the bounds must be concrete Python numbers."""
+    import math
+
+    lo, hi = (float(v) for v in
+              jax.device_get(jnp.stack([jnp.min(x), jnp.max(x)])))
+    return jnp.arange(math.floor(lo), math.ceil(hi) + 1.0,
+                      dtype=jnp.float32)

@@ -383,3 +383,11 @@ def test_gelman_rubin_device_matches_host():
         jnp.asarray(frozen_disagree))))
     with pytest.raises(ValueError, match="T >= 4"):
         stats.gelman_rubin_device(jnp.zeros((2, 3)))
+
+
+def test_integer_thresholds_grid():
+    """The shared threshold builder spans the observed range inclusively
+    on integer bounds (concrete values, jit-shapeable length)."""
+    import jax.numpy as jnp
+    thr = stats.integer_thresholds(jnp.asarray([[2.0, 5.0], [3.0, 4.0]]))
+    np.testing.assert_array_equal(np.asarray(thr), [2.0, 3.0, 4.0, 5.0])
