@@ -34,7 +34,6 @@ accept rate) goes to stderr as a second JSON object.
 """
 
 import argparse
-import contextlib
 import json
 import sys
 import time
@@ -84,7 +83,15 @@ def main():
     ap.add_argument("--block-chains", type=int, default=128)
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="wrap the timed region in a jax.profiler trace "
-                         "written to DIR (SURVEY.md section 5 tracing)")
+                         "written to DIR (SURVEY.md section 5 tracing; "
+                         "the shared obs.profile_region hook)")
+    ap.add_argument("--events", metavar="PATH", default=None,
+                    help="append structured telemetry (obs JSONL: "
+                         "run_start/chunk/compile/run_end with per-chunk "
+                         "flips/s, accept rate, transfer bytes) to PATH; "
+                         "'-' streams to stderr. Fold with "
+                         "tools/obs_report.py. The default null recorder "
+                         "keeps the timed region un-instrumented")
     ap.add_argument("--repeats", type=int, default=None,
                     help="timed-region repetitions; the reported rate is "
                          "the best (throughput benchmarks should not be "
@@ -172,7 +179,10 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     import flipcomplexityempirical_tpu as fce
+    from flipcomplexityempirical_tpu import obs
     from flipcomplexityempirical_tpu.kernel import board as kboard
+
+    rec = obs.from_spec(args.events)
 
     g = fce.graphs.square_grid(args.grid, args.grid)
     plan = fce.graphs.stripes_plan(g, args.k)
@@ -245,7 +255,7 @@ def main():
                     bg, spec, params, states, n_steps=n_steps,
                     record_history=record, chunk=args.chunk, bits=variant,
                     record_every=args.record_every if record else 1,
-                    history_device=device_hist)
+                    history_device=device_hist, recorder=rec)
     else:
         dg, states, params = fce.init_batch(
             g, plan, n_chains=args.chains, seed=0, spec=spec,
@@ -257,7 +267,7 @@ def main():
                 dg, spec, params, states, n_steps=n_steps,
                 record_history=record, chunk=args.chunk,
                 record_every=args.record_every if record else 1,
-                history_device=device_hist)
+                history_device=device_hist, recorder=rec)
 
     # compile + mix in (reach steady-state boundary sizes); same chunk as
     # the timed run so the timed region reuses the compiled kernel
@@ -280,8 +290,7 @@ def main():
         # one body only under --profile, so the trace holds exactly one
         # kernel's timed region (the auto-dispatched body)
         variants = variants[:1]
-    prof = (jax.profiler.trace(args.profile) if args.profile
-            else contextlib.nullcontext())
+    prof = obs.profile_region(args.profile)
     repeats = args.repeats if args.repeats else (1 if args.profile else 2)
     dt = float("inf")
     best = variants[0]
@@ -429,6 +438,7 @@ def main():
         # probe failed; vs_baseline still divides by the PER-CHIP target
         headline["cpu_fallback"] = True
     print(json.dumps(headline))
+    rec.close()
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ sweeps, metrics, and artifact pipeline reproduced on top.
 
 __version__ = "0.1.0"
 
+from . import obs  # noqa: F401
 from . import graphs  # noqa: F401
 from . import compat  # noqa: F401
 from . import state  # noqa: F401

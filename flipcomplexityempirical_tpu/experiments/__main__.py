@@ -7,7 +7,9 @@ Runs the reference sweep grids with skip-if-done resume, emitting the
 """
 
 import argparse
+import os
 
+from ..obs import from_spec
 from .config import SWEEPS
 from .driver import run_sweep
 
@@ -32,6 +34,15 @@ def main():
                          "from the last saved segment")
     ap.add_argument("--only", nargs="*", default=None,
                     help="config tags to run, e.g. 2B30P10")
+    ap.add_argument("--events", metavar="PATH", default=None,
+                    help="append structured telemetry (obs JSONL: sweep "
+                         "progress, per-chunk runner metrics, compile "
+                         "events) to PATH; '-' streams to stderr; fold "
+                         "with tools/obs_report.py")
+    ap.add_argument("--heartbeat", metavar="PATH", default=None,
+                    help="sweep progress heartbeat JSON (atomically "
+                         "refreshed around every config); defaults to "
+                         "OUT/heartbeat.json")
     ap.add_argument("--dual-source", choices=["quads", "voronoi"],
                     default="quads",
                     help="dual family geometry: jittered-quad lattice or "
@@ -64,7 +75,10 @@ def main():
     configs = list(sweep(**overrides))
     if args.only:
         configs = [c for c in configs if c.tag in set(args.only)]
-    run_sweep(configs, args.out, checkpoint_dir=args.checkpoint_dir)
+    heartbeat = args.heartbeat or os.path.join(args.out, "heartbeat.json")
+    with from_spec(args.events) as rec:
+        run_sweep(configs, args.out, checkpoint_dir=args.checkpoint_dir,
+                  recorder=rec, heartbeat=heartbeat)
 
 
 if __name__ == "__main__":

@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from .. import compat
+from .. import compat, obs
 from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
                       square_grid, triangular_lattice, hex_lattice,
                       stripes_plan, from_geojson, synthetic_precincts,
@@ -115,8 +115,16 @@ def is_done(cfg: ExperimentConfig, outdir: str) -> bool:
                for k in artifact_kinds(cfg.family))
 
 
+def count_artifacts(cfg: ExperimentConfig, outdir: str) -> int:
+    """How many of a config's manifest artifacts exist on disk (the
+    sweep telemetry's per-config completion reading)."""
+    return sum(os.path.exists(os.path.join(outdir, cfg.tag + k))
+               for k in artifact_kinds(cfg.family))
+
+
 def run_config(cfg: ExperimentConfig, outdir: str,
-               checkpoint_dir: Optional[str] = None) -> dict:
+               checkpoint_dir: Optional[str] = None,
+               recorder=None) -> dict:
     os.makedirs(outdir, exist_ok=True)
     g, plan, geo = build_graph_and_plan(cfg)
     labels = _labels_for(cfg)
@@ -133,9 +141,10 @@ def run_config(cfg: ExperimentConfig, outdir: str,
     elif cfg.backend != "jax":
         raise ValueError(f"backend {cfg.backend!r}")
     elif cfg.family == "temper":
-        data = _run_temper(cfg, g, plan, checkpoint_dir)
+        data = _run_temper(cfg, g, plan, checkpoint_dir,
+                           recorder=recorder)
     else:
-        data = _run_jax(cfg, g, plan, checkpoint_dir)
+        data = _run_jax(cfg, g, plan, checkpoint_dir, recorder=recorder)
     data["seconds"] = time.time() - t0
     if cfg.n_districts == 2:
         data["partisan"] = _partisan_summary(cfg, g, data)
@@ -184,7 +193,8 @@ def run_config(cfg: ExperimentConfig, outdir: str,
 
 
 def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
-             _stop_after_segments: Optional[int] = None) -> dict:
+             _stop_after_segments: Optional[int] = None,
+             recorder=None) -> dict:
     """Batched run, in checkpoint segments when cfg.checkpoint_every > 0.
 
     A crash between segments loses at most ``checkpoint_every`` steps: the
@@ -235,11 +245,13 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         n = min(every, total - done)
         if use_board:
             res = run_board_segment(handle, spec, params, states, n,
-                                    record_every=cfg.record_every)
+                                    record_every=cfg.record_every,
+                                    recorder=recorder)
         else:
             res = run_chains(handle, spec, params, states,
                              n_steps=n, record_initial=(done == 0),
-                             record_every=cfg.record_every)
+                             record_every=cfg.record_every,
+                             recorder=recorder)
         states = res.state
         for k, v in res.history.items():
             hist_parts.setdefault(k, []).append(v)
@@ -259,7 +271,7 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         from ..sampling.board_runner import finalize_board_run
         res = finalize_board_run(handle, spec, params, states, hist_parts,
                                  waits_total, [], True, cfg.total_steps,
-                                 cfg.record_every)
+                                 cfg.record_every, recorder=recorder)
         states, history, waits_total = (res.state, res.history,
                                         res.waits_total)
     else:
@@ -295,7 +307,8 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
 
 def _run_temper(cfg: ExperimentConfig, g, plan,
                 checkpoint_dir: Optional[str] = None,
-                _stop_after_segments: Optional[int] = None) -> dict:
+                _stop_after_segments: Optional[int] = None,
+                recorder=None) -> dict:
     """The temper family: n_chains LADDERS of len(betas) rungs each (so
     the batch is n_chains * n_rungs chains), swap rounds every
     ``swap_every`` transitions. Artifacts follow the chain that ENDS
@@ -326,10 +339,12 @@ def _run_temper(cfg: ExperimentConfig, g, plan,
                            n_steps=cfg.total_steps, betas=cfg.betas,
                            n_ladders=cfg.n_chains,
                            swap_every=cfg.swap_every, swap_seed=cfg.seed,
-                           record_every=cfg.record_every)
+                           record_every=cfg.record_every,
+                           recorder=recorder)
     else:
         res = _run_temper_segmented(cfg, handle, spec, params, states,
-                                    checkpoint_dir, _stop_after_segments)
+                                    checkpoint_dir, _stop_after_segments,
+                                    recorder=recorder)
     s = res.host_state()
     # the PHYSICAL (beta = betas[0]) chain of each ladder: swaps permute
     # betas, so the cold chain's batch row differs per ladder at run end
@@ -374,7 +389,7 @@ def _run_temper(cfg: ExperimentConfig, g, plan,
 
 def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
                           states, checkpoint_dir,
-                          _stop_after_segments=None):
+                          _stop_after_segments=None, recorder=None):
     """Checkpointed temper run: whole-swap-round segments through
     run_tempered(segment=True), the between-segment ladder state in the
     checkpoint's extra_* arrays, the per-round beta assignment saved as a
@@ -415,7 +430,7 @@ def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
             n_ladders=cfg.n_chains, swap_every=cfg.swap_every,
             record_every=cfg.record_every, segment=not last,
             record_initial=(done == 0), start_parity=parity,
-            swap_key=swap_key)
+            swap_key=swap_key, recorder=recorder)
         states, params = res.state, res.params
         parity, swap_key = res.end_parity, res.end_swap_key
         seg_hist = dict(res.history)
@@ -694,20 +709,85 @@ def load_checkpoint(ckpt_dir: str, cfg: ExperimentConfig):
     return d
 
 
+def write_heartbeat(path: Optional[str], **payload):
+    """Atomically (tmp+rename) refresh the sweep's heartbeat file: one
+    small JSON object a watcher (or a resuming operator) can poll to see
+    where a multi-hour sweep is WITHOUT parsing the event stream — the
+    reference's only liveness signal was artifacts appearing on disk
+    (SURVEY.md §5). Always carries ``ts``; a stale ts is the hang
+    detector."""
+    if not path:
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload["ts"] = time.time()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+
+
 def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
-              verbose: bool = True) -> list:
-    """Sweep with skip-if-done resume (per-config completion manifest)."""
+              verbose: bool = True, recorder=None,
+              heartbeat: Optional[str] = None) -> list:
+    """Sweep with skip-if-done resume (per-config completion manifest).
+
+    ``recorder``: an obs.Recorder receives one ``sweep_config`` event per
+    config (status start/done/skip, artifact counts, seconds) and is
+    threaded into every runner underneath for per-chunk telemetry; an
+    uncaught per-config failure emits an ``error`` event before
+    re-raising. ``heartbeat``: path of a JSON progress file refreshed
+    before and after each config (write_heartbeat).
+    """
+    rec = obs.resolve_recorder(recorder)
+    configs = list(configs)
     results = []
-    for cfg in configs:
+    n_done = n_skipped = 0
+    for i, cfg in enumerate(configs):
         if is_done(cfg, outdir):
+            n_skipped += 1
             if verbose:
                 print(f"[skip] {cfg.family} {cfg.tag} (artifacts complete)")
+            rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
+                     status="skip",
+                     artifacts=len(artifact_kinds(cfg.family)),
+                     index=i, n_configs=len(configs))
+            write_heartbeat(heartbeat, status="running", current=None,
+                            last=cfg.tag, n_done=n_done,
+                            n_skipped=n_skipped, n_configs=len(configs))
             continue
         t0 = time.time()
-        data = run_config(cfg, outdir, checkpoint_dir)
+        rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
+                 status="start", artifacts=count_artifacts(cfg, outdir),
+                 index=i, n_configs=len(configs))
+        write_heartbeat(heartbeat, status="running", current=cfg.tag,
+                        last=None, n_done=n_done, n_skipped=n_skipped,
+                        n_configs=len(configs))
+        try:
+            data = run_config(cfg, outdir, checkpoint_dir, recorder=rec)
+        except Exception as e:
+            rec.emit("error", message=f"{type(e).__name__}: {e}",
+                     tag=cfg.tag, family=cfg.family)
+            write_heartbeat(heartbeat, status="error", current=cfg.tag,
+                            last=None, n_done=n_done,
+                            n_skipped=n_skipped, n_configs=len(configs),
+                            error=f"{type(e).__name__}: {e}")
+            raise
+        n_done += 1
+        rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
+                 status="done", artifacts=count_artifacts(cfg, outdir),
+                 seconds=time.time() - t0, index=i,
+                 n_configs=len(configs))
+        write_heartbeat(heartbeat, status="running", current=None,
+                        last=cfg.tag, n_done=n_done, n_skipped=n_skipped,
+                        n_configs=len(configs))
         if verbose:
             print(f"[done] {cfg.family} {cfg.tag} "
                   f"waits={data['waits_sum']:.4g} "
                   f"({time.time() - t0:.1f}s)")
         results.append((cfg, data))
+    write_heartbeat(heartbeat, status="complete", current=None,
+                    last=None, n_done=n_done, n_skipped=n_skipped,
+                    n_configs=len(configs))
     return results

@@ -1,0 +1,26 @@
+"""Chain telemetry subsystem: structured JSONL run events, per-chunk
+metrics, and the shared ``jax.profiler`` hook (ISSUE 1).
+
+Zero-dependency by construction — stdlib only at import time, jax
+imported lazily inside ``profile_region`` — so the schema and recorder
+stay usable from tools and tests that never touch the device runtime.
+The default recorder is the no-op ``NULL``; enable telemetry by passing
+``recorder=`` to a runner / ``run_sweep``, via ``--events PATH`` on
+bench.py and ``python -m flipcomplexityempirical_tpu.experiments``, or
+process-wide with ``set_default_recorder``.
+"""
+
+from .events import (EVENT_FIELDS, SCHEMA_VERSION, SWEEP_STATUSES,
+                     validate_event, validate_line)
+from .recorder import (NULL, JitWatch, NullRecorder, Recorder,
+                       default_recorder, dict_nbytes, from_spec,
+                       jit_cache_size, profile_region, resolve_recorder,
+                       set_default_recorder)
+
+__all__ = [
+    "EVENT_FIELDS", "SCHEMA_VERSION", "SWEEP_STATUSES",
+    "validate_event", "validate_line",
+    "NULL", "NullRecorder", "Recorder", "JitWatch",
+    "default_recorder", "set_default_recorder", "resolve_recorder",
+    "from_spec", "profile_region", "jit_cache_size", "dict_nbytes",
+]

@@ -1,0 +1,93 @@
+"""Event schema for the chain telemetry stream (JSONL, one object/line).
+
+Every event carries the envelope ``{"v": SCHEMA_VERSION, "ts": <unix
+seconds>, "event": <type>}`` plus the type's core fields (EVENT_FIELDS).
+Emitters may attach extra fields freely — validation is
+forward-compatible and checks only the envelope and each type's core, so
+``tools/obs_report.py`` can fold any conforming stream without knowing
+which runner wrote it. A version bump means a core field changed
+meaning; adding optional fields does not bump.
+
+Core field semantics:
+
+- ``run_start``: one per runner entry (``run_chains``,
+  ``run_board_segment``, ``run_tempered``); ``chains`` is the batch
+  size, ``n_steps`` the requested yields/transitions, ``chunk`` the
+  resolved scan length.
+- ``chunk``: one per executed device chunk. ``wall_s`` is host
+  wall-clock between the chunk boundaries the runner already has (the
+  general path syncs per chunk on its waits drain; the board path never
+  syncs mid-run, so its per-chunk walls are dispatch intervals and the
+  ``run_end`` wall is the authoritative end-to-end time).
+  ``flips`` = chains * steps; ``accept_rate`` is this chunk's accepted
+  fraction; ``transfer_bytes`` the history bytes copied device->host for
+  this chunk; ``hbm_history_bytes`` the cumulative device-resident
+  history footprint (``history_device=True`` runs); ``done``/``total``
+  give progress.
+- ``compile``: the runner's jitted chunk kernel traced a new
+  specialization (cache miss) during the preceding call — the
+  ``pick_chunk`` recompile story as data.
+- ``transfer``: a one-off device->host copy outside the per-chunk
+  stream (initial/final record blocks).
+- ``run_end``: totals for the run; ``flips_per_s`` is the aggregate
+  throughput over ``wall_s``.
+- ``sweep_config``: driver progress, ``status`` in SWEEP_STATUSES with
+  per-config artifact counts.
+- ``error``: a failure the emitter survived or is about to re-raise.
+"""
+
+from __future__ import annotations
+
+SCHEMA_VERSION = 1
+
+EVENT_FIELDS = {
+    "run_start": frozenset({"runner", "chains", "n_steps", "chunk"}),
+    "chunk": frozenset({"runner", "steps", "chains", "flips", "wall_s",
+                        "flips_per_s", "accept_rate", "transfer_bytes",
+                        "hbm_history_bytes", "done", "total"}),
+    "compile": frozenset({"fn", "cache_size"}),
+    "transfer": frozenset({"what", "bytes"}),
+    "run_end": frozenset({"runner", "n_yields", "wall_s", "flips_per_s"}),
+    "sweep_config": frozenset({"tag", "family", "status"}),
+    "error": frozenset({"message"}),
+}
+
+SWEEP_STATUSES = ("start", "done", "skip")
+
+
+def validate_event(obj) -> str | None:
+    """None when ``obj`` is a schema-conforming event, else a short
+    reason string (the ``--check`` contract: unknown or malformed events
+    must be reported, extra fields must not)."""
+    if not isinstance(obj, dict):
+        return f"not an object: {type(obj).__name__}"
+    if obj.get("v") != SCHEMA_VERSION:
+        return f"unknown schema version {obj.get('v')!r}"
+    ts = obj.get("ts")
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+        return f"missing/non-numeric ts: {ts!r}"
+    event = obj.get("event")
+    if event not in EVENT_FIELDS:
+        return f"unknown event type {event!r}"
+    missing = EVENT_FIELDS[event] - obj.keys()
+    if missing:
+        return f"{event} missing fields {sorted(missing)}"
+    if event == "sweep_config" and obj["status"] not in SWEEP_STATUSES:
+        return f"sweep_config status {obj['status']!r} not in " \
+               f"{SWEEP_STATUSES}"
+    return None
+
+
+def validate_line(line: str) -> str | None:
+    """validate_event over one raw JSONL line (blank lines pass: an
+    interrupted writer may leave a trailing newline)."""
+    import json
+
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError as e:
+        return f"malformed JSON: {e.msg}"
+    return validate_event(obj)

@@ -1,0 +1,214 @@
+"""Structured run telemetry: JSONL Recorder + shared profiler hook.
+
+The package itself previously emitted nothing — every round-3 kernel win
+came from ``jax.profiler`` traces hand-bolted onto bench.py, and a
+multi-hour ``run_sweep`` gave no heartbeat (ISSUE 1 motivation). This
+module is the zero-dependency core: a ``Recorder`` that appends
+schema-versioned events (obs.events) to a file and/or a text stream, a
+``NullRecorder`` default whose falsiness lets instrumented loops skip
+metric computation entirely (the off path costs nothing), and the
+``jax.profiler`` trace context promoted out of bench.py so runners,
+examples, and bench share one hook.
+
+jax is imported lazily (inside ``profile_region`` only): the schema and
+recorder are importable — and ``tools/obs_report.py`` can validate a
+stream — without touching the accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+
+from .events import EVENT_FIELDS, SCHEMA_VERSION
+
+
+class NullRecorder:
+    """Default recorder: every emit is a no-op and ``bool(rec)`` is
+    False, so call sites gate their metric readbacks on ``if rec:`` and
+    the un-instrumented hot loops stay byte-identical to before."""
+
+    enabled = False
+
+    def __bool__(self):
+        return False
+
+    def emit(self, event, ts=None, **fields):
+        return None
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL = NullRecorder()
+
+
+def _jsonable(o):
+    """json.dumps default for numpy scalars/arrays riding in fields."""
+    to_item = getattr(o, "item", None)
+    if callable(to_item) and getattr(o, "ndim", 0) == 0:
+        return to_item()
+    to_list = getattr(o, "tolist", None)
+    if callable(to_list):
+        return to_list()
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+
+class Recorder:
+    """Appends one JSON object per event to ``path`` and/or ``stream``.
+
+    Each line is flushed as written, so ``tail -f`` and post-crash reads
+    see every event emitted so far — the telemetry exists precisely for
+    runs that may not end cleanly. ``emit`` rejects unknown event types
+    at the call site (a typo'd emitter must fail its own tests, not
+    poison downstream streams); field content is the emitter's contract
+    with obs.events.EVENT_FIELDS, checked by ``obs_report.py --check``.
+    """
+
+    enabled = True
+
+    def __init__(self, path=None, stream=None):
+        if path is None and stream is None:
+            raise ValueError("Recorder needs a path and/or a stream "
+                             "(use obs.NULL for the no-op recorder)")
+        self.path = path
+        if path:
+            # the sweep CLI defaults the stream into its --out directory,
+            # which may not exist until the driver creates it
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._file = open(path, "a", encoding="utf-8")
+        else:
+            self._file = None
+        self._stream = stream
+        self.n_emitted = 0
+
+    def __bool__(self):
+        return True
+
+    def emit(self, event, ts=None, **fields):
+        if event not in EVENT_FIELDS:
+            raise ValueError(f"unknown event type {event!r} "
+                             f"(schema v{SCHEMA_VERSION}: "
+                             f"{sorted(EVENT_FIELDS)})")
+        obj = {"v": SCHEMA_VERSION,
+               "ts": time.time() if ts is None else float(ts),
+               "event": event}
+        obj.update(fields)
+        line = json.dumps(obj, separators=(",", ":"), default=_jsonable)
+        if self._file is not None:
+            self._file.write(line + "\n")
+            self._file.flush()
+        if self._stream is not None:
+            print(line, file=self._stream, flush=True)
+        self.n_emitted += 1
+        return obj
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def from_spec(spec):
+    """CLI convenience: ``None``/empty -> NULL, ``"-"`` -> stderr
+    stream, anything else -> append-to-file Recorder (the ``--events``
+    flag of bench.py and experiments/__main__.py)."""
+    if not spec:
+        return NULL
+    if spec == "-":
+        return Recorder(stream=sys.stderr)
+    return Recorder(path=spec)
+
+
+_default = NULL
+
+
+def default_recorder():
+    return _default
+
+
+def set_default_recorder(rec):
+    """Install a process-wide default (returned by ``resolve_recorder``
+    for call sites that don't pass one explicitly). Returns the previous
+    default so tests and tools can restore it."""
+    global _default
+    prev = _default
+    _default = NULL if rec is None else rec
+    return prev
+
+
+def resolve_recorder(rec):
+    """The runners' argument coercion: ``None`` means "whatever the
+    process default is" (NULL unless someone configured one), an
+    explicit recorder — including NULL — wins."""
+    return _default if rec is None else rec
+
+
+def profile_region(trace_dir):
+    """The ``jax.profiler`` trace context shared by bench.py, the
+    examples, and ad-hoc runner scripts (promoted out of bench.py,
+    SURVEY.md §5 tracing): a nullcontext when ``trace_dir`` is falsy, so
+    callers wrap their timed region unconditionally."""
+    if not trace_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(trace_dir)
+
+
+def jit_cache_size(fn):
+    """Compiled-specialization count of a ``jax.jit`` callable; None
+    when unavailable (``_cache_size`` is private API, stable on the
+    pinned jax — degrade to "no compile events" rather than crash a
+    run if it moves)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+class JitWatch:
+    """Cache-miss watcher for one jitted callable: ``poll(rec)`` after a
+    call emits a ``compile`` event when the trace cache grew, giving
+    compile-vs-execute attribution (each distinct ``_run_chunk`` length
+    — the ``pick_chunk`` remainder-chunk recompile story — shows up as
+    an event instead of an anomalous chunk wall time)."""
+
+    def __init__(self, fn, name):
+        self.fn = fn
+        self.name = name
+        self.last = jit_cache_size(fn)
+
+    def poll(self, rec, **fields):
+        n = jit_cache_size(self.fn)
+        grew = n is not None and (self.last is None or n > self.last)
+        self.last = n
+        if grew:
+            rec.emit("compile", fn=self.name, cache_size=n, **fields)
+        return grew
+
+
+def dict_nbytes(d) -> int:
+    """Total payload bytes of a dict of array-likes (one chunk's history
+    block) — the per-chunk host-transfer / HBM-residency metric."""
+    if not d:
+        return 0
+    return int(sum(getattr(v, "nbytes", 0) for v in d.values()))

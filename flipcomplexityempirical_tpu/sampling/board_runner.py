@@ -8,12 +8,14 @@ driver, tests) can switch between the general and board paths on a
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..graphs.lattice import LatticeGraph
 from ..kernel import board as kboard
 from ..kernel import step as kstep
@@ -61,7 +63,8 @@ def _sum_pending(waits_total, pending_waits):
 def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
                        pending_waits, record_history, n_steps,
                        record_every: int = 1,
-                       history_device: bool = False) -> RunResult:
+                       history_device: bool = False,
+                       recorder=None) -> RunResult:
     """Shared run epilogue for the board-path runners: record the final
     yield (no trailing transition), drain waits, assemble the RunResult.
     Under thinning the final yield joins the history only when it lands
@@ -69,9 +72,13 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
     ``history_device=True`` keeps the history as device arrays (for
     device-side diagnostics, stats.ess_device) instead of copying each
     chunk to host."""
+    rec = obs.resolve_recorder(recorder)
     state, out_last = kboard.record_final(bg, spec, params, state)
     if record_history and (n_steps - 1) % record_every == 0:
         out_last = maybe_host(out_last, history_device)
+        if rec and not history_device:
+            rec.emit("transfer", what="final_record",
+                     bytes=obs.dict_nbytes(out_last))
         for k, v in out_last.items():
             hist_parts.setdefault(k, []).append(v[:, None])
     state = drain_waits(state, pending_waits)
@@ -81,6 +88,31 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
                      waits_total=waits_total, n_yields=n_steps)
 
 
+def _emit_board_chunks(rec, chunk_meta, acc0, n_chains, n_transitions,
+                       transfer_total, hbm_bytes):
+    """Flush the deferred per-chunk telemetry of a board run. The board
+    loop never syncs mid-run (waits and accept counts are stashed as
+    device refs so dispatch pipelines); the accept readbacks happen HERE,
+    at the run-end sync that already exists, and each chunk event is
+    back-stamped with its dispatch-time ``ts``. Per-chunk ``wall_s`` is
+    therefore a dispatch interval — the run_end wall is the
+    authoritative end-to-end time (obs.events docstring)."""
+    last_acc = int(np.asarray(acc0, np.int64).sum())
+    acc_start = last_acc
+    done = 0
+    for steps, wall, tb, hbm, acc_ref, ts in chunk_meta:
+        acc = int(np.asarray(acc_ref, np.int64).sum())
+        done += steps
+        rec.emit("chunk", ts=ts, runner="board", steps=steps,
+                 chains=n_chains, flips=n_chains * steps, wall_s=wall,
+                 flips_per_s=n_chains * steps / max(wall, 1e-12),
+                 accept_rate=(acc - last_acc) / (n_chains * steps),
+                 transfer_bytes=tb, hbm_history_bytes=hbm,
+                 done=done, total=n_transitions)
+        last_acc = acc
+    return (last_acc - acc_start) / max(n_chains * n_transitions, 1)
+
+
 def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                       params: StepParams, state: kboard.BoardState,
                       n_transitions: int,
@@ -88,7 +120,8 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                       chunk: Optional[int] = None,
                       bits: Optional[bool] = None,
                       record_every: int = 1,
-                      history_device: bool = False) -> RunResult:
+                      history_device: bool = False,
+                      recorder=None) -> RunResult:
     """Advance ``n_transitions`` transitions, recording the same number of
     yields (each BEFORE its transition) — and NO trailing record, so
     segments compose without duplicate boundary yields: a full run is
@@ -96,7 +129,14 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
     ``kboard.record_final``. ``run_board`` is exactly that composition;
     the experiment driver checkpoints between segments.
     ``history_device=True`` skips the per-chunk host copy and returns the
-    history as device arrays (costs (C, T_recorded) HBM per key)."""
+    history as device arrays (costs (C, T_recorded) HBM per key).
+
+    ``recorder``: an obs.Recorder emits run_start / per-chunk / compile /
+    run_end events. Telemetry preserves this runner's no-mid-run-sync
+    contract: accept counts are stashed as (C,) device refs per chunk
+    (like the pending waits) and read back only at run end, so enabling
+    events does not serialize the pipelined dispatch."""
+    rec = obs.resolve_recorder(recorder)
     if record_every < 1:
         raise ValueError(f"record_every must be >= 1, got {record_every}")
     if chunk is None:
@@ -109,24 +149,62 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
     state = state.replace(waits_sum=jnp.zeros_like(state.waits_sum))
     pending_waits: list = []
 
+    n_chains = state.waits_sum.shape[0]
+    if rec:
+        rec.emit("run_start", runner="board", chains=n_chains,
+                 n_steps=n_transitions, chunk=chunk,
+                 record_history=record_history, record_every=record_every,
+                 history_device=history_device)
+        watch = obs.JitWatch(kboard.run_board_chunk,
+                             "board.run_board_chunk")
+        acc0, chunk_meta, hbm_bytes, transfer_total = \
+            state.accept_count, [], 0, 0
+        t_run0 = t_prev = time.perf_counter()
+
     done = 0
     while done < n_transitions:
         this = min(chunk, n_transitions - done)
         state, outs = kboard.run_board_chunk(bg, spec, params, state, this,
                                              collect=record_history,
                                              bits=bits)
+        if rec:
+            watch.poll(rec, chunk=this)
+        transfer_bytes = 0
         if record_history:
             # board chunks record BEFORE transitioning, so block-local
             # index 0 is already on the global grid
             outs = maybe_host(thin_outs(outs, record_every, offset=0),
                               history_device)
+            if rec:
+                nb = obs.dict_nbytes(outs)
+                if history_device:
+                    hbm_bytes += nb
+                else:
+                    transfer_bytes = nb
+                    transfer_total += nb
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (T, C) -> (C, T)
         state = drain_waits(state, pending_waits)
         done += this
+        if rec:
+            now = time.perf_counter()
+            chunk_meta.append((this, now - t_prev, transfer_bytes,
+                               hbm_bytes, state.accept_count, time.time()))
+            t_prev = now
 
     waits_total = _sum_pending(waits_total, pending_waits)
     history = assemble_history(hist_parts, record_history, history_device)
+    if rec:
+        wall = time.perf_counter() - t_run0
+        flips = n_chains * n_transitions
+        accept_rate = _emit_board_chunks(
+            rec, chunk_meta, acc0, n_chains, n_transitions,
+            transfer_total, hbm_bytes)
+        rec.emit("run_end", runner="board", n_yields=n_transitions,
+                 chains=n_chains, flips=flips, wall_s=wall,
+                 flips_per_s=flips / max(wall, 1e-12),
+                 accept_rate=accept_rate, transfer_bytes=transfer_total,
+                 hbm_history_bytes=hbm_bytes)
     return RunResult(state=state, history=history,
                      waits_total=waits_total, n_yields=n_transitions)
 
@@ -137,19 +215,24 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
               chunk: Optional[int] = None,
               bits: Optional[bool] = None,
               record_every: int = 1,
-              history_device: bool = False) -> RunResult:
+              history_device: bool = False,
+              recorder=None) -> RunResult:
     """Run the batched board chain for ``n_steps`` yields (yield 0 is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
     ``bits`` overrides the bit-board body dispatch (perf toggle; the
     bodies are bit-identical). ``record_every=k`` keeps only yields
     0, k, 2k, ... in the returned history (accumulators still advance
-    every step), strided on device before the host copy."""
+    every step), strided on device before the host copy.
+    ``recorder``: obs events for the segment (run_start/chunk/run_end)
+    plus the final record's ``transfer``."""
     seg = run_board_segment(bg, spec, params, state, n_steps - 1,
                             record_history=record_history, chunk=chunk,
                             bits=bits, record_every=record_every,
-                            history_device=history_device)
+                            history_device=history_device,
+                            recorder=recorder)
     hist_parts = {k: [v] for k, v in seg.history.items()}
     return finalize_board_run(bg, spec, params, seg.state, hist_parts,
                               seg.waits_total, [], record_history,
                               n_steps, record_every,
-                              history_device=history_device)
+                              history_device=history_device,
+                              recorder=recorder)

@@ -13,12 +13,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..graphs.lattice import DeviceGraph, LatticeGraph
 from ..state.chain_state import ChainState, init_state
 from ..kernel import step as kstep
@@ -162,7 +164,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                chunk: Optional[int] = None,
                record_initial: bool = True,
                record_every: int = 1,
-               history_device: bool = False) -> RunResult:
+               history_device: bool = False,
+               recorder=None) -> RunResult:
     """Run the batched chain for ``n_steps`` yields (the first yield is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
 
@@ -182,7 +185,16 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     alone dwarfed the sampling wall clock (PROFILE.md round-5 ESS
     records), and the general path serves exactly the graphs the big
     sweeps run on (sec11, frank, dual).
+
+    ``recorder``: an obs.Recorder emits one ``run_start``, one ``chunk``
+    event per executed chunk (wall time, aggregate flips/s, accept rate,
+    history transfer/HBM bytes), a ``compile`` event per fresh
+    ``_run_chunk`` specialization, and one ``run_end``. The per-chunk
+    accept/timing readbacks piggyback on this runner's EXISTING per-chunk
+    sync (the waits drain) — no extra device syncs — and the default
+    NullRecorder skips all of it.
     """
+    rec = obs.resolve_recorder(recorder)
     n_chains = states.assignment.shape[0]
     if record_every < 1:
         raise ValueError(f"record_every must be >= 1, got {record_every}")
@@ -191,34 +203,89 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     if record_every > 1:
         chunk = snap_chunk_to(chunk, record_every)
 
+    if rec:
+        rec.emit("run_start", runner="general", chains=n_chains,
+                 n_steps=n_steps, chunk=chunk,
+                 record_history=record_history, record_every=record_every,
+                 record_initial=record_initial,
+                 history_device=history_device)
+        watch = obs.JitWatch(_run_chunk, "runner._run_chunk")
+        t_run0 = time.perf_counter()
+        last_acc = int(np.asarray(states.accept_count, np.int64).sum())
+        acc_start, hbm_bytes, transfer_total = last_acc, 0, 0
+
     if record_initial:
         states, out0 = _record_initial(dg, spec, params, states)
         if record_history:
             out0 = maybe_host(out0, history_device)
             hist_parts = {k: [v[:, None]] for k, v in out0.items()}
+            if rec:
+                nb = obs.dict_nbytes(out0)
+                if history_device:
+                    hbm_bytes += nb
+                else:
+                    transfer_total += nb
+                    rec.emit("transfer", what="initial_record", bytes=nb)
         else:
             hist_parts = None
         done = 1
     else:
         hist_parts = {} if record_history else None
         done = 0
+    done0 = done
     # waits accumulate on device in f32 but are drained and zeroed at every
     # chunk boundary, so the host f64 total stays exact over long horizons
     waits_total = np.asarray(states.waits_sum, np.float64).copy()
     states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
 
+    t_prev = time.perf_counter() if rec else None
     while done < n_steps:
         this = min(chunk, n_steps - done)
         states, outs = _run_chunk(dg, spec, params, states, this,
                                   collect=record_history)
+        if rec:
+            watch.poll(rec, chunk=this)
+        transfer_bytes = 0
         if record_history:
             outs = maybe_host(thin_outs(outs, record_every), history_device)
+            if rec:
+                nb = obs.dict_nbytes(outs)
+                if history_device:
+                    hbm_bytes += nb
+                else:
+                    transfer_bytes = nb
+                    transfer_total += nb
             for k, v in outs.items():
                 hist_parts.setdefault(k, []).append(v.T)  # (chunk, C)->(C,)
         waits_total += np.asarray(states.waits_sum, np.float64)
         states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
         done += this
+        if rec:
+            # the waits drain above already synchronized on this chunk,
+            # so the accept readback and the wall stamp cost no new sync
+            acc = int(np.asarray(states.accept_count, np.int64).sum())
+            now = time.perf_counter()
+            wall = now - t_prev
+            t_prev = now
+            rec.emit("chunk", runner="general", steps=this,
+                     chains=n_chains, flips=n_chains * this,
+                     wall_s=wall,
+                     flips_per_s=n_chains * this / max(wall, 1e-12),
+                     accept_rate=(acc - last_acc) / (n_chains * this),
+                     transfer_bytes=transfer_bytes,
+                     hbm_history_bytes=hbm_bytes,
+                     done=done, total=n_steps)
+            last_acc = acc
 
     history = assemble_history(hist_parts, record_history, history_device)
+    if rec:
+        wall = time.perf_counter() - t_run0
+        flips = n_chains * (n_steps - done0)
+        rec.emit("run_end", runner="general", n_yields=n_steps,
+                 chains=n_chains, flips=flips, wall_s=wall,
+                 flips_per_s=flips / max(wall, 1e-12),
+                 accept_rate=(last_acc - acc_start) / max(flips, 1),
+                 transfer_bytes=transfer_total,
+                 hbm_history_bytes=hbm_bytes)
     return RunResult(state=states, history=history,
                      waits_total=waits_total, n_yields=n_steps)

@@ -24,12 +24,14 @@ itself carries only a dead annealing schedule (grid_chain_sec11.py:88-95).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..kernel import board as kboard
 from ..kernel.step import Spec, StepParams
 from . import board_runner, runner
@@ -109,7 +111,8 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                  record_history: bool = True, record_every: int = 1,
                  bits: Optional[bool] = None,
                  segment: bool = False, record_initial: bool = True,
-                 start_parity: int = 0, swap_key=None) -> TemperResult:
+                 start_parity: int = 0, swap_key=None,
+                 recorder=None) -> TemperResult:
     """Run C = n_ladders * len(betas) chains for ``n_steps`` yields with a
     replica-exchange round every ``swap_every`` transitions.
 
@@ -131,6 +134,12 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
     ``start_parity=result.end_parity``, ``swap_key=result.end_swap_key``,
     and the returned ``params``. Segments must be multiples of
     ``swap_every``.
+
+    ``recorder``: an obs.Recorder emits run_start / one ``chunk`` event
+    per swap round (with the round index) / compile / run_end. The
+    per-round accept readback rides the round boundary this
+    orchestration already synchronizes at (``_host_rungs`` pulls beta to
+    host every swap round); the NullRecorder path is unchanged.
     """
     betas = np.asarray(betas, np.float64)
     n_rungs = betas.shape[0]
@@ -163,14 +172,33 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                             thin_outs(outs, record_every, offset=offset))
         for k, v in outs.items():
             hist_parts.setdefault(k, []).append(v.T)
+        return obs.dict_nbytes(outs)
 
     transitions = n_steps if segment else n_steps - 1
+    rec = obs.resolve_recorder(recorder)
+    if rec:
+        chunk_fn = kboard.run_board_chunk if is_board else runner._run_chunk
+        watch = obs.JitWatch(
+            chunk_fn, ("board.run_board_chunk" if is_board
+                       else "runner._run_chunk"))
+        rec.emit("run_start", runner="tempered", chains=c,
+                 n_steps=n_steps, chunk=swap_every, n_rungs=n_rungs,
+                 n_ladders=n_ladders, swap_every=swap_every,
+                 segment=segment, record_history=record_history,
+                 record_every=record_every,
+                 path="board" if is_board else "general")
+        t_run0 = t_prev = time.perf_counter()
+        last_acc = int(np.asarray(states.accept_count, np.int64).sum())
+        acc_start, transfer_total = last_acc, 0
     done = 0
     parity = start_parity
     if not is_board and record_initial:
         states, out0 = runner._record_initial(
             graph_handle, spec, params, states)
         if record_history:
+            if rec:
+                rec.emit("transfer", what="initial_record",
+                         bytes=obs.dict_nbytes(out0))
             for k, v in out0.items():
                 hist_parts.setdefault(k, []).append(np.asarray(v)[:, None])
     while done < transitions:
@@ -184,11 +212,31 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
             states, outs = runner._run_chunk(
                 graph_handle, spec, params, states, this,
                 collect=record_history)
+        if rec:
+            watch.poll(rec, chunk=this)
+        transfer_bytes = 0
         if record_history:
-            collect(outs, 0 if is_board else record_every - 1)
+            transfer_bytes = collect(outs, 0 if is_board else
+                                     record_every - 1)
         pending.append(states.waits_sum)
         states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
         done += this
+        if rec:
+            # _host_rungs / the swap below synchronize every round
+            # anyway; piggyback the round's accept readback on it
+            acc = int(np.asarray(states.accept_count, np.int64).sum())
+            now = time.perf_counter()
+            wall = now - t_prev
+            t_prev = now
+            transfer_total += transfer_bytes
+            rec.emit("chunk", runner="tempered", steps=this, chains=c,
+                     flips=c * this, wall_s=wall,
+                     flips_per_s=c * this / max(wall, 1e-12),
+                     accept_rate=(acc - last_acc) / (c * this),
+                     transfer_bytes=transfer_bytes, hbm_history_bytes=0,
+                     done=done, total=transitions,
+                     round=len(beta_rows) - 1, parity=parity)
+            last_acc = acc
         if done < transitions or segment:
             # swaps sit BETWEEN rounds only: no trailing swap on a FULL
             # run, so the final recorded yield still belongs to
@@ -205,7 +253,7 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
     if is_board and not segment:
         res = board_runner.finalize_board_run(
             graph_handle, spec, params, states, hist_parts, waits_total,
-            pending, record_history, n_steps, record_every)
+            pending, record_history, n_steps, record_every, recorder=rec)
         states, history, waits_total = res.state, res.history, \
             res.waits_total
     else:
@@ -214,6 +262,18 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
         history = ({k: np.concatenate(v, axis=1)
                     for k, v in hist_parts.items()}
                    if record_history and hist_parts else {})
+
+    if rec:
+        wall = time.perf_counter() - t_run0
+        flips = c * transitions
+        rec.emit("run_end", runner="tempered", n_yields=n_steps,
+                 chains=c, flips=flips, wall_s=wall,
+                 flips_per_s=flips / max(wall, 1e-12),
+                 accept_rate=(last_acc - acc_start) / max(flips, 1),
+                 transfer_bytes=transfer_total, hbm_history_bytes=0,
+                 n_rounds=len(beta_rows),
+                 swap_attempts=int(attempts.sum()),
+                 swap_accepts=int(accepts.sum()))
 
     return TemperResult(
         state=states, history=history, waits_total=waits_total,

@@ -64,11 +64,14 @@ def conductance_profile_device(x, thresholds):
     device history: Phi(S_r) over level sets S_r = {f <= r}, the paper's
     bottleneck-ratio estimator, without the history readback.
 
-    ``thresholds`` must be a sorted concrete array (jit shapes the
-    bincounts by its static length; the host default of "unique observed
-    values" is data-dependent and cannot be shaped — pass e.g.
+    ``thresholds`` is a concrete array (jit shapes the bincounts by its
+    static length; the host default of "unique observed values" is
+    data-dependent and cannot be shaped — pass e.g.
     ``jnp.arange(lo, hi + 1)`` for integer observables like cut counts,
-    or a linspace). For f32-representable observables (every integer
+    or a linspace), sorted HERE at trace time to match the host twin's
+    unconditional sort (ADVICE r5: an unsorted grid previously produced
+    silently wrong searchsorted bins). For f32-representable observables
+    (every integer
     trajectory this framework records) the occupancy/crossing counts and
     the two-sided mask are exact int32 arithmetic (valid up to 2^31
     transitions = C*(T-1)) and only ONE final division is f32 vs the
@@ -84,7 +87,7 @@ def conductance_profile_device(x, thresholds):
         # static shape: raise at trace time like the host path, instead
         # of 0/0 -> all-NaN masquerading as the frozen-observable verdict
         raise ValueError("need T >= 2 transitions")
-    thresholds = jnp.asarray(thresholds, jnp.float32)
+    thresholds = jnp.sort(jnp.asarray(thresholds, jnp.float32))
     nb = thresholds.shape[0]
     cur = x[:, :-1].ravel()
     nxt = x[:, 1:].ravel()
@@ -144,6 +147,13 @@ def gelman_rubin_device(x):
     half = t // 2
     if half < 2:
         raise ValueError("need T >= 4 for split R-hat")
+    # R-hat is shift-invariant, so center on the grand mean BEFORE
+    # halving (ADVICE r5): the f32 cancellation residue of the variance
+    # then scales with the CENTERED magnitude, not the raw offset — an
+    # observable sitting at a large offset with genuinely small variance
+    # (std ~0.1% of its magnitude) no longer trips the frozen floor.
+    scale = jnp.abs(x).max()
+    x = x - x.mean()
     halves = jnp.concatenate([x[:, :half], x[:, t - half:]], axis=0)
     n = halves.shape[1]
     means = halves.mean(axis=1)
@@ -151,14 +161,16 @@ def gelman_rubin_device(x):
     w = variances.mean()
     b = n * means.var(ddof=1)
     var_plus = (n - 1) / n * w + b / n
-    # frozen contract under f32+jit: XLA's fused variance leaves
-    # eps-scale residue on constant inputs (observed ~1e-15 for b on
-    # identical 3.0s), so BOTH zero tests carry a scale-relative
-    # tolerance, and agreement is judged on the SPREAD of the half-chain
-    # means rather than on b's residue. A genuinely mixing observable
-    # has w and spread orders of magnitude above these floors.
-    scale = jnp.abs(halves).max()
-    frozen = w <= 1e-6 * scale * scale + 1e-30
+    # frozen contract under f32+jit: XLA's fused mean/variance leaves
+    # eps-scale residue on constant inputs, so both zero tests carry a
+    # scale-relative tolerance against the RAW scale (centering itself
+    # rounds at ~eps * offset, i.e. w-residue ~(eps*scale)^2 ~ 1.4e-14 *
+    # scale^2 — the 1e-10 floor keeps ~100x margin over it instead of
+    # the old 1e-6 floor's ~1e8x, ADVICE r5), and agreement is judged on
+    # the SPREAD of the half-chain means rather than on b's residue. A
+    # genuinely mixing observable has w and spread orders of magnitude
+    # above these floors.
+    frozen = w <= 1e-10 * scale * scale + 1e-30
     spread = means.max() - means.min()
     return jnp.where(
         ~frozen, jnp.sqrt(var_plus / jnp.where(frozen, 1.0, w)),

@@ -1,0 +1,338 @@
+"""Telemetry subsystem (obs/): schema round-trip, the null recorder's
+no-op contract, chunk-event accounting against the runners' chunking
+math, driver sweep events + heartbeat, and the obs_report.py --check
+gate over a real stream."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu import experiments as ex
+
+REPORT = os.path.join(os.path.dirname(__file__), os.pardir,
+                      "tools", "obs_report.py")
+
+
+def read_events(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def assert_stream_valid(events):
+    for e in events:
+        err = obs.validate_event(e)
+        assert err is None, (err, e)
+
+
+# ---------------------------------------------------------------- schema
+
+
+def test_recorder_jsonl_roundtrip(tmp_path):
+    """One of each event type through Recorder -> file -> parse ->
+    validate: the writer and the schema agree on every type."""
+    path = str(tmp_path / "ev.jsonl")
+    with obs.Recorder(path=path) as rec:
+        rec.emit("run_start", runner="general", chains=4, n_steps=101,
+                 chunk=25)
+        rec.emit("chunk", runner="general", steps=25, chains=4, flips=100,
+                 wall_s=0.01, flips_per_s=1e4, accept_rate=0.5,
+                 transfer_bytes=800, hbm_history_bytes=0, done=25,
+                 total=100)
+        rec.emit("compile", fn="runner._run_chunk", cache_size=1)
+        rec.emit("transfer", what="initial_record", bytes=96)
+        rec.emit("run_end", runner="general", n_yields=101, wall_s=0.04,
+                 flips_per_s=1e4)
+        rec.emit("sweep_config", tag="2B30P10", family="sec11",
+                 status="start")
+        rec.emit("error", message="boom")
+        assert rec.n_emitted == 7
+    events = read_events(path)
+    assert [e["event"] for e in events] == [
+        "run_start", "chunk", "compile", "transfer", "run_end",
+        "sweep_config", "error"]
+    assert_stream_valid(events)
+    assert all(e["v"] == obs.SCHEMA_VERSION for e in events)
+    # ts is monotone-ish wall time, numeric on every event
+    assert all(isinstance(e["ts"], float) for e in events)
+
+
+def test_recorder_rejects_unknown_event(tmp_path):
+    """A typo'd emitter fails at its own call site, not downstream."""
+    rec = obs.Recorder(path=str(tmp_path / "e.jsonl"))
+    with pytest.raises(ValueError, match="unknown event type"):
+        rec.emit("chunkk", runner="general")
+    rec.close()
+
+
+def test_validate_event_rejections():
+    ok = {"v": 1, "ts": 0.0, "event": "error", "message": "x"}
+    assert obs.validate_event(ok) is None
+    assert "missing" in obs.validate_event(
+        {"v": 1, "ts": 0.0, "event": "error"})
+    assert obs.validate_event(
+        {"v": 99, "ts": 0.0, "event": "error", "message": "x"})
+    assert obs.validate_event(
+        {"v": 1, "ts": 0.0, "event": "nope"})
+    assert obs.validate_event(
+        {"v": 1, "ts": "later", "event": "error", "message": "x"})
+    assert obs.validate_event(
+        {"v": 1, "ts": 0.0, "event": "sweep_config", "tag": "t",
+         "family": "f", "status": "resting"})
+    # forward compatibility: extra fields pass
+    assert obs.validate_event(dict(ok, extra_field=123)) is None
+    # numpy payloads serialize (the runners emit numpy scalars)
+    rec_line = json.dumps(
+        {"v": 1, "ts": 0.0, "event": "error", "message": "x"})
+    assert obs.validate_line(rec_line) is None
+    assert obs.validate_line("not json {") is not None
+    assert obs.validate_line("   \n") is None  # blank lines pass
+
+
+def test_from_spec_routing(tmp_path, capsys):
+    assert obs.from_spec(None) is obs.NULL
+    assert obs.from_spec("") is obs.NULL
+    stderr_rec = obs.from_spec("-")
+    assert stderr_rec.enabled and stderr_rec.path is None
+    p = str(tmp_path / "f.jsonl")
+    with obs.from_spec(p) as rec:
+        assert rec.path == p
+        rec.emit("error", message="hi")
+    assert len(read_events(p)) == 1
+
+
+def test_null_recorder_noop():
+    """bool(NULL) is False (call sites gate metric readbacks on it),
+    emit/close/context-manager are inert."""
+    assert not obs.NULL
+    assert obs.NULL.emit("chunk", anything="goes") is None
+    with obs.NULL as rec:
+        assert rec is obs.NULL
+    assert obs.resolve_recorder(None) is obs.NULL
+    prev = obs.set_default_recorder(obs.NULL)
+    try:
+        assert obs.resolve_recorder(None) is obs.NULL
+    finally:
+        obs.set_default_recorder(prev)
+
+
+# ------------------------------------------------- runner chunk accounting
+
+
+def _grid_setup(n=8):
+    g = fce.graphs.square_grid(n, n)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    return g, plan, spec
+
+
+def test_run_chains_chunk_events(tmp_path):
+    """The acceptance contract: one run_start, exactly one chunk event
+    per executed chunk (ceil((n_steps-1)/chunk) on the general path),
+    one run_end — with flips/s, accept rate, and transfer bytes
+    populated — and the stream passes the schema gate. The instrumented
+    run's history is identical to the un-instrumented one (telemetry
+    reads, never perturbs)."""
+    g, plan, spec = _grid_setup()
+    path = str(tmp_path / "run.jsonl")
+    runs = {}
+    for rec_on in (False, True):
+        dg, st, params = fce.init_batch(g, plan, n_chains=4, seed=0,
+                                        spec=spec, base=1.3, pop_tol=0.4)
+        rec = obs.Recorder(path=path) if rec_on else None
+        res = fce.run_chains(dg, spec, params, st, n_steps=101, chunk=25,
+                             recorder=rec)
+        if rec:
+            rec.close()
+        runs[rec_on] = res
+    events = read_events(path)
+    assert_stream_valid(events)
+    kinds = [e["event"] for e in events]
+    assert kinds.count("run_start") == 1
+    assert kinds.count("run_end") == 1
+    chunks = [e for e in events if e["event"] == "chunk"]
+    assert len(chunks) == 4  # (101 - 1 initial yield) / 25
+    assert sum(c["steps"] for c in chunks) == 100
+    # done counts yields (the initial record is yield 1 of 101)
+    assert chunks[-1]["done"] == chunks[-1]["total"] == 101
+    start = next(e for e in events if e["event"] == "run_start")
+    assert start["runner"] == "general"
+    assert start["chains"] == 4 and start["n_steps"] == 101
+    for c in chunks:
+        assert c["flips"] == 4 * c["steps"]
+        assert c["wall_s"] > 0 and c["flips_per_s"] > 0
+        assert 0.0 <= c["accept_rate"] <= 1.0
+        assert c["transfer_bytes"] > 0  # host history path copies back
+        assert c["hbm_history_bytes"] == 0
+    end = next(e for e in events if e["event"] == "run_end")
+    assert end["n_yields"] == 101 and end["flips_per_s"] > 0
+    # accept_rate deltas integrate to a plausible overall rate
+    assert 0.0 <= end["accept_rate"] <= 1.0
+    # telemetry must not change the walk
+    for k in runs[False].history:
+        np.testing.assert_array_equal(runs[True].history[k],
+                                      runs[False].history[k])
+
+
+def test_run_chains_chunk_events_device_history(tmp_path):
+    """history_device=True: transfer_bytes drops to 0 (nothing crosses
+    to host per chunk) while hbm_history_bytes grows monotonically."""
+    g, plan, spec = _grid_setup(6)
+    dg, st, params = fce.init_batch(g, plan, n_chains=4, seed=0,
+                                    spec=spec, base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "dev.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.run_chains(dg, spec, params, st, n_steps=76, chunk=25,
+                       history_device=True, recorder=rec)
+    chunks = [e for e in read_events(path) if e["event"] == "chunk"]
+    assert len(chunks) == 3
+    hbm = [c["hbm_history_bytes"] for c in chunks]
+    assert all(c["transfer_bytes"] == 0 for c in chunks)
+    assert hbm[0] > 0 and hbm == sorted(hbm)
+
+
+def test_run_board_chunk_events(tmp_path):
+    """Board fast path: same event contract, accept readbacks deferred
+    to the run-end sync (chunk events are back-stamped, so their ts
+    precedes run_end's)."""
+    g, plan, spec = _grid_setup()
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=4, seed=0, spec=spec, base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "board.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.sampling.run_board(bg, spec, params, st, n_steps=101,
+                               chunk=25, recorder=rec)
+    events = read_events(path)
+    assert_stream_valid(events)
+    kinds = [e["event"] for e in events]
+    assert kinds.count("run_start") == 1 and kinds.count("run_end") == 1
+    chunks = [e for e in events if e["event"] == "chunk"]
+    assert len(chunks) == 4
+    assert all(c["runner"] == "board" for c in chunks)
+    assert sum(c["steps"] for c in chunks) == 100
+    for c in chunks:
+        assert 0.0 <= c["accept_rate"] <= 1.0
+    end = next(e for e in events if e["event"] == "run_end")
+    assert all(c["ts"] <= end["ts"] for c in chunks)
+    # the board segment covers n_steps - 1 = 100 transitions; the final
+    # yield comes from finalize_board_run (its host copy is the trailing
+    # transfer event)
+    assert end["n_yields"] == 100
+    assert chunks[-1]["done"] == chunks[-1]["total"] == 100
+
+
+def test_run_tempered_round_events(tmp_path):
+    """Tempered runner: chunk events carry round/parity, one per swap
+    round, and run_end reports the swap totals."""
+    g, plan, spec = _grid_setup(6)
+    handle, st, params = fce.sampling.init_tempered(
+        g, plan, betas=(1.0, 0.5), n_ladders=2, seed=0, spec=spec,
+        base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "temper.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.sampling.run_tempered(handle, spec, params, st, n_steps=41,
+                                  betas=(1.0, 0.5), n_ladders=2,
+                                  swap_every=10, recorder=rec)
+    events = read_events(path)
+    assert_stream_valid(events)
+    start = next(e for e in events if e["event"] == "run_start")
+    assert start["runner"] == "tempered"
+    chunks = [e for e in events if e["event"] == "chunk"]
+    assert len(chunks) == 4  # 40 transitions / swap_every=10
+    assert [c["round"] for c in chunks] == [0, 1, 2, 3]
+    assert all(c["parity"] in (0, 1) for c in chunks)
+    end = next(e for e in events if e["event"] == "run_end")
+    assert end["n_yields"] == 41
+    assert end["swap_attempts"] >= 0 and end["n_rounds"] == 4
+
+
+# --------------------------------------------------- driver sweep events
+
+
+def test_run_sweep_skip_events_and_heartbeat(tmp_path):
+    """A completed config (all manifest artifacts on disk) emits exactly
+    one sweep_config skip event — no start/done — and the heartbeat file
+    lands atomically with the final 'complete' status."""
+    out = str(tmp_path / "plots")
+    os.makedirs(out)
+    cfg = ex.ExperimentConfig(family="frank", alignment=0, base=0.3,
+                              pop_tol=0.5, total_steps=200, n_chains=2)
+    for kind in ex.ARTIFACT_KINDS:
+        with open(os.path.join(out, cfg.tag + kind), "w") as f:
+            f.write("x")
+    assert ex.is_done(cfg, out)
+    path = str(tmp_path / "sweep.jsonl")
+    hb = str(tmp_path / "hb" / "heartbeat.json")
+    with obs.Recorder(path=path) as rec:
+        results = ex.run_sweep([cfg], out, verbose=False, recorder=rec,
+                               heartbeat=hb)
+    assert results == []
+    events = read_events(path)
+    assert_stream_valid(events)
+    sweep = [e for e in events if e["event"] == "sweep_config"]
+    assert [e["status"] for e in sweep] == ["skip"]
+    assert sweep[0]["tag"] == cfg.tag
+    assert sweep[0]["family"] == "frank"
+    assert sweep[0]["artifacts"] == len(ex.ARTIFACT_KINDS)
+    with open(hb) as f:
+        beat = json.load(f)
+    assert beat["status"] == "complete"
+    assert beat["n_skipped"] == 1 and beat["n_done"] == 0
+    assert beat["ts"] > 0
+    assert not os.path.exists(hb + ".tmp")  # atomic replace, no residue
+
+
+def test_write_heartbeat_atomic(tmp_path):
+    from flipcomplexityempirical_tpu.experiments import driver as drv
+    hb = str(tmp_path / "nested" / "hb.json")
+    drv.write_heartbeat(hb, status="running", current="X")
+    with open(hb) as f:
+        d = json.load(f)
+    assert d["status"] == "running" and d["current"] == "X"
+    drv.write_heartbeat(None)  # disabled path is a no-op
+
+
+# ------------------------------------------------------ obs_report gate
+
+
+def test_obs_report_check_passes_real_stream(tmp_path):
+    """The acceptance gate: a stream from an actual run_chains call
+    passes ``tools/obs_report.py --check`` (exit 0), and the report
+    mode renders its run table."""
+    g, plan, spec = _grid_setup(6)
+    dg, st, params = fce.init_batch(g, plan, n_chains=4, seed=0,
+                                    spec=spec, base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "real.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.run_chains(dg, spec, params, st, n_steps=51, chunk=25,
+                       recorder=rec)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, REPORT, "--check", path],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "ok (" in r.stdout
+    r = subprocess.run([sys.executable, REPORT, path],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "general" in r.stdout and "## Runs" in r.stdout
+
+
+def test_obs_report_check_fails_bad_stream(tmp_path):
+    """Unknown/malformed events exit nonzero, each with a line-numbered
+    diagnostic."""
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 1, "ts": 1.0, "event": "bogus"}) + "\n")
+        f.write("not json {\n")
+        f.write(json.dumps({"v": 1, "ts": 2.0, "event": "error",
+                            "message": "fine"}) + "\n")
+    r = subprocess.run([sys.executable, REPORT, "--check", path],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert f"{path}:1:" in r.stderr and f"{path}:2:" in r.stderr
+    assert "2/3 events failed" in r.stderr

@@ -346,6 +346,26 @@ def test_bottleneck_device_matches_host():
     assert float(r_d) == r_h
 
 
+def test_bottleneck_device_unsorted_thresholds():
+    """An unsorted threshold grid must match the host estimator, which
+    sorts unconditionally — searchsorted on an unsorted grid silently
+    bins wrong (ADVICE r5), so the device twin now sorts at trace time."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(13)
+    x = rng.integers(0, 12, size=(5, 300)).astype(np.float64)
+    thr = np.arange(x.min(), x.max() + 1, dtype=np.float64)
+    shuffled = rng.permutation(thr)
+    assert not np.all(shuffled[:-1] <= shuffled[1:])  # genuinely unsorted
+
+    th_h, phi_h = stats.conductance_profile(x, shuffled)
+    th_d, phi_d = stats.conductance_profile_device(jnp.asarray(x), shuffled)
+    np.testing.assert_array_equal(np.asarray(th_d), th_h)
+    np.testing.assert_array_equal(np.isnan(np.asarray(phi_d)),
+                                  np.isnan(phi_h))
+    m = ~np.isnan(phi_h)
+    np.testing.assert_allclose(np.asarray(phi_d)[m], phi_h[m], rtol=1e-5)
+
+
 def test_bottleneck_device_rejects_single_yield():
     """T=1 raises at trace time (host parity), rather than returning the
     frozen-observable (nan, nan) verdict for a mis-sliced history."""
@@ -383,6 +403,27 @@ def test_gelman_rubin_device_matches_host():
         jnp.asarray(frozen_disagree))))
     with pytest.raises(ValueError, match="T >= 4"):
         stats.gelman_rubin_device(jnp.zeros((2, 3)))
+
+
+def test_gelman_rubin_device_large_offset():
+    """A genuinely mixing observable sitting at a large offset (std
+    ~0.02% of magnitude) must match the host, not trip the frozen floor:
+    R-hat is shift-invariant, so the device twin centers on the grand
+    mean BEFORE halving and judges frozenness against the centered
+    variance (ADVICE r5 — the old raw-scale 1e-6 floor swallowed this
+    case). The large-offset frozen contracts must survive the tighter
+    floor too."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(17)
+    x = (4000.0 + rng.normal(0, 1, size=(6, 400))).astype(np.float64)
+    r_d = float(stats.gelman_rubin_device(jnp.asarray(x)))
+    assert np.isfinite(r_d)
+    np.testing.assert_allclose(r_d, stats.gelman_rubin(x), rtol=1e-3)
+    # frozen contracts at the same offset scale
+    assert float(stats.gelman_rubin_device(
+        jnp.full((4, 50), 4000.0))) == 1.0
+    assert np.isinf(float(stats.gelman_rubin_device(
+        jnp.asarray(np.repeat([[4000.0], [4001.0]], 50, axis=1)))))
 
 
 def test_integer_thresholds_grid():

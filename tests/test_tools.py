@@ -117,6 +117,36 @@ def test_commit_race_quarantines_uncommitted(sandbox):
     assert "TEST_t6.json" not in bench_files(sandbox)
 
 
+def test_pick_health_record_quarantine_shapes(sandbox):
+    """The tail watchdog's window-health selection: a committed record
+    wins, the .uncommitted quarantine is an acceptable stand-in (a lost
+    commit race is still a true reading), and the .failed/.fallback
+    shapes yield NOTHING — the caller must classify the window unhealthy
+    explicitly, not via vsb_at_least's missing-file fallthrough."""
+    runs = sandbox / "bench_runs"
+    base = "bench_runs/h.json"
+
+    r = drive(sandbox, "ok", f"pick_health_record {base}")
+    assert r.returncode == 1 and r.stdout == ""
+
+    (runs / "h.json.failed").write_text('{"value": 0}')
+    (runs / "h.json.fallback").write_text(
+        '{"value": 1, "cpu_fallback": true}')
+    (runs / "h.json.suspect").write_text('{"vs_baseline": 0.7}')
+    r = drive(sandbox, "ok", f"pick_health_record {base}")
+    assert r.returncode == 1 and r.stdout == ""
+
+    (runs / "h.json.uncommitted").write_text('{"vs_baseline": 16.3}')
+    r = drive(sandbox, "ok", f"pick_health_record {base}")
+    assert r.returncode == 0
+    assert r.stdout.strip() == f"{base}.uncommitted"
+
+    (runs / "h.json").write_text('{"vs_baseline": 16.3}')
+    r = drive(sandbox, "ok", f"pick_health_record {base}")
+    assert r.returncode == 0
+    assert r.stdout.strip() == base
+
+
 def test_vsb_at_least_gate(sandbox):
     f = sandbox / "bench_runs" / "x.json"
     for content, floor, expect in (

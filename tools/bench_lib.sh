@@ -25,6 +25,10 @@
 #                                          picks up the file either way)
 # run_bench_min VSB NAME TMO ARGS... - run_bench with a vs_baseline
 #                          acceptance floor VSB.
+# pick_health_record BASE - print the record usable as a window-health
+#                          reading (committed file, else the .uncommitted
+#                          quarantine); nothing (rc 1) when only
+#                          not-a-reading quarantine shapes exist.
 
 commit_retry() {
   # pathspec'd commit: never sweeps up unrelated staged work from the
@@ -46,6 +50,21 @@ import json, sys
 rec = json.load(open(sys.argv[1]))
 sys.exit(0 if (rec.get("vs_baseline") or 0) >= float(sys.argv[2]) else 1)
 EOF
+}
+
+pick_health_record() { # base: committed record, else .uncommitted
+  # a validated record whose commit lost the git race is still a TRUE
+  # health reading, so the .uncommitted quarantine gates fine; the other
+  # quarantine shapes are explicitly NOT readings (.failed: bench died
+  # with no record; .fallback: a host number; .suspect: already judged
+  # below its floor) — print nothing so the caller treats the window as
+  # unhealthy outright instead of leaning on vsb_at_least's missing-file
+  # behavior (ADVICE r5)
+  local f
+  for f in "$1" "$1.uncommitted"; do
+    if [ -s "$f" ]; then printf '%s\n' "$f"; return 0; fi
+  done
+  return 1
 }
 
 RB_MIN_VSB=""

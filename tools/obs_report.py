@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Fold an obs telemetry stream (JSONL) into a PROFILE.md-style summary.
+
+    python tools/obs_report.py EVENTS.jsonl            # summary tables
+    python tools/obs_report.py --check EVENTS.jsonl    # schema gate
+
+Report mode prints, per run (run_start → run_end), the headline numbers
+the round-3/5 profiling sessions extracted by hand: chains, chunks,
+wall, aggregate flips/s, accept rate, host-transfer and HBM-resident
+history bytes, and compile (jit cache miss) counts — plus a per-chunk
+throughput spread so a single degraded chunk (the round-5 "history
+readback dwarfs sampling" class of finding) is visible without a
+profiler. A trailing sweep section summarizes driver progress events.
+
+``--check`` validates every line against the event schema
+(obs.events.EVENT_FIELDS envelope + per-type core fields) and exits
+nonzero listing each malformed/unknown event — the CI gate on anything
+that emits telemetry. Stdlib-only: the schema module is loaded by file
+path, so the check needs no jax (and no package import) at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_EVENTS_PY = os.path.join(_HERE, os.pardir, "flipcomplexityempirical_tpu",
+                          "obs", "events.py")
+
+
+def _load_schema():
+    """Load obs.events directly by path: stdlib-only, no package import
+    (the package __init__ pulls jax, which a JSONL check never needs)."""
+    spec = importlib.util.spec_from_file_location("_obs_events", _EVENTS_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def check(path: str, schema) -> int:
+    """Validate every line; print one diagnostic per bad line; return
+    the number of bad lines (the exit code driver)."""
+    bad = n = 0
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            n += 1
+            err = schema.validate_line(line)
+            if err is not None:
+                bad += 1
+                print(f"{path}:{lineno}: {err}", file=sys.stderr)
+    if bad:
+        print(f"{path}: {bad}/{n} events failed schema "
+              f"v{schema.SCHEMA_VERSION}", file=sys.stderr)
+    else:
+        print(f"{path}: ok ({n} events, schema v{schema.SCHEMA_VERSION})")
+    return bad
+
+
+def load_events(path: str, schema):
+    """Parse the stream, tolerating (and counting) malformed lines —
+    a report over a crashed run's partial stream must still render."""
+    events, bad = [], 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            if schema.validate_line(line) is None:
+                events.append(json.loads(line))
+            else:
+                bad += 1
+    return events, bad
+
+
+def _mb(b):
+    return f"{b / 1e6:.1f}" if b else "0"
+
+
+def fold_runs(events) -> list[dict]:
+    """Group the flat stream into runs: a run_start opens a run, every
+    chunk/compile/transfer joins the currently open run, run_end closes
+    it. Runs never nest within one process (the runners are
+    synchronous), so a second run_start before a run_end means the
+    previous run died — it is kept, flagged unfinished."""
+    runs, open_run = [], None
+    for e in events:
+        kind = e["event"]
+        if kind == "run_start":
+            open_run = {"start": e, "chunks": [], "compiles": 0,
+                        "transfers": 0, "end": None}
+            runs.append(open_run)
+        elif open_run is not None:
+            if kind == "chunk":
+                open_run["chunks"].append(e)
+            elif kind == "compile":
+                open_run["compiles"] += 1
+            elif kind == "transfer":
+                open_run["transfers"] += e.get("bytes", 0)
+            elif kind == "run_end":
+                open_run["end"] = e
+                open_run = None
+    return runs
+
+
+def report_runs(runs, out):
+    cols = ("runner path chains steps chunks wall_s Mflips/s accept "
+            "xfer_MB hbm_MB compiles").split()
+    print("## Runs", file=out)
+    print("| " + " | ".join(cols) + " |", file=out)
+    print("|" + "---|" * len(cols), file=out)
+    for r in runs:
+        s, e = r["start"], r["end"]
+        if e is None:
+            done = r["chunks"][-1]["done"] if r["chunks"] else 0
+            print(f"| {s['runner']} | {s.get('path', '-')} "
+                  f"| {s['chains']} | {s['n_steps']} "
+                  f"| {len(r['chunks'])} | UNFINISHED@{done} | - | - "
+                  f"| - | - | {r['compiles']} |", file=out)
+            continue
+        rate = e.get("accept_rate")
+        print(f"| {s['runner']} | {s.get('path', '-')} | {s['chains']} "
+              f"| {s['n_steps']} | {len(r['chunks'])} "
+              f"| {e['wall_s']:.3f} | {e['flips_per_s'] / 1e6:.3f} "
+              f"| {'-' if rate is None else format(rate, '.3f')} "
+              f"| {_mb(e.get('transfer_bytes', 0) + r['transfers'])} "
+              f"| {_mb(e.get('hbm_history_bytes', 0))} "
+              f"| {r['compiles']} |", file=out)
+
+    spreads = [(i, r) for i, r in enumerate(runs) if len(r["chunks"]) > 1]
+    if spreads:
+        print("\n## Per-chunk throughput spread (flips/s)", file=out)
+        print("| run | runner | chunks | min | median | max |", file=out)
+        print("|---|---|---|---|---|---|", file=out)
+        for i, r in spreads:
+            f = sorted(c["flips_per_s"] for c in r["chunks"])
+            print(f"| {i} | {r['start']['runner']} | {len(f)} "
+                  f"| {f[0] / 1e6:.3f}M | {f[len(f) // 2] / 1e6:.3f}M "
+                  f"| {f[-1] / 1e6:.3f}M |", file=out)
+
+
+def report_sweep(events, out):
+    sweep = [e for e in events if e["event"] == "sweep_config"]
+    errors = [e for e in events if e["event"] == "error"]
+    if not sweep and not errors:
+        return
+    print("\n## Sweep", file=out)
+    by_status = {}
+    for e in sweep:
+        by_status.setdefault(e["status"], []).append(e)
+    for status in ("done", "skip", "start"):
+        tags = by_status.get(status, [])
+        if not tags:
+            continue
+        extra = ""
+        if status == "done":
+            secs = sum(e.get("seconds", 0) for e in tags)
+            extra = f" ({secs:.1f}s total)"
+        print(f"- {status}: {len(tags)}{extra} — "
+              + ", ".join(e["tag"] for e in tags), file=out)
+    # a start with no matching done/skip is the config a crash was in
+    finished = {e["tag"] for e in by_status.get("done", [])}
+    hanging = [e["tag"] for e in by_status.get("start", [])
+               if e["tag"] not in finished]
+    if hanging:
+        print(f"- in flight (started, never finished): "
+              + ", ".join(hanging), file=out)
+    for e in errors:
+        print(f"- ERROR [{e.get('tag', '?')}]: {e['message']}", file=out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize / validate an obs telemetry JSONL stream")
+    ap.add_argument("path", help="JSONL event stream (obs.Recorder output)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate only: exit nonzero on any "
+                         "unknown/malformed event (CI gate)")
+    args = ap.parse_args(argv)
+    schema = _load_schema()
+
+    if args.check:
+        return 1 if check(args.path, schema) else 0
+
+    events, bad = load_events(args.path, schema)
+    out = sys.stdout
+    counts = {}
+    for e in events:
+        counts[e["event"]] = counts.get(e["event"], 0) + 1
+    span = (events[-1]["ts"] - events[0]["ts"]) if len(events) > 1 else 0.0
+    print(f"# obs report: {os.path.basename(args.path)}", file=out)
+    print(f"{len(events)} events over {span:.1f}s — "
+          + ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+          + (f" — {bad} MALFORMED (see --check)" if bad else ""),
+          file=out)
+    print(file=out)
+    runs = fold_runs(events)
+    if runs:
+        report_runs(runs, out)
+    report_sweep(events, out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

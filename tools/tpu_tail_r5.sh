@@ -50,16 +50,17 @@ while true; do
     TS=$(date -u +%Y%m%dT%H%M%SZ)
     if [ "$n_def" -lt 8 ]; then
       run_bench tail_default 900 || true
-      health="bench_runs/${TS}_tail_default.json"
-      # a validated record whose commit lost the git race is still a
-      # true health reading — accept the quarantined file for gating
-      [ -s "$health" ] || health="$health.uncommitted"
+      # committed record or the .uncommitted quarantine (a lost git race
+      # is still a true reading); empty on the .failed/.fallback shapes,
+      # which the gate below treats as unhealthy outright
+      health=$(pick_health_record \
+                 "bench_runs/${TS}_tail_default.json") || health=""
     else
       # cap reached: measure health without growing the committed stream
       health=$(mktemp /tmp/tail_health.XXXXXX)
       timeout 900 python bench.py >"$health" 2>/dev/null || true
     fi
-    if healthy "$health"; then
+    if [ -n "$health" ] && healthy "$health"; then
       have tail_pallas || run_bench_min 2.0 tail_pallas 900 --pallas || true
       have tail_ess8192 \
         || run_bench_min 12.0 tail_ess8192 1200 --ess --chains 8192 || true
