@@ -15,6 +15,7 @@ from . import obs  # noqa: F401
 from . import graphs  # noqa: F401
 from . import compat  # noqa: F401
 from . import state  # noqa: F401
+from . import lower  # noqa: F401
 from . import kernel  # noqa: F401
 from . import sampling  # noqa: F401
 from . import stats  # noqa: F401

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from types import SimpleNamespace
 from typing import Optional
 
 import jax
@@ -206,9 +207,12 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     ``_stop_after_segments`` simulates an interruption for tests.
 
     Routes through the board (stencil) fast path whenever
-    ``kernel.board.supports(graph, spec)`` holds — e.g. the kpair family's
-    plain rook grid — and falls back to the general gather kernel (sec11's
-    corner surgery, the Frankengraph, tri/hex, dual graphs)."""
+    ``kernel.board.supports(graph, spec)`` holds — plain rook grids (the
+    kpair family) AND near-grid graphs the lowering pass embeds onto the
+    masked-plane stencil body: sec11's corner surgery, the Frankengraph
+    seam, queen grids, triangular lattices (a grid plus one diagonal
+    plane). Truly irregular graphs (hex — radius-3 patches — and dual
+    graphs) fall back to the general gather kernel."""
     from ..sampling.board_runner import run_board_segment
 
     spec = spec_for(cfg)
@@ -279,12 +283,21 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
                    for k, v in hist_parts.items()}
     s = jax.tree.map(np.asarray, states)
     t_final = cfg.total_steps  # reference t after the loop (line 402)
-    c0 = type(s)(**{f: np.asarray(getattr(s, f))[0]
+    c0 = type(s)(**{f: (np.asarray(v)[0] if (v := getattr(s, f))
+                        is not None else None)
                     for f in s.__dataclass_fields__})
     if use_board:
-        assign0 = np.asarray(c0.board, dtype=np.int64)
+        # canvas -> node order: on lowered (surgical) stencils the board
+        # carries hole cells (district -1, untouched bookkeeping) that
+        # must not reach the artifacts; node_view is the identity on
+        # plain full grids
+        assign0 = kboard.node_view(handle, c0.board).astype(np.int64)
         cut_times = kboard.edge_cut_times(g, s)[0]
-        assignments = np.asarray(s.board)
+        assignments = kboard.node_view(handle, s.board)
+        c0 = SimpleNamespace(
+            part_sum=kboard.node_view(handle, c0.part_sum),
+            last_flipped=kboard.node_view(handle, c0.last_flipped),
+            num_flips=kboard.node_view(handle, c0.num_flips))
     else:
         assign0 = np.asarray(c0.assignment, dtype=np.int64)
         cut_times = np.asarray(c0.cut_times)
@@ -352,15 +365,29 @@ def _run_temper(cfg: ExperimentConfig, g, plan,
     cold_rows = (np.arange(cfg.n_chains) * n_rungs
                  + np.argmax(beta_lr == np.float32(cfg.betas[0]), axis=1))
     cold = int(cold_rows[0])
-    cc = type(s)(**{f: np.asarray(getattr(s, f))[cold]
+    cc = type(s)(**{f: (np.asarray(v)[cold] if (v := getattr(s, f))
+                        is not None else None)
                     for f in s.__dataclass_fields__})
-    assign_c = np.asarray(cc.assignment, dtype=np.int64)
+    if isinstance(s, kboard.BoardState):
+        # board fast path (the Frankengraph lowers onto the stencil
+        # body): canvas -> node order, holes dropped (see _run_jax)
+        assign_c = kboard.node_view(handle, cc.board).astype(np.int64)
+        cut_times_c = kboard.edge_cut_times(g, s)[cold]
+        assignments = kboard.node_view(handle, s.board)[cold_rows]
+        cc = SimpleNamespace(
+            part_sum=kboard.node_view(handle, cc.part_sum),
+            last_flipped=kboard.node_view(handle, cc.last_flipped),
+            num_flips=kboard.node_view(handle, cc.num_flips))
+    else:
+        assign_c = np.asarray(cc.assignment, dtype=np.int64)
+        cut_times_c = np.asarray(cc.cut_times)
+        assignments = np.asarray(s.assignment)[cold_rows]
     part_sum, _ = finalize_host(cc, labels, cfg.total_steps,
                                 assignment=assign_c)
     rung_cut = per_rung_history(res, "cut_count")[:, 0, :]  # ladder 0
     return {
         "end_signed": labels[assign_c],
-        "cut_times": np.asarray(cc.cut_times),
+        "cut_times": cut_times_c,
         "part_sum": part_sum,
         "num_flips": np.asarray(cc.num_flips),
         "slopes": None,
@@ -371,7 +398,7 @@ def _run_temper(cfg: ExperimentConfig, g, plan,
         "state": s,
         # one physical plan per ladder (partisan summaries must not mix
         # in molten hot-rung plans)
-        "assignments": np.asarray(s.assignment)[cold_rows],
+        "assignments": assignments,
         "rung_cut": rung_cut,
         "swapstats": {
             # pair r is the exchange between the chains holding the
@@ -495,12 +522,20 @@ class _SegmentStop(RuntimeError):
 
 
 def _state_from_arrays(template, loaded: dict):
-    """Rebuild a device ChainState from checkpoint arrays, using the
-    freshly-initialized state as the shape/dtype template."""
+    """Rebuild a device chain state from checkpoint arrays, using the
+    freshly-initialized state as the shape/dtype template. Fields that
+    are None on the template (absent from the checkpoint) stay None;
+    a template field MISSING from the checkpoint means the checkpoint
+    was written by a different kernel path (e.g. a pre-lowering general
+    run of a now-lowered graph) — raise KeyError so _load_resume
+    restarts loudly instead of resuming corrupt state."""
     import jax.numpy as jnp
 
     fields = {}
     for f in template.__dataclass_fields__:
+        if getattr(template, f) is None and f"state_{f}" not in loaded:
+            fields[f] = None
+            continue
         arr = loaded[f"state_{f}"]
         fields[f] = jnp.asarray(arr)
     return type(template)(**fields)
@@ -516,9 +551,18 @@ def _load_resume(checkpoint_dir, cfg: ExperimentConfig, states_template):
     loaded = load_checkpoint(checkpoint_dir, cfg)
     if loaded is None:
         return None
+    try:
+        states = _state_from_arrays(states_template, loaded)
+    except KeyError as e:
+        # state-field mismatch: the checkpoint predates a kernel-path
+        # change (e.g. written by the general runner before this graph
+        # lowered onto the board path). Restart loudly from scratch.
+        print(f"[ckpt] ignoring {cfg.tag}: state field {e} missing "
+              "(written by a different kernel path); restarting")
+        return None
     return (int(loaded["meta_done"]),
             int(loaded["meta_n_parts"]),
-            _state_from_arrays(states_template, loaded),
+            states,
             {k[len("hist_"):]: [v] for k, v in loaded.items()
              if k.startswith("hist_")},
             loaded["meta_waits_total"].copy(),
@@ -663,8 +707,11 @@ def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,
                                for k, v in new_hist.items()})
         os.replace(ppath + ".tmp.npz", ppath)
         part_idx += 1
-    arrays = {f"state_{f}": np.asarray(getattr(host_state, f))
-              for f in host_state.__dataclass_fields__}
+    # None fields (e.g. the diagonal cut_times planes on non-lowered
+    # board states) are omitted; _state_from_arrays restores them as None
+    arrays = {f"state_{f}": np.asarray(v)
+              for f in host_state.__dataclass_fields__
+              if (v := getattr(host_state, f)) is not None}
     arrays["meta_done"] = np.int64(done)
     arrays["meta_n_parts"] = np.int64(part_idx)
     arrays["meta_identity"] = np.array(_ckpt_identity(cfg))

@@ -32,16 +32,24 @@ _SEC11_CORNERS = [(0, 0), (0, 39), (39, 0), (39, 39)]
 
 def square_grid(nx_: int, ny_: int | None = None, *, name: str | None = None,
                 extra_edges=(), remove_nodes=(), wall=None, frame=None,
-                center=None) -> LatticeGraph:
-    """Rook-adjacency nx_ x ny_ grid with optional edge/node surgery."""
+                center=None, queen: bool = False) -> LatticeGraph:
+    """Rook-adjacency nx_ x ny_ grid with optional edge/node surgery.
+
+    ``queen=True`` adds both diagonals of every unit cell (the
+    reference's commented-out queen block, grid_chain_sec11.py:241-249):
+    an n x n queen grid has 2n(n-1) rook + 2(n-1)^2 diagonal edges.
+    Queen grids lower onto the board kernel's stencil fast path as two
+    extra diagonal planes (flipcomplexityempirical_tpu/lower)."""
     ny_ = nx_ if ny_ is None else ny_
     removed = set(remove_nodes)
     nodes = [(x, y) for x in range(nx_) for y in range(ny_)
              if (x, y) not in removed]
     nodeset = set(nodes)
     adjacency = {n: [] for n in nodes}
+    offsets = (((1, 0), (0, 1), (1, 1), (1, -1)) if queen
+               else ((1, 0), (0, 1)))
     for (x, y) in nodes:
-        for (dx, dy) in ((1, 0), (0, 1)):
+        for (dx, dy) in offsets:
             m = (x + dx, y + dy)
             if m in nodeset:
                 adjacency[(x, y)].append(m)
@@ -55,7 +63,8 @@ def square_grid(nx_: int, ny_: int | None = None, *, name: str | None = None,
     if center is None:
         center = (nx_ / 2.0, ny_ / 2.0)
     return build_lattice(
-        adjacency, name=name or f"grid{nx_}x{ny_}",
+        adjacency,
+        name=name or f"{'queen' if queen else 'grid'}{nx_}x{ny_}",
         frame=frame, wall=wall, center=center)
 
 

@@ -54,8 +54,13 @@ U32 = jnp.uint32
 
 
 def _common_gates(bg, spec: Spec) -> bool:
+    # surgical (holes / diagonal planes) graphs run the lowered stencil
+    # body in kernel/board.py — the packed planes here are rook-only.
+    # getattr: `bg` may be a BoardGraph or a lower.StencilSpec.
     return (
         bool(bg.uniform_pop)
+        and not getattr(bg, "surgical", False)
+        and not spec.record_interface
         and bg.w % 32 == 0
         and spec.accept in ("cut", "always")
         and spec.contiguity in ("patch", "none")

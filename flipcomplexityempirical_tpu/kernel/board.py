@@ -77,8 +77,8 @@ R(S_0), [T, R] x (n-1)  ==  [R, T] x (n-1), R (epilogue).
 
 from __future__ import annotations
 
-import dataclasses
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -86,6 +86,7 @@ import numpy as np
 from flax import struct
 
 from ..graphs.lattice import LatticeGraph
+from ..lower.stencil import stencil_for
 from . import bitboard
 from .step import Spec, StepParams, sample_geom_minus1
 from .step import geom_denom_finite as kstep_geom_ok
@@ -95,20 +96,49 @@ class BoardGraph:
     """Static per-graph planes (a small pytree; loop-invariant).
 
     ``h``/``w`` ride the treedef (static), so jitted kernels specialize on
-    the grid shape."""
+    the grid shape. Built from a ``lower.StencilSpec``: plain full rook
+    grids keep the original rook bodies (bit-identical), while *surgical*
+    stencils (holes, diagonal planes — sec11, Frankengraph, queen grids)
+    and ``record_interface`` specs run the generalized lowered body
+    (masked 8-direction planes, B2-window contiguity, wall-key interface
+    reduction)."""
 
-    pop: jnp.ndarray      # int32[N] node population weights (flat x*W+y)
-    deg: jnp.ndarray      # int32[N] rook degree (2/3/4)
+    pop: jnp.ndarray      # int32[N] node population weights (0 at holes)
+    deg: jnp.ndarray      # int32[N] graph degree (<= 8)
     east_ok: jnp.ndarray  # bool[N] node has an east (+1 flat) neighbor
     west_ok: jnp.ndarray  # bool[N] node has a west (-1 flat) neighbor
+    # --- lowered-stencil planes (see lower/stencil.py::StencilSpec) ---
+    adj: Optional[jnp.ndarray] = None           # bool[8, N] ring order
+    node_mask: Optional[jnp.ndarray] = None     # bool[N] real node cells
+    cell_of_node: Optional[jnp.ndarray] = None  # int32[n_real]
+    b2_in: Optional[jnp.ndarray] = None         # bool[K, N]
+    b2_adj: Optional[jnp.ndarray] = None        # int32[K, N]
+    nbr_bits: Optional[jnp.ndarray] = None      # int32[N]
+    iface_key: Optional[jnp.ndarray] = None     # int32[4, N]
     h: int = struct.field(pytree_node=False, default=0)
     w: int = struct.field(pytree_node=False, default=0)
     # static because the bit-board body is chosen at trace time
     uniform_pop: bool = struct.field(pytree_node=False, default=False)
+    # static: body selection and loop structure specialize on these
+    surgical: bool = struct.field(pytree_node=False, default=False)
+    real_nodes: int = struct.field(pytree_node=False, default=0)
+    b2_offsets: tuple = struct.field(pytree_node=False, default=())
+    b2_iters: int = struct.field(pytree_node=False, default=0)
+    patch_exact: bool = struct.field(pytree_node=False, default=False)
+    iface_ok: bool = struct.field(pytree_node=False, default=False)
+    iface_decode: tuple = struct.field(pytree_node=False,
+                                       default=(0, 0, 0, 0))
+    center: tuple = struct.field(pytree_node=False, default=(0.0, 0.0))
 
     @property
     def n(self) -> int:
         return self.h * self.w
+
+    @property
+    def n_real(self) -> int:
+        """Real node count (canvas minus holes) — the geometric-wait
+        denominator and abits width use THIS, never the canvas size."""
+        return self.real_nodes or self.h * self.w
 
 
 @struct.dataclass
@@ -117,9 +147,12 @@ class BoardState:
 
     Mirrors state.ChainState field-for-field where semantics overlap;
     node-indexed arrays are flat (C, N) with flat index = x*W + y
-    (LatticeGraph's sorted (x, y) label order). ``cut_times_e[c, i]``
-    counts cut yields of edge (i, i+1) (zero where no east neighbor);
-    ``cut_times_s[c, i]`` of edge (i, i+W)."""
+    (LatticeGraph's sorted (x, y) label order; on surgical stencils the
+    canvas embedding, hole cells carrying district -1). ``cut_times_e[c,
+    i]`` counts cut yields of edge (i, i+1) (zero where no east
+    neighbor); ``cut_times_s[c, i]`` of edge (i, i+W); the lowered body
+    adds the diagonal planes ``cut_times_se`` (i, i+W+1) and
+    ``cut_times_sw`` (i, i+W-1), None on rook-body states."""
 
     key: jnp.ndarray           # uint32[C, 2] per-chain PRNG keys
     board: jnp.ndarray         # int8[C, N] district 0..K-1 (0/1 for 'bi')
@@ -143,6 +176,8 @@ class BoardState:
     accept_count: jnp.ndarray  # int32[C]
     tries_sum: jnp.ndarray     # int32[C] == yields processed (one draw/step)
     exhausted_count: jnp.ndarray  # int32[C] steps with empty valid set
+    cut_times_se: Optional[jnp.ndarray] = None  # int32[C, N] lowered body
+    cut_times_sw: Optional[jnp.ndarray] = None  # int32[C, N] lowered body
 
 
 # ---------------------------------------------------------------------------
@@ -175,18 +210,26 @@ def board_shape(graph: LatticeGraph):
 
 
 def supports(graph: LatticeGraph, spec: Spec) -> bool:
-    """True iff this kernel reproduces run_chains semantics exactly for
-    (graph, spec). Everything outside falls back to the general path."""
+    """True iff the board kernel family reproduces run_chains semantics
+    exactly for (graph, spec) — via the lowering pass
+    (lower.lower_to_stencil), so near-grid graphs with holes and diagonal
+    planes (sec11, Frankengraph, queen grids) qualify. Everything outside
+    falls back to the general path. ``body_for`` picks the body within
+    the family (lowered / bitboard / int8 board)."""
+    st = stencil_for(graph)
+    if st is None:
+        return False
     if spec.n_districts == 2 and spec.proposal == "bi":
         prop_ok = spec.accept in ("cut", "corrected", "always")
-    elif spec.proposal == "pair" and 2 <= spec.n_districts <= 31:
+    elif (spec.proposal == "pair" and 2 <= spec.n_districts <= 31
+          and not st.surgical):
         # k-district pair walk (slow_reversible_propose): the pair body
         # needs uniform node population (its per-district bound test is a
         # per-chain bitmask) and has no reversibility-corrected accept;
         # geom waits need the literal n**k - 1 denominator to stay finite
         # in f32; gating here fails such configs at init (the general
         # fallback raises the explanatory error from sample_geom_minus1)
-        # instead of mid-trace inside a board body
+        # instead of mid-trace inside a board body. Rook stencils only.
         pop = np.asarray(graph.pop)
         prop_ok = (spec.accept in ("cut", "always")
                    and pop.size > 0 and bool((pop == pop[0]).all())
@@ -194,37 +237,75 @@ def supports(graph: LatticeGraph, spec: Spec) -> bool:
                        graph.n_nodes, spec.n_districts)))
     else:
         return False
+    # 'patch' contiguity: plain rook grids use the ring criterion (proven
+    # equivalent); surgical stencils run the B2 propagation, which must
+    # match the graph's own patch tables exactly (radius-2 lattices only —
+    # a radius-3 patch graph like hex falls back to the general kernel)
+    contig_ok = (spec.contiguity == "none"
+                 or (spec.contiguity == "patch"
+                     and (not st.surgical or st.patch_exact)))
+    iface_ok = (not spec.record_interface
+                or (st.iface_ok and spec.proposal == "bi"))
     return (
         prop_ok
-        and board_shape(graph) is not None
-        and spec.contiguity in ("patch", "none")
+        and contig_ok
+        and iface_ok
         and spec.invalid == "repropose"
         and spec.anneal in ("none", "linear")
         and not spec.frame_interface
         and not spec.weighted_cut
-        and not spec.record_interface
         and (not spec.record_assignment_bits
-             or graph.n_nodes * max(
+             or st.n_real * max(
                  1, (spec.n_districts - 1).bit_length()) <= 32)
     )
 
 
+def body_for(bg: BoardGraph, spec: Spec, bits: Optional[bool] = None) -> str:
+    """The body ``run_board_chunk`` will execute: 'lowered' | 'bitboard'
+    | 'board'. Surgical stencils and interface recording need the masked
+    lowered body; plain rook grids keep the bit-identical rook bodies."""
+    if bg.surgical or spec.record_interface:
+        return "lowered"
+    bits_ok = (bitboard.supported_pair(bg, spec)
+               if spec.proposal == "pair" else bitboard.supported(bg, spec))
+    use_bits = bits_ok if bits is None else bool(bits)
+    return "bitboard" if use_bits else "board"
+
+
 def make_board_graph(graph: LatticeGraph) -> BoardGraph:
-    h, w = board_shape(graph)
-    deg = np.full((h, w), 4, np.int32)
-    deg[0, :] -= 1
-    deg[-1, :] -= 1
-    deg[:, 0] -= 1
-    deg[:, -1] -= 1
-    ys = np.arange(h * w) % w
-    pop = np.asarray(graph.pop, np.int32)
+    st = stencil_for(graph)
+    if st is None:
+        raise ValueError(f"graph {graph.name!r} does not lower to a board "
+                         "stencil (see lower.lower_to_stencil)")
     return BoardGraph(
-        pop=jnp.asarray(pop),
-        deg=jnp.asarray(deg.reshape(-1)),
-        east_ok=jnp.asarray(ys != w - 1),
-        west_ok=jnp.asarray(ys != 0),
-        h=h, w=w,
-        uniform_pop=bool(pop.size) and bool((pop == pop[0]).all()))
+        pop=jnp.asarray(st.pop),
+        deg=jnp.asarray(st.deg),
+        east_ok=jnp.asarray(st.adj[0]),
+        west_ok=jnp.asarray(st.adj[4]),
+        adj=jnp.asarray(st.adj),
+        node_mask=jnp.asarray(st.node_mask),
+        cell_of_node=jnp.asarray(st.cell_of_node),
+        b2_in=jnp.asarray(st.b2_in),
+        b2_adj=jnp.asarray(st.b2_adj),
+        nbr_bits=jnp.asarray(st.nbr_bits),
+        iface_key=(jnp.asarray(st.iface_key)
+                   if st.iface_key is not None else None),
+        h=st.h, w=st.w,
+        uniform_pop=st.uniform_pop,
+        surgical=st.surgical,
+        real_nodes=st.n_real,
+        b2_offsets=st.b2_offsets,
+        b2_iters=st.b2_iters,
+        patch_exact=st.patch_exact,
+        iface_ok=st.iface_ok,
+        iface_decode=st.iface_decode,
+        center=st.center)
+
+
+def node_view(bg: BoardGraph, arr):
+    """Restrict a canvas-indexed (..., N) array to real nodes in node
+    order (..., n_real) — identity on plain full grids. Host-side."""
+    return np.asarray(arr)[..., np.asarray(bg.cell_of_node)]
 
 
 # ---------------------------------------------------------------------------
@@ -263,6 +344,13 @@ def recount_cuts(bg: BoardGraph, board) -> jnp.ndarray:
     carries BoardState.cut_count incrementally (+dcut on accept); this
     from-scratch recount serves out-of-loop callers (replica-exchange
     acceptance over a freshly permuted board) and drift tests."""
+    if bg.surgical:
+        same = _same_planes_stencil(bg, board)
+        total = jnp.zeros(board.shape[0], jnp.int32)
+        for d in range(4):  # forward planes only: each edge counted once
+            total = total + (bg.adj[d][None] & ~same[d]).sum(
+                axis=1, dtype=jnp.int32)
+        return total
     cut_e, cut_s = cut_planes(bg, board)
     return (cut_e.sum(axis=1, dtype=jnp.int32)
             + cut_s.sum(axis=1, dtype=jnp.int32))
@@ -326,6 +414,232 @@ def _planes(bg: BoardGraph, spec: Spec, params: StepParams,
     valid = b_mask & contig & pop_ok
     return dict(valid=valid, b_count=b_count, diff_deg=diff_deg,
                 cut_e=cut_e, cut_s=cut_s)
+
+
+# ---------------------------------------------------------------------------
+# Lowered stencil body: masked 8-direction planes (holes + diagonals)
+# ---------------------------------------------------------------------------
+
+_RING_FLAT = ("+1", "+w+1", "+w", "+w-1", "-1", "-w-1", "-w", "-w+1")
+
+
+def _ring_offsets(w: int) -> tuple:
+    return (1, w + 1, w, w - 1, -1, -w - 1, -w, -w + 1)
+
+
+def _same_planes_stencil(bg: BoardGraph, board):
+    """same[d][c, i] = the ring-d neighbor EDGE exists in the lowered
+    graph and its cell shares i's district. Unlike ``same_planes``, every
+    direction (diagonals included) is masked by its static adjacency
+    plane, so removed nodes and seam edges are exact."""
+    w, n = bg.w, bg.n
+    p = jnp.pad(board, ((0, 0), (w + 1, w + 1)), constant_values=-1)
+
+    def sh(o):
+        return p[:, w + 1 + o: w + 1 + o + n] == board
+
+    return [sh(o) & bg.adj[d][None]
+            for d, o in enumerate(_ring_offsets(w))]
+
+
+def _stencil_patch_ok(bg: BoardGraph, board):
+    """EXACT ``contiguity.patch_connected`` for every cell at once, as a
+    gather-free bitset propagation over static flat offsets.
+
+    The ring-criterion shortcut of the rook body is WRONG once diagonal
+    edges exist (a diagonal can bridge two ring-nonadjacent neighbors),
+    so the lowered body runs the real check: member bitset over the K
+    B2-window offsets (same district as the center, in the center's
+    radius-2 patch), seeds = direct neighbors, propagate reachability
+    from the lowest seed through ``b2_adj`` for ``b2_iters`` rounds (max
+    patch size - 1 bounds any simple path), ok iff every seed is reached
+    (<= 1 seed is vacuously ok: seeds & ~reach == 0). Bit k of every
+    word refers to offset ``b2_offsets[k]`` — per-cell masks ``b2_in`` /
+    ``b2_adj`` make the same bit mean a different *node* at each cell,
+    which is what lets one static program serve an irregular graph."""
+    n = bg.n
+    pad = 2 * bg.w + 2
+    p = jnp.pad(board, ((0, 0), (pad, pad)), constant_values=-1)
+    member = jnp.zeros(board.shape, jnp.int32)
+    for k, o in enumerate(bg.b2_offsets):
+        same_k = (p[:, pad + o: pad + o + n] == board) & bg.b2_in[k][None]
+        member = member | jnp.where(same_k, jnp.int32(1 << k), 0)
+    seeds = member & bg.nbr_bits[None]
+    reach = seeds & -seeds                     # lowest set bit (0 if none)
+    for _ in range(bg.b2_iters):
+        contrib = jnp.zeros_like(reach)
+        for k in range(len(bg.b2_offsets)):
+            hit = ((reach >> k) & 1) == 1
+            contrib = contrib | jnp.where(hit, bg.b2_adj[k][None], 0)
+        reach = reach | (contrib & member)
+    return (seeds & ~reach) == 0
+
+
+def _planes_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
+                    state: BoardState):
+    """The lowered body's fused plane pass: 8 masked same-planes, full
+    graph degree, 4 forward cut planes (E, SE, S, SW), B2 contiguity."""
+    board = state.board
+    same = _same_planes_stencil(bg, board)
+    same_deg = same[0].astype(jnp.int8)
+    for s in same[1:]:
+        same_deg = same_deg + s
+    diff_deg = bg.deg[None].astype(jnp.int8) - same_deg
+    b_mask = (diff_deg > 0) & bg.node_mask[None]
+    b_count = b_mask.sum(axis=1, dtype=jnp.int32)
+    cut_e = bg.adj[0][None] & ~same[0]
+    cut_se = bg.adj[1][None] & ~same[1]
+    cut_s = bg.adj[2][None] & ~same[2]
+    cut_sw = bg.adj[3][None] & ~same[3]
+
+    if spec.contiguity == "patch":
+        contig = _stencil_patch_ok(bg, board)
+    else:  # 'none'
+        contig = jnp.ones_like(b_mask)
+
+    # same exact-f32 threshold trick as _planes; hole cells hold board
+    # -1 => is1 False, pop 0, and are excluded by b_mask regardless
+    p0 = state.dist_pop[:, 0].astype(jnp.float32)
+    p1 = state.dist_pop[:, 1].astype(jnp.float32)
+    lo = jnp.ceil(params.pop_lo)
+    hi = jnp.floor(params.pop_hi)
+    thr0 = jnp.minimum(p0 - lo, hi - p1)
+    thr1 = jnp.minimum(p1 - lo, hi - p0)
+    is1 = board == 1
+    popn = bg.pop[None].astype(jnp.float32)
+    pop_ok = popn <= jnp.where(is1, thr1[:, None], thr0[:, None])
+
+    valid = b_mask & contig & pop_ok
+    return dict(valid=valid, b_count=b_count, diff_deg=diff_deg,
+                cut_e=cut_e, cut_se=cut_se, cut_s=cut_s, cut_sw=cut_sw)
+
+
+def _interface_stencil(bg: BoardGraph, cuts):
+    """step.interface_metrics on the lowered planes, gather-free: each
+    wall edge's static int32 key packs (canonical edge index << coord
+    bits | doubled midpoint coords), so min-reducing keys over the cut
+    planes selects the two smallest-INDEX wall-cut edges (the general
+    path's deterministic choice) and the midpoints decode arithmetically
+    from the winning keys. Exact in f32: integer coords, *0.5 decode."""
+    qx_off, qy_off, bx, by = bg.iface_decode
+    big = jnp.int32(2 ** 30)
+    keyed = [jnp.where(cuts[d], bg.iface_key[d][None], big)
+             for d in range(4)]
+    first = keyed[0].min(axis=1)
+    for kd in keyed[1:]:
+        first = jnp.minimum(first, kd.min(axis=1))
+    second = None
+    for kd in keyed:
+        s = jnp.where(kd > first[:, None], kd, big).min(axis=1)
+        second = s if second is None else jnp.minimum(second, s)
+    ok = second < big
+
+    def decode(key):
+        qy = (key & ((1 << by) - 1)) + qy_off
+        qx = ((key >> by) & ((1 << bx) - 1)) + qx_off
+        return qx.astype(jnp.float32) * 0.5, qy.astype(jnp.float32) * 0.5
+
+    ax, ay = decode(first)
+    ex, ey = decode(second)
+    dx, dy = ex - ax, ey - ay
+    slope = jnp.where(dx != 0, dy / jnp.where(dx != 0, dx, 1.0), jnp.inf)
+    cx = jnp.float32(bg.center[0])
+    cy = jnp.float32(bg.center[1])
+    vax, vay = ax - cx, ay - cy
+    vbx, vby = ex - cx, ey - cy
+    norm = (jnp.sqrt(vax * vax + vay * vay)
+            * jnp.sqrt(vbx * vbx + vby * vby))
+    cosang = jnp.clip((vax * vbx + vay * vby) / jnp.maximum(norm, 1e-12),
+                      -1.0, 1.0)
+    angle = jnp.arccos(cosang)
+    nan = jnp.float32(jnp.nan)
+    return (jnp.where(ok, slope, nan).astype(jnp.float32),
+            jnp.where(ok, angle, nan).astype(jnp.float32))
+
+
+_CUT_KEYS = ("cut_e", "cut_se", "cut_s", "cut_sw")
+
+
+def _record_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
+                    state: BoardState, cts16, planes, cur_wait):
+    """The lowered body's measurement yield: 4 cut-plane accumulators,
+    node-rank abits packing (holes excluded), interface slope/angle."""
+    state, out, log = _record_common(state, planes["b_count"], cur_wait)
+    if spec.record_interface:
+        if not bg.iface_ok:
+            raise ValueError("record_interface needs wall planes the "
+                             "lowering could not encode (lower.stencil)")
+        out["slope"], out["angle"] = _interface_stencil(
+            bg, [planes[k] for k in _CUT_KEYS])
+    if spec.record_assignment_bits:
+        bits_per = max(1, (spec.n_districts - 1).bit_length())
+        if bg.n_real * bits_per > 32:
+            raise ValueError("record_assignment_bits needs n_nodes * "
+                             "ceil(log2(k)) <= 32")
+        rank = jnp.cumsum(bg.node_mask.astype(jnp.uint32)) - 1
+        shifts = (rank * bits_per)[None, :]
+        out["abits"] = jnp.sum(
+            jnp.where(bg.node_mask[None],
+                      state.board.astype(jnp.uint32) << shifts, 0),
+            axis=1, dtype=jnp.uint32)
+    cts16 = tuple(a + planes[k].astype(jnp.int16)
+                  for a, k in zip(cts16, _CUT_KEYS))
+    return state, cts16, out, log
+
+
+def _transition_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
+                        state: BoardState, planes, kprop, kacc):
+    """The lowered transition: identical structure to ``_transition``,
+    with degree/boundary arithmetic over all 8 masked directions."""
+    c, n = state.board.shape
+    h, w = bg.h, bg.w
+    cidx = jnp.arange(c)
+
+    flat, any_valid = _select_two_level(planes["valid"], _uniform(kprop),
+                                        h, w)
+
+    d_from = state.board[cidx, flat].astype(jnp.int32)
+    d_to = 1 - d_from
+    dd = planes["diff_deg"][cidx, flat].astype(jnp.int32)
+    dcut = bg.deg[flat] - 2 * dd
+
+    if spec.accept == "corrected":
+        # 8-direction generalization of the rook nbr_delta (see
+        # _transition): a neighbor u enters the boundary iff its only
+        # relation changed (same -> cut with diff_deg 0), leaves iff its
+        # only cut edge was to v; v leaves iff all neighbors differed
+        diff_deg_p = planes["diff_deg"].astype(jnp.int32)
+        board_i = state.board.astype(jnp.int32)
+        delta = jnp.zeros(c, jnp.int32)
+        for d, off in enumerate(_ring_offsets(w)):
+            exists = bg.adj[d][flat]
+            uc = jnp.clip(flat + off, 0, n - 1)
+            same_u = board_i[cidx, uc] == d_from
+            dd_u = diff_deg_p[cidx, uc]
+            delta = delta + jnp.where(
+                exists,
+                jnp.where(same_u & (dd_u == 0), 1,
+                          jnp.where(~same_u & (dd_u == 1), -1, 0)),
+                0)
+        b_new = (planes["b_count"] + delta
+                 - (dd == bg.deg[flat]).astype(jnp.int32))
+        corr_log = (jnp.log(planes["b_count"].astype(jnp.float32))
+                    - jnp.log(jnp.maximum(b_new, 1).astype(jnp.float32)))
+    else:
+        corr_log = None
+    accept = _accept_decision(spec, params, state.move_clock, dcut,
+                              any_valid, kacc, corr_log)
+
+    sel = (jnp.arange(n)[None, :] == flat[:, None]) & accept[:, None]
+    board = jnp.where(sel, d_to[:, None].astype(state.board.dtype),
+                      state.board)
+    popv = bg.pop[flat] * accept.astype(jnp.int32)
+    sgn = jnp.where(d_from == 0, 1, -1)
+    dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
+    dist_pop = dist_pop.at[:, 1].add(popv * sgn)
+
+    return _commit_transition(state, params, board, dist_pop, flat, d_to,
+                              dcut, accept, any_valid)
 
 
 # ---------------------------------------------------------------------------
@@ -790,6 +1104,43 @@ def apply_flip_log(part_sum, last_flipped, num_flips, log_f, log_s, t0,
 
 _BOOKKEEPING = ("part_sum", "last_flipped", "num_flips",
                 "cut_times_e", "cut_times_s")
+_BOOKKEEPING_DIAG = ("cut_times_se", "cut_times_sw")
+
+
+def _bookkeeping_names(state: BoardState) -> tuple:
+    """The heavy per-node accumulators kept OUT of the scan carry; the
+    diagonal cut_times planes exist only on the lowered body."""
+    extra = tuple(k for k in _BOOKKEEPING_DIAG
+                  if getattr(state, k) is not None)
+    return _BOOKKEEPING + extra
+
+
+def _scan_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
+                  loop_state: BoardState, chunk: int, collect: bool):
+    """The chunk scan on the lowered stencil body: masked 8-direction
+    planes (holes, diagonal/seam edges), exact B2-window contiguity,
+    keyed-plane interface metrics. Same scan shape as the int8 rook body
+    — heavy accumulators (4 cut_times planes) ride int16 beside the
+    carry and fold afterwards."""
+    c, n = loop_state.board.shape
+
+    def body(carry, _):
+        state, cts16 = carry
+        key, kprop, kacc, kwait = _split4(state.key)
+        state = state.replace(key=key)
+        planes = _planes_stencil(bg, spec, params, state)
+        cur_wait = _complete_wait(spec, state, planes["b_count"], kwait,
+                                  bg.n_real)
+        state, cts16, out, log = _record_stencil(
+            bg, spec, params, state, cts16, planes, cur_wait)
+        state = _transition_stencil(bg, spec, params, state, planes,
+                                    kprop, kacc)
+        return (state, cts16), (out if collect else {}, log)
+
+    ct0 = tuple(jnp.zeros((c, n), jnp.int16) for _ in _CUT_KEYS)
+    (loop_state, cts16), (outs, logs) = jax.lax.scan(
+        body, (loop_state, ct0), None, length=chunk)
+    return loop_state, outs, logs, cts16
 
 
 def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
@@ -943,19 +1294,31 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
     n = bg.n
     c = state.board.shape[0]
     t0 = state.t_yield
-    big = {k: getattr(state, k) for k in _BOOKKEEPING}
+    names = _bookkeeping_names(state)
+    big = {k: getattr(state, k) for k in names}
     loop_state = state.replace(
-        **{k: None for k in _BOOKKEEPING})
+        **{k: None for k in names})
 
-    bits_ok = (bitboard.supported_pair(bg, spec)
-               if spec.proposal == "pair"
-               else bitboard.supported(bg, spec))
-    if bits and not bits_ok:
-        raise ValueError("bits=True: workload not supported by the "
-                         "bit-board body (see bitboard.supported / "
-                         "supported_pair)")
-    use_bits = bits_ok if bits is None else bits
-    if use_bits:
+    lowered = bg.surgical or spec.record_interface
+    if lowered:
+        if bits:
+            raise ValueError("bits=True: the lowered stencil body has no "
+                             "bit-board backend")
+        loop_state, outs, logs, cts16 = _scan_stencil(
+            bg, spec, params, loop_state, chunk, collect)
+        for k, ct in zip(("cut_times_e", "cut_times_se", "cut_times_s",
+                          "cut_times_sw"), cts16):
+            big[k] = big[k] + ct
+    elif (bits if bits is not None else
+          (bitboard.supported_pair(bg, spec)
+           if spec.proposal == "pair" else bitboard.supported(bg, spec))):
+        bits_ok = (bitboard.supported_pair(bg, spec)
+                   if spec.proposal == "pair"
+                   else bitboard.supported(bg, spec))
+        if not bits_ok:
+            raise ValueError("bits=True: workload not supported by the "
+                             "bit-board body (see bitboard.supported / "
+                             "supported_pair)")
         scan_bits = (_scan_bits_pair if spec.proposal == "pair"
                      else _scan_bits)
         (loop_state, outs, logs, cte, cts) = scan_bits(
@@ -1001,10 +1364,28 @@ def record_final(bg: BoardGraph, spec: Spec, params: StepParams,
     """Epilogue: complete any pending wait and record the last yield,
     without a trailing transition."""
     t0 = state.t_yield
-    big = {k: getattr(state, k) for k in _BOOKKEEPING}
-    loop_state = state.replace(**{k: None for k in _BOOKKEEPING})
+    names = _bookkeeping_names(state)
+    big = {k: getattr(state, k) for k in names}
+    loop_state = state.replace(**{k: None for k in names})
     key, _, _, kwait = _split4(loop_state.key)
     loop_state = loop_state.replace(key=key)
+    if bg.surgical or spec.record_interface:
+        planes = _planes_stencil(bg, spec, params, loop_state)
+        cur_wait = _complete_wait(spec, loop_state, planes["b_count"],
+                                  kwait, bg.n_real)
+        ct16 = tuple(jnp.zeros_like(big["cut_times_e"], jnp.int16)
+                     for _ in _CUT_KEYS)
+        loop_state, cts16, out, log = _record_stencil(
+            bg, spec, params, loop_state, ct16, planes, cur_wait)
+        for k, ct in zip(("cut_times_e", "cut_times_se", "cut_times_s",
+                          "cut_times_sw"), cts16):
+            big[k] = big[k] + ct
+        if spec.parity_metrics:
+            big["part_sum"], big["last_flipped"], big["num_flips"] = \
+                apply_flip_log(big["part_sum"], big["last_flipped"],
+                               big["num_flips"], log["f"][None],
+                               log["s"][None], t0)
+        return loop_state.replace(**big), out
     planes = (_planes_pair if spec.proposal == "pair" else _planes)(
         bg, spec, params, loop_state)
     cur_wait = _complete_wait(spec, loop_state, planes["b_count"], kwait,
@@ -1030,20 +1411,27 @@ def record_final(bg: BoardGraph, spec: Spec, params: StepParams,
 def init_board_state(graph: LatticeGraph, bg: BoardGraph,
                      assignment: np.ndarray, n_chains: int, seed: int,
                      spec: Spec, params: StepParams) -> BoardState:
+    """Broadcast a node-order assignment (length n_real) onto the canvas
+    (holes carry district -1, pop 0) and seed the per-chain state."""
     n = bg.n
-    a0 = np.asarray(assignment, np.int8)
+    lowered = bg.surgical or spec.record_interface
+    a_nodes = np.asarray(assignment, np.int8)
+    cell_of_node = np.asarray(bg.cell_of_node)
+    a0 = np.full(n, -1, np.int8)
+    a0[cell_of_node] = a_nodes
     board = jnp.broadcast_to(jnp.asarray(a0), (n_chains, n))
-    pops = np.bincount(a0.astype(np.int64), weights=graph.pop,
+    pops = np.bincount(a_nodes.astype(np.int64), weights=graph.pop,
                        minlength=spec.n_districts).astype(np.int32)
     dist_pop = jnp.broadcast_to(jnp.asarray(pops),
                                 (n_chains, spec.n_districts))
     keys = jax.random.key_data(
         jax.random.split(jax.random.PRNGKey(seed), n_chains))
     label_values = np.asarray(params.label_values)
-    part0 = label_values[a0.astype(np.int64)].astype(np.int32)
-    a2 = a0.reshape(bg.h, bg.w)
-    cut0 = int((a2[:, :-1] != a2[:, 1:]).sum()
-               + (a2[:-1, :] != a2[1:, :]).sum())
+    part0 = np.zeros(n, np.int32)
+    part0[cell_of_node] = label_values[a_nodes.astype(np.int64)]
+    cut0 = int((a_nodes[graph.edges[:, 0]]
+                != a_nodes[graph.edges[:, 1]]).sum())
+    zplane = jnp.zeros((n_chains, n), jnp.int32)
     return BoardState(
         key=keys,
         board=board,
@@ -1058,40 +1446,39 @@ def init_board_state(graph: LatticeGraph, bg: BoardGraph,
         t_yield=jnp.zeros(n_chains, jnp.int32),
         move_clock=jnp.zeros(n_chains, jnp.int32),
         part_sum=jnp.broadcast_to(jnp.asarray(part0), (n_chains, n)),
-        last_flipped=jnp.zeros((n_chains, n), jnp.int32),
-        num_flips=jnp.zeros((n_chains, n), jnp.int32),
-        cut_times_e=jnp.zeros((n_chains, n), jnp.int32),
-        cut_times_s=jnp.zeros((n_chains, n), jnp.int32),
+        last_flipped=zplane,
+        num_flips=zplane,
+        cut_times_e=zplane,
+        cut_times_s=zplane,
         waits_sum=jnp.zeros(n_chains, jnp.float32),
         accept_count=jnp.zeros(n_chains, jnp.int32),
         tries_sum=jnp.zeros(n_chains, jnp.int32),
         exhausted_count=jnp.zeros(n_chains, jnp.int32),
+        cut_times_se=zplane if lowered else None,
+        cut_times_sw=zplane if lowered else None,
     )
-
-
-@dataclasses.dataclass(frozen=True)
-class _EdgeIndex:
-    east: np.ndarray    # bool[E] edge is (i, i+1); else (i, i+W)
-    lo: np.ndarray      # int64[E] flat index of the smaller endpoint
-
-
-def _edge_index(graph: LatticeGraph) -> _EdgeIndex:
-    h, w = board_shape(graph)
-    lab = np.array(graph.labels, np.int64)
-    a = lab[graph.edges[:, 0]]
-    b = lab[graph.edges[:, 1]]
-    lo = np.minimum(a, b)
-    east = a[:, 0] == b[:, 0]
-    return _EdgeIndex(east=east, lo=lo[:, 0] * w + lo[:, 1])
 
 
 def edge_cut_times(graph: LatticeGraph, state: BoardState) -> np.ndarray:
     """cut_times as an (C, E) array in LatticeGraph edge order (for the
-    artifact pipeline and general-path parity tests)."""
-    ei = _edge_index(graph)
-    te = np.asarray(state.cut_times_e)
-    ts = np.asarray(state.cut_times_s)
-    out = np.empty((te.shape[0], graph.n_edges), te.dtype)
-    out[:, ei.east] = te[:, ei.lo[ei.east]]
-    out[:, ~ei.east] = ts[:, ei.lo[~ei.east]]
+    artifact pipeline and general-path parity tests). Each edge's plane
+    and cell come from the lowering's per-edge map, so holes, diagonal
+    and seam edges land in the right accumulator."""
+    st = stencil_for(graph)
+    planes = {0: np.asarray(state.cut_times_e),
+              2: np.asarray(state.cut_times_s)}
+    if state.cut_times_se is not None:
+        planes[1] = np.asarray(state.cut_times_se)
+        planes[3] = np.asarray(state.cut_times_sw)
+    c = planes[0].shape[0]
+    out = np.empty((c, graph.n_edges), planes[0].dtype)
+    for d in (0, 1, 2, 3):
+        sel = np.asarray(st.edge_plane) == d
+        if not sel.any():
+            continue
+        if d not in planes:
+            raise ValueError("graph has diagonal edges but state has no "
+                             "diagonal cut_times planes (the chunk was "
+                             "not run on the lowered body)")
+        out[:, sel] = planes[d][:, np.asarray(st.edge_cell)[sel]]
     return out

@@ -52,18 +52,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..graphs.lattice import LatticeGraph
-from .board import (BoardGraph, BoardState, recount_cuts,
+from .board import (BoardGraph, BoardState, board_shape, recount_cuts,
                     supports as _board_supports)
 from .step import Spec, StepParams
 
 
 def supports(graph: LatticeGraph, spec: Spec, params: StepParams,
              n_chains: int, block_chains: int = 128) -> bool:
-    """The pallas path serves the benchmark family: everything the board
-    path supports, with reference +1/-1 labels and a block-divisible
-    batch."""
+    """The pallas path serves the benchmark family: plain full rook
+    grids (the hand-written kernel hardcodes the 4-neighbor stencil —
+    lowered surgical graphs run the masked-plane body in board.py),
+    reference +1/-1 labels, and a block-divisible batch."""
     lv = np.asarray(params.label_values)
-    return (_board_supports(graph, spec)
+    return (board_shape(graph) is not None
+            and not spec.record_interface
+            and _board_supports(graph, spec)
             and spec.n_districts == 2
             and spec.proposal == "bi"
             and spec.accept == "cut"

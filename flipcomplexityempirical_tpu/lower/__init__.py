@@ -1,0 +1,8 @@
+"""Stencil lowering subsystem: compile near-grid graphs onto the board
+kernel's masked-plane representation (see lower/stencil.py docstring)."""
+
+from .dispatch import kernel_path_for
+from .stencil import IFACE_BIG, StencilSpec, lower_to_stencil, stencil_for
+
+__all__ = ["IFACE_BIG", "StencilSpec", "kernel_path_for",
+           "lower_to_stencil", "stencil_for"]

@@ -1,0 +1,34 @@
+"""Kernel-path resolution: which body will run a (graph, spec) workload.
+
+The dispatch order is lowered stencil -> bitboard -> int8 board ->
+general: ``kernel/board.py::supports`` decides whether the board family
+applies at all (via the lowering pass), and ``body_for`` picks the body
+within it. This module exposes that decision as a cheap, import-light
+query for tagging — bench records, obs events, reports — so fallback
+regressions show up in scoreboards instead of silently running 50x
+slower. Kernel imports happen lazily inside the functions to keep
+``lower`` importable from the kernel layer without cycles.
+"""
+
+from __future__ import annotations
+
+from ..graphs.lattice import LatticeGraph
+from .stencil import stencil_for
+
+
+def kernel_path_for(graph: LatticeGraph, spec) -> str:
+    """'lowered' | 'bitboard' | 'board' | 'general' — the body the
+    runners will select for this workload (sampling/board_runner.py +
+    kernel/board.py::run_board_chunk dispatch, bits=None auto)."""
+    from ..kernel import bitboard, board
+
+    if not board.supports(graph, spec):
+        return "general"
+    st = stencil_for(graph)
+    if st.surgical or spec.record_interface:
+        return "lowered"
+    # bitboard gates duck-type on (uniform_pop, w, n, surgical), which
+    # StencilSpec provides — no BoardGraph construction needed here
+    bits_ok = (bitboard.supported_pair(st, spec)
+               if spec.proposal == "pair" else bitboard.supported(st, spec))
+    return "bitboard" if bits_ok else "board"

@@ -89,7 +89,7 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
 
 
 def _emit_board_chunks(rec, chunk_meta, acc0, n_chains, n_transitions,
-                       transfer_total, hbm_bytes):
+                       transfer_total, hbm_bytes, path="board"):
     """Flush the deferred per-chunk telemetry of a board run. The board
     loop never syncs mid-run (waits and accept counts are stashed as
     device refs so dispatch pipelines); the accept readbacks happen HERE,
@@ -103,7 +103,7 @@ def _emit_board_chunks(rec, chunk_meta, acc0, n_chains, n_transitions,
     for steps, wall, tb, hbm, acc_ref, ts in chunk_meta:
         acc = int(np.asarray(acc_ref, np.int64).sum())
         done += steps
-        rec.emit("chunk", ts=ts, runner="board", steps=steps,
+        rec.emit("chunk", ts=ts, runner="board", path=path, steps=steps,
                  chains=n_chains, flips=n_chains * steps, wall_s=wall,
                  flips_per_s=n_chains * steps / max(wall, 1e-12),
                  accept_rate=(acc - last_acc) / (n_chains * steps),
@@ -150,8 +150,12 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
     pending_waits: list = []
 
     n_chains = state.waits_sum.shape[0]
+    # which body run_board_chunk will select (lowered / bitboard / board)
+    # — tagged on every event so fallback regressions are visible in
+    # scoreboards (tools/obs_report.py breaks throughput out per path)
+    path = kboard.body_for(bg, spec, bits)
     if rec:
-        rec.emit("run_start", runner="board", chains=n_chains,
+        rec.emit("run_start", runner="board", path=path, chains=n_chains,
                  n_steps=n_transitions, chunk=chunk,
                  record_history=record_history, record_every=record_every,
                  history_device=history_device)
@@ -199,8 +203,9 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
         flips = n_chains * n_transitions
         accept_rate = _emit_board_chunks(
             rec, chunk_meta, acc0, n_chains, n_transitions,
-            transfer_total, hbm_bytes)
-        rec.emit("run_end", runner="board", n_yields=n_transitions,
+            transfer_total, hbm_bytes, path=path)
+        rec.emit("run_end", runner="board", path=path,
+                 n_yields=n_transitions,
                  chains=n_chains, flips=flips, wall_s=wall,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=accept_rate, transfer_bytes=transfer_total,

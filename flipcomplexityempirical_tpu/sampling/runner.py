@@ -204,7 +204,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         chunk = snap_chunk_to(chunk, record_every)
 
     if rec:
-        rec.emit("run_start", runner="general", chains=n_chains,
+        rec.emit("run_start", runner="general", path="general",
+                 chains=n_chains,
                  n_steps=n_steps, chunk=chunk,
                  record_history=record_history, record_every=record_every,
                  record_initial=record_initial,
@@ -267,7 +268,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
             now = time.perf_counter()
             wall = now - t_prev
             t_prev = now
-            rec.emit("chunk", runner="general", steps=this,
+            rec.emit("chunk", runner="general", path="general",
+                     steps=this,
                      chains=n_chains, flips=n_chains * this,
                      wall_s=wall,
                      flips_per_s=n_chains * this / max(wall, 1e-12),
@@ -281,7 +283,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     if rec:
         wall = time.perf_counter() - t_run0
         flips = n_chains * (n_steps - done0)
-        rec.emit("run_end", runner="general", n_yields=n_steps,
+        rec.emit("run_end", runner="general", path="general",
+                 n_yields=n_steps,
                  chains=n_chains, flips=flips, wall_s=wall,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=(last_acc - acc_start) / max(flips, 1),

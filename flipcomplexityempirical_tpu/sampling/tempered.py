@@ -176,6 +176,8 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
 
     transitions = n_steps if segment else n_steps - 1
     rec = obs.resolve_recorder(recorder)
+    path = (kboard.body_for(graph_handle, spec, bits) if is_board
+            else "general")
     if rec:
         chunk_fn = kboard.run_board_chunk if is_board else runner._run_chunk
         watch = obs.JitWatch(
@@ -186,7 +188,7 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                  n_ladders=n_ladders, swap_every=swap_every,
                  segment=segment, record_history=record_history,
                  record_every=record_every,
-                 path="board" if is_board else "general")
+                 path=path)
         t_run0 = t_prev = time.perf_counter()
         last_acc = int(np.asarray(states.accept_count, np.int64).sum())
         acc_start, transfer_total = last_acc, 0
@@ -229,7 +231,8 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
             wall = now - t_prev
             t_prev = now
             transfer_total += transfer_bytes
-            rec.emit("chunk", runner="tempered", steps=this, chains=c,
+            rec.emit("chunk", runner="tempered", path=path, steps=this,
+                     chains=c,
                      flips=c * this, wall_s=wall,
                      flips_per_s=c * this / max(wall, 1e-12),
                      accept_rate=(acc - last_acc) / (c * this),
@@ -266,7 +269,8 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
     if rec:
         wall = time.perf_counter() - t_run0
         flips = c * transitions
-        rec.emit("run_end", runner="tempered", n_yields=n_steps,
+        rec.emit("run_end", runner="tempered", path=path,
+                 n_yields=n_steps,
                  chains=c, flips=flips, wall_s=wall,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=(last_acc - acc_start) / max(flips, 1),

@@ -382,9 +382,12 @@ def test_record_every_is_a_stride(path, every):
 def test_supports_gates():
     spec = fce.Spec(contiguity="patch")
     assert kb.supports(fce.graphs.square_grid(6, 6), spec)
-    # non-board graphs and unsupported specs must fall back
-    assert not kb.supports(fce.graphs.grid_sec11(), spec)
-    assert not kb.supports(fce.graphs.frankengraph(), spec)
+    # the paper's near-grid graphs lower onto the stencil fast path
+    # (lower.lower_to_stencil); hex falls back — its radius-3 patch
+    # tables don't match the lowering's radius-2 B2 windows
+    assert kb.supports(fce.graphs.grid_sec11(), spec)
+    assert kb.supports(fce.graphs.frankengraph(), spec)
+    assert not kb.supports(fce.graphs.hex_lattice(4, 4), spec)
     g = fce.graphs.square_grid(6, 6)
     assert not kb.supports(g, fce.Spec(contiguity="exact"))
     # the k-district pair walk has its own body (uniform pop, no

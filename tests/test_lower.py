@@ -1,0 +1,523 @@
+"""Stencil-lowering subsystem verification (flipcomplexityempirical_tpu/lower).
+
+Five layers:
+
+1. Lowering shapes: ``lower_to_stencil`` embeds the paper's two surgical
+   graphs (grid_sec11, frankengraph) and queen grids exactly — canvas
+   dims, hole masks, per-direction adjacency planes, edge mapping — and
+   refuses what it cannot embed (tiny canvases, non-king edges).
+2. Dispatch: ``kernel_path_for`` routes each workload to the body the
+   runners actually select (lowered / board / general).
+3. Local equivalence of the lowered primitives against the general
+   kernel's: the offset-keyed B2 bitset propagation vs
+   ``contiguity.patch_connected`` per node on random boards, and the
+   keyed min-reduce interface metrics vs ``step.interface_metrics``
+   bit-for-bit on sec11.
+4. Exact per-run invariants of the lowered body (cut recount, district
+   populations, hole cells, edge_cut_times tie-out) plus the checkpoint
+   field-mismatch guard.
+5. Distributional parity (slow): lowered vs general trajectories on the
+   real sec11/frank workloads, and — the exact-enumeration bar — the
+   lowered path vs the power-iterated stationary distribution of the
+   literal transition matrix on a small surgically-modified grid, with
+   a chi-square occupancy gate and the compat/ oracle as referee.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import compat, lower
+from flipcomplexityempirical_tpu.kernel import board as kb
+from flipcomplexityempirical_tpu.kernel import contiguity
+from flipcomplexityempirical_tpu.kernel import step as kstep
+
+from test_parity import ks_stat
+
+
+def surgical_grid(h=5, w=7):
+    """A small grid with the full surgery menu: two holes (one corner,
+    one interior) and two diagonal bypass edges."""
+    return fce.graphs.square_grid(
+        h, w, remove_nodes=[(0, 0), (2, 3)],
+        extra_edges=[((0, 1), (1, 0)), ((3, 4), (4, 5))])
+
+
+# ---------------------------------------------------------------------------
+# 1. lowering shapes
+# ---------------------------------------------------------------------------
+
+def _check_embedding(g, st):
+    """Structural consistency of a StencilSpec against its graph."""
+    cell = np.asarray(st.cell_of_node)
+    mask = np.asarray(st.node_mask)
+    assert st.n == st.h * st.w
+    assert st.n_real == g.n_nodes
+    assert mask.sum() == g.n_nodes
+    assert np.unique(cell).size == g.n_nodes and mask[cell].all()
+    # holes carry zero degree/pop; real cells carry the graph's
+    deg = np.zeros(g.n_nodes, np.int64)
+    np.add.at(deg, g.edges.ravel(), 1)
+    assert (np.asarray(st.deg)[cell] == deg).all()
+    assert (np.asarray(st.deg)[~mask] == 0).all()
+    assert (np.asarray(st.pop)[~mask] == 0).all()
+    # every edge appears in exactly one forward plane at its smaller
+    # endpoint's cell, and the adjacency planes are symmetric: the total
+    # plane population double-counts each edge once per endpoint
+    assert np.asarray(st.adj).sum() == 2 * len(g.edges)
+    assert len(st.edge_plane) == len(g.edges)
+    assert ((np.asarray(st.edge_plane) >= 0)
+            & (np.asarray(st.edge_plane) < 4)).all()
+    adj = np.asarray(st.adj)
+    assert adj[np.asarray(st.edge_plane), np.asarray(st.edge_cell)].all()
+
+
+def test_lower_sec11():
+    g = fce.graphs.grid_sec11()
+    st = lower.lower_to_stencil(g)
+    assert st is not None
+    assert (st.h, st.w) == (40, 40)
+    assert st.n_real == 1596 and st.surgical and not st.plain
+    assert st.patch_exact and st.iface_ok
+    _check_embedding(g, st)
+    # the 4 corner cells plus nothing else are holes
+    assert (~np.asarray(st.node_mask)).sum() == 4
+
+
+def test_lower_frankengraph():
+    g = fce.graphs.frankengraph()
+    st = lower.lower_to_stencil(g)
+    assert st is not None
+    assert st.n_real == 800 and st.surgical
+    assert st.h * st.w == 800          # seam canvas has no holes
+    assert st.patch_exact and st.iface_ok
+    _check_embedding(g, st)
+
+
+def test_lower_queen_and_plain():
+    q = fce.graphs.square_grid(6, queen=True)
+    st = lower.lower_to_stencil(q)
+    assert st is not None and st.surgical and st.patch_exact
+    _check_embedding(q, st)
+
+    p = fce.graphs.square_grid(6, 6)
+    st = lower.lower_to_stencil(p)
+    assert st is not None and st.plain and not st.surgical
+    _check_embedding(p, st)
+
+
+def test_queen_builder_counts():
+    """Satellite: the queen option of square_grid — n^2 nodes,
+    2n(n-1) rook + 2(n-1)^2 diagonal edges (the reference's commented
+    queen block, grid_chain_sec11.py:241-249)."""
+    for n in (3, 6, 8):
+        g = fce.graphs.square_grid(n, queen=True)
+        assert g.name == f"queen{n}x{n}"
+        assert g.n_nodes == n * n
+        assert len(g.edges) == 2 * n * (n - 1) + 2 * (n - 1) ** 2
+    # rook default unchanged
+    g = fce.graphs.square_grid(4, 5)
+    assert g.name == "grid4x5" and len(g.edges) == 4 * 4 + 3 * 5
+
+
+def test_lower_rejections():
+    # canvases thinner than the ring's aliasing bound
+    assert lower.lower_to_stencil(fce.graphs.square_grid(2, 5)) is None
+    # a non-king extra edge cannot be a stencil plane
+    far = fce.graphs.square_grid(5, 5, extra_edges=[((0, 0), (0, 4))])
+    assert lower.lower_to_stencil(far) is None
+    # hex lowers structurally but its radius-3 patch tables don't match
+    # the radius-2 B2 windows => never patch_exact
+    st = lower.lower_to_stencil(fce.graphs.hex_lattice(4, 4))
+    assert st is None or not st.patch_exact
+
+
+# ---------------------------------------------------------------------------
+# 2. dispatch
+# ---------------------------------------------------------------------------
+
+def test_kernel_path_routing():
+    spec = fce.Spec(contiguity="patch")
+    assert lower.kernel_path_for(fce.graphs.grid_sec11(), spec) == "lowered"
+    assert lower.kernel_path_for(fce.graphs.frankengraph(), spec) == "lowered"
+    assert lower.kernel_path_for(
+        fce.graphs.square_grid(6, queen=True), spec) == "lowered"
+    assert lower.kernel_path_for(fce.graphs.square_grid(6, 6), spec) == "board"
+    assert lower.kernel_path_for(fce.graphs.hex_lattice(4, 4),
+                                 spec) == "general"
+    # record_interface: lowered where wall planes encode, general where
+    # the graph has no walls at all
+    ispec = fce.Spec(record_interface=True)
+    assert lower.kernel_path_for(fce.graphs.grid_sec11(), ispec) == "lowered"
+    assert lower.kernel_path_for(fce.graphs.square_grid(6, 6),
+                                 ispec) == "general"
+    # dispatch agrees with the body the runner will build
+    for g in (fce.graphs.grid_sec11(), fce.graphs.square_grid(6, 6)):
+        bg = kb.make_board_graph(g)
+        assert lower.kernel_path_for(g, spec) == kb.body_for(bg, spec)
+
+
+# ---------------------------------------------------------------------------
+# 3. lowered primitives == general primitives
+# ---------------------------------------------------------------------------
+
+def test_b2_contiguity_matches_patch_connected(rng):
+    """The offset-keyed bitset propagation is patch_connected, exactly:
+    every node of every random board agrees, on a grid with holes and
+    diagonals and on a queen grid."""
+    for g, trials in ((surgical_grid(), 12),
+                      (fce.graphs.square_grid(6, queen=True), 8)):
+        bg = kb.make_board_graph(g)
+        dg = g.device()
+        cell = np.asarray(bg.cell_of_node)
+        pc = jax.vmap(contiguity.patch_connected, in_axes=(None, None, 0, 0))
+        vs = jnp.arange(g.n_nodes)
+        for _ in range(trials):
+            a = rng.integers(0, 2, g.n_nodes).astype(np.int8)
+            board = np.full(bg.n, -1, np.int8)
+            board[cell] = a
+            ok = np.asarray(kb._stencil_patch_ok(bg, jnp.asarray(board[None])))
+            av = jnp.asarray(a)
+            ref = np.asarray(pc(dg, av, vs, av[vs].astype(jnp.int32)))
+            np.testing.assert_array_equal(ok[0, cell], ref)
+
+
+def test_interface_planes_match_general(rng):
+    """Keyed min-reduce slope/angle == step.interface_metrics bit-for-bit
+    on sec11 (same two smallest-index wall-cut edges, same f32 math)."""
+    g = fce.graphs.grid_sec11()
+    bg = kb.make_board_graph(g)
+    dg = g.device()
+    cell = np.asarray(bg.cell_of_node)
+    for _ in range(6):
+        a = rng.integers(0, 2, g.n_nodes).astype(np.int8)
+        board = np.full(bg.n, -1, np.int8)
+        board[cell] = a
+        bj = jnp.asarray(board[None])
+        same = kb._same_planes_stencil(bg, bj)
+        cuts = [bg.adj[d][None] & ~same[d] for d in range(4)]
+        slope_l, angle_l = kb._interface_stencil(bg, cuts)
+        cut_e = (a[g.edges[:, 0]] != a[g.edges[:, 1]]).astype(np.int32)
+        slope_g, angle_g = kstep.interface_metrics(dg, jnp.asarray(cut_e))
+        for lo, go in ((slope_l[0], slope_g), (angle_l[0], angle_g)):
+            lo, go = float(lo), float(go)
+            assert (np.isnan(lo) and np.isnan(go)) or lo == go, (lo, go)
+
+
+# ---------------------------------------------------------------------------
+# 4. lowered-body run invariants + checkpoint guard
+# ---------------------------------------------------------------------------
+
+def test_lowered_run_invariants():
+    g = surgical_grid()
+    spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                    invalid="repropose", accept="cut",
+                    parity_metrics=True, geom_waits=True)
+    assert kb.supports(g, spec)
+    plan = fce.graphs.stripes_plan(g, 2)
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=8, seed=9, spec=spec, base=1.3, pop_tol=0.3)
+    assert kb.body_for(bg, spec) == "lowered"
+    res = fce.sampling.run_board(bg, spec, params, st, n_steps=301, chunk=100)
+    s = res.host_state()
+    board = np.asarray(s.board)
+
+    # hole cells never change district
+    mask = np.asarray(bg.node_mask)
+    assert (board[:, ~mask] == -1).all()
+
+    # derived fields are pure functions of the board
+    cut = np.asarray(kb.recount_cuts(bg, jnp.asarray(board)))
+    np.testing.assert_array_equal(np.asarray(s.cut_count), cut)
+    a = kb.node_view(bg, board)
+    pop0 = (a == 0).sum(axis=1)
+    np.testing.assert_array_equal(np.asarray(s.dist_pop)[:, 0], pop0)
+    np.testing.assert_array_equal(np.asarray(s.dist_pop)[:, 1],
+                                  g.n_nodes - pop0)
+
+    # both districts stay connected under the graph's real adjacency
+    import networkx as nx
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    for row in a:
+        for d in (0, 1):
+            sub = gx.subgraph(np.nonzero(row == d)[0].tolist())
+            assert sub.number_of_nodes() and nx.is_connected(sub)
+
+    # diagonal cut_times planes exist and the per-edge accumulators tie
+    # out against the recorded per-yield cut counts
+    assert s.cut_times_se is not None and s.cut_times_sw is not None
+    ct = kb.edge_cut_times(g, res.state)
+    assert ct.shape == (8, len(g.edges))
+    np.testing.assert_array_equal(ct.sum(axis=1),
+                                  res.history["cut_count"].sum(axis=1))
+
+
+def test_checkpoint_field_mismatch_restarts():
+    """A checkpoint written by a different kernel path (missing state
+    fields the current path carries) must KeyError out of
+    _state_from_arrays — the _load_resume restart-from-scratch guard."""
+    from flipcomplexityempirical_tpu.experiments.driver import \
+        _state_from_arrays
+
+    g = surgical_grid()
+    spec = fce.Spec(contiguity="patch")
+    plan = fce.graphs.stripes_plan(g, 2)
+    _, st, _ = fce.sampling.init_board(
+        g, plan, n_chains=2, seed=0, spec=spec, base=1.2, pop_tol=0.3)
+    full = {f"state_{f}": np.asarray(v)
+            for f in st.__dataclass_fields__
+            if (v := getattr(st, f)) is not None}
+
+    # round-trip: every field restored, None fields stay None
+    back = _state_from_arrays(st, full)
+    for f in st.__dataclass_fields__:
+        v = getattr(st, f)
+        if v is None:
+            assert getattr(back, f) is None
+        else:
+            np.testing.assert_array_equal(np.asarray(getattr(back, f)),
+                                          np.asarray(v))
+
+    # drop a field the lowered path requires => loud KeyError
+    partial = {k: v for k, v in full.items() if k != "state_cut_times_se"}
+    with pytest.raises(KeyError):
+        _state_from_arrays(st, partial)
+
+
+# ---------------------------------------------------------------------------
+# 5. distributional parity (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("graph", ["sec11", "frank"])
+@pytest.mark.slow
+def test_lowered_matches_general_trajectory(graph):
+    """The paper's two workloads, lowered vs general (independent RNG
+    streams): same cut/b trajectory distributions, accept rates, and
+    cut-edge heat profiles — the sec11/frank analogue of
+    test_board_matches_general_path."""
+    if graph == "sec11":
+        g = fce.graphs.grid_sec11()
+        plan = fce.graphs.sec11_plan(g, alignment=0)
+    else:
+        g = fce.graphs.frankengraph()
+        plan = fce.graphs.frank_plan(g, alignment=0)
+    chains, steps, burn = 24, 4001, 800
+    base, tol = 1.4, 0.3
+    spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                    invalid="repropose", accept="cut",
+                    parity_metrics=True, geom_waits=True)
+
+    dg, st_g, par_g = fce.init_batch(g, plan, n_chains=chains, seed=11,
+                                     spec=spec, base=base, pop_tol=tol)
+    res_g = fce.run_chains(dg, spec, par_g, st_g, n_steps=steps)
+
+    bg, st_b, par_b = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=17, spec=spec, base=base, pop_tol=tol)
+    assert kb.body_for(bg, spec) == "lowered"
+    res_b = fce.sampling.run_board(bg, spec, par_b, st_b, n_steps=steps)
+
+    sub = slice(burn, None, 20)
+    for key, tol_ks in (("cut_count", 0.08), ("b_count", 0.08)):
+        a = res_g.history[key][:, sub]
+        b = res_b.history[key][:, sub]
+        ks = ks_stat(a.ravel(), b.ravel())
+        assert ks < tol_ks, f"{graph} {key} KS {ks:.4f}"
+        # means via a between-chain z-test: per-chain means are the
+        # independent unit (within-chain samples are heavily
+        # autocorrelated at this run length, so a fixed relative
+        # tolerance would mis-calibrate across graphs)
+        ma, mb = a.mean(axis=1), b.mean(axis=1)
+        se = np.sqrt(ma.var(ddof=1) / chains + mb.var(ddof=1) / chains)
+        z = abs(ma.mean() - mb.mean()) / se
+        assert z < 4.0, (f"{graph} {key} means {ma.mean():.2f} vs "
+                         f"{mb.mean():.2f} (z={z:.2f})")
+
+    aa = np.asarray(res_g.state.accept_count).mean()
+    ab = np.asarray(res_b.state.accept_count).mean()
+    assert abs(aa - ab) / aa < 0.06, f"accepts {aa:.1f} vs {ab:.1f}"
+
+    ct_g = np.asarray(res_g.state.cut_times).mean(axis=0)
+    ct_b = kb.edge_cut_times(g, res_b.state).mean(axis=0)
+    corr = np.corrcoef(ct_g, ct_b)[0, 1]
+    assert corr > 0.95, f"cut_times profile corr {corr:.3f}"
+
+
+# --- exact enumeration on a surgically-modified grid -----------------------
+
+CHI_EPS = 0.5
+
+
+def _nbr_bitmasks(g):
+    nbrmask = [0] * g.n_nodes
+    for u, v in g.edges:
+        nbrmask[u] |= 1 << int(v)
+        nbrmask[v] |= 1 << int(u)
+    return nbrmask
+
+
+def _connected(mask, nbrmask):
+    if mask == 0:
+        return False
+    reach = mask & (-mask)
+    while True:
+        grow, m = reach, reach
+        while m:
+            b = m & (-m)
+            grow |= nbrmask[b.bit_length() - 1]
+            m ^= b
+        grow &= mask
+        if grow == reach:
+            return reach == mask
+        reach = grow
+
+
+def _enumerate_states(g, nbrmask):
+    n = g.n_nodes
+    full = (1 << n) - 1
+    ideal = n / 2
+    lo, hi = (1 - CHI_EPS) * ideal, (1 + CHI_EPS) * ideal
+    states = []
+    for m in range(1, full):
+        p1 = bin(m).count("1")
+        if not (lo <= p1 <= hi and lo <= n - p1 <= hi):
+            continue
+        if _connected(m, nbrmask) and _connected(full ^ m, nbrmask):
+            states.append(m)
+    return states
+
+
+def _build_transition(states, g, base):
+    """Row-stochastic matrix of the re-propose chain with literal accept,
+    over the graph's OWN edge list (test_enumeration's build_transition
+    is rook-grid-specific; this one takes any LatticeGraph)."""
+    n = g.n_nodes
+    index = {m: i for i, m in enumerate(states)}
+    edges = g.edges
+
+    def cut_of(m):
+        a = np.array([(m >> i) & 1 for i in range(n)])
+        return int((a[edges[:, 0]] != a[edges[:, 1]]).sum())
+
+    cuts = np.array([cut_of(m) for m in states])
+    P = np.zeros((len(states), len(states)))
+    for i, m in enumerate(states):
+        a = np.array([(m >> v) & 1 for v in range(n)])
+        cut = a[edges[:, 0]] != a[edges[:, 1]]
+        bnodes = np.unique(edges[cut].ravel())
+        moves = [index[m ^ (1 << int(v))] for v in bnodes
+                 if (m ^ (1 << int(v))) in index]
+        V = len(moves)
+        assert V > 0
+        stay = 0.0
+        for j in moves:
+            acc = min(1.0, base ** (cuts[i] - cuts[j]))
+            P[i, j] += acc / V
+            stay += (1 - acc) / V
+        P[i, i] += stay
+    assert np.allclose(P.sum(axis=1), 1.0)
+    return P, cuts
+
+
+def _stationary(P):
+    pi = np.full(P.shape[0], 1.0 / P.shape[0])
+    for _ in range(20000):
+        nxt = pi @ P
+        if np.abs(nxt - pi).max() < 1e-13:
+            break
+        pi = nxt
+    return pi / pi.sum()
+
+
+def _occupancy_checks(masks, states, pi, cuts, label, tv_tol=0.06,
+                      cut_tol=0.02, chi2_tol=None):
+    """TV + E[cut] (the repo's standard gates) plus, when requested, a
+    chi-square occupancy statistic over thinned samples."""
+    index = {m: i for i, m in enumerate(states)}
+    idx = np.array([index[int(m)] for m in masks])   # KeyError => invalid
+    emp = np.bincount(idx, minlength=len(states)).astype(float)
+    tot = emp.sum()
+    tv = 0.5 * np.abs(emp / tot - pi).sum()
+    assert tv < tv_tol, f"{label}: TV {tv:.4f} (|S|={len(states)})"
+    e_exact = float((pi * cuts).sum())
+    e_emp = float((emp / tot * cuts).sum())
+    assert abs(e_emp - e_exact) / e_exact < cut_tol, \
+        f"{label}: E[cut] {e_emp:.3f} vs {e_exact:.3f}"
+    if chi2_tol is not None:
+        exp = pi * tot
+        chi2 = float((((emp - exp) ** 2) / exp).sum())
+        dof = len(states) - 1
+        assert chi2 < chi2_tol * dof, \
+            f"{label}: chi2/dof {chi2 / dof:.2f} (dof={dof})"
+
+
+@pytest.mark.slow
+def test_lowered_matches_exact_stationary_chi2():
+    """Satellite: the exact-enumeration bar for the SURGICAL fast path.
+    A 3x4 grid with one corner removed and one diagonal bypass edge (the
+    sec11 surgery in miniature) routes through the lowered body; its
+    empirical occupancy must match the power-iterated stationary
+    distribution of the literal transition matrix — chi-square over
+    thinned samples plus the TV/E[cut] gates — and agree with the
+    general kernel and the compat/ (gerrychain-semantics) oracle."""
+    base = 1.5
+    g = fce.graphs.square_grid(3, 4, remove_nodes=[(0, 0)],
+                               extra_edges=[((0, 1), (1, 0))])
+    assert g.n_nodes == 11 and len(g.edges) == 16
+    nbrmask = _nbr_bitmasks(g)
+    states = _enumerate_states(g, nbrmask)
+    P, cuts = _build_transition(states, g, base)
+    pi = _stationary(P)
+
+    spec = fce.Spec(contiguity="patch", record_assignment_bits=True,
+                    geom_waits=False, parity_metrics=False)
+    assert lower.kernel_path_for(g, spec) == "lowered"
+    plan = fce.graphs.stripes_plan(g, 2)
+    chains, steps, burn, stride = 48, 12000, 2000, 25
+
+    # lowered board path: decode the node-rank abits packing (bit p of a
+    # record is the node at canvas-cell rank p)
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=13, spec=spec, base=base,
+        pop_tol=CHI_EPS)
+    assert kb.body_for(bg, spec) == "lowered"
+    res_b = fce.sampling.run_board(bg, spec, params, st, n_steps=steps)
+    rank = np.cumsum(np.asarray(bg.node_mask)) - 1
+    rank_of_node = rank[np.asarray(bg.cell_of_node)]
+    abits = np.asarray(res_b.history["abits"][:, burn::stride])
+    per_node = (abits[..., None] >> rank_of_node) & 1
+    masks_b = (per_node << np.arange(g.n_nodes)).sum(axis=-1).ravel()
+    _occupancy_checks(masks_b, states, pi, cuts, "lowered", chi2_tol=2.0)
+
+    # general kernel, node-index packing
+    dg, st_g, par_g = fce.init_batch(g, plan, n_chains=chains, seed=29,
+                                     spec=spec, base=base, pop_tol=CHI_EPS)
+    res_g = fce.run_chains(dg, spec, par_g, st_g, n_steps=steps)
+    masks_g = np.asarray(res_g.history["abits"][:, burn::stride]).ravel()
+    _occupancy_checks(masks_g, states, pi, cuts, "general", chi2_tol=2.0)
+
+    # compat oracle (single sequential chain => looser gates)
+    rng = np.random.default_rng(5)
+    signed = {lab: 1 - 2 * int(plan[i]) for i, lab in enumerate(g.labels)}
+    part = compat.Partition(g, signed, {
+        "population": compat.Tally("population"),
+        "cut_edges": compat.cut_edges,
+        "b_nodes": compat.b_nodes_bi,
+        "base": lambda p: base,
+        "step_num": compat.step_num,
+    })
+    popbound = compat.within_percent_of_ideal_population(part, CHI_EPS)
+    chain = compat.MarkovChain(
+        compat.make_reversible_propose_bi(rng),
+        compat.Validator([compat.single_flip_contiguous, popbound]),
+        compat.make_cut_accept(rng), part, 8000)
+    masks_c = []
+    for t, p in enumerate(chain):
+        if t >= 1000 and t % 5 == 0:
+            a = p.assignment_array
+            masks_c.append(int(((a == -1).astype(np.uint32)
+                                << np.arange(g.n_nodes)).sum()))
+    _occupancy_checks(np.array(masks_c), states, pi, cuts, "oracle",
+                      tv_tol=0.15, cut_tol=0.05)

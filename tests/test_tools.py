@@ -61,7 +61,11 @@ def bench_files(sandbox):
     return sorted(os.listdir(sandbox / "bench_runs"))
 
 
+@pytest.mark.slow
 def test_failure_shapes_are_quarantined(sandbox):
+    """Three full run_bench round-trips (~14s: each sources the helper,
+    spawns the stub under `timeout`, and drives a git quarantine rename)
+    — over the fast tier's 12s per-test budget, so slow tier."""
     r = drive(sandbox, "fail", "run_bench t1 60")
     assert r.returncode == 1
     assert "TEST_t1.json.failed" in bench_files(sandbox)

@@ -131,6 +131,8 @@ def report_runs(runs, out):
               f"| {_mb(e.get('hbm_history_bytes', 0))} "
               f"| {r['compiles']} |", file=out)
 
+    report_paths(runs, out)
+
     spreads = [(i, r) for i, r in enumerate(runs) if len(r["chunks"]) > 1]
     if spreads:
         print("\n## Per-chunk throughput spread (flips/s)", file=out)
@@ -141,6 +143,35 @@ def report_runs(runs, out):
             print(f"| {i} | {r['start']['runner']} | {len(f)} "
                   f"| {f[0] / 1e6:.3f}M | {f[len(f) // 2] / 1e6:.3f}M "
                   f"| {f[-1] / 1e6:.3f}M |", file=out)
+
+
+def report_paths(runs, out):
+    """Aggregate throughput per kernel path (lowered / bitboard / board
+    / general / pallas). The dispatch in kernel/board.py is silent —
+    this table is where a workload that regressed off its fast path
+    shows up (e.g. a sec11 run reporting 'general' instead of
+    'lowered')."""
+    by_path: dict = {}
+    for r in runs:
+        e = r["end"]
+        if e is None:
+            continue
+        path = r["start"].get("path", e.get("path", "-"))
+        agg = by_path.setdefault(path, {"runs": 0, "flips": 0,
+                                        "wall": 0.0})
+        agg["runs"] += 1
+        agg["flips"] += e.get("flips", 0)
+        agg["wall"] += e.get("wall_s", 0.0)
+    if not by_path:
+        return
+    print("\n## Throughput by kernel path", file=out)
+    print("| path | runs | flips | wall_s | Mflips/s |", file=out)
+    print("|---|---|---|---|---|", file=out)
+    for path in sorted(by_path):
+        a = by_path[path]
+        rate = a["flips"] / max(a["wall"], 1e-12)
+        print(f"| {path} | {a['runs']} | {a['flips']} "
+              f"| {a['wall']:.3f} | {rate / 1e6:.3f} |", file=out)
 
 
 def report_sweep(events, out):
