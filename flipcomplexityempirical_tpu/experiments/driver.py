@@ -785,7 +785,11 @@ def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
     threaded into every runner underneath for per-chunk telemetry; an
     uncaught per-config failure emits an ``error`` event before
     re-raising. ``heartbeat``: path of a JSON progress file refreshed
-    before and after each config (write_heartbeat).
+    before and after each config (write_heartbeat) — while a config is
+    running, each runner ``diag`` snapshot also refreshes it (the
+    ``diag`` key holds the active run's latest convergence/health
+    numbers, so the hang detector doubles as an in-flight health
+    readout).
     """
     rec = obs.resolve_recorder(recorder)
     configs = list(configs)
@@ -811,6 +815,15 @@ def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
         write_heartbeat(heartbeat, status="running", current=cfg.tag,
                         last=None, n_done=n_done, n_skipped=n_skipped,
                         n_configs=len(configs))
+        if rec and heartbeat:
+            # ChainMonitor calls rec.diag_hook with each diag event it
+            # emits; embed the latest snapshot so the heartbeat shows
+            # live R-hat / acceptance for the config in flight
+            rec.diag_hook = (
+                lambda diag, _tag=cfg.tag, _i=i: write_heartbeat(
+                    heartbeat, status="running", current=_tag, last=None,
+                    n_done=n_done, n_skipped=n_skipped,
+                    n_configs=len(configs), diag={_tag: diag}))
         try:
             data = run_config(cfg, outdir, checkpoint_dir, recorder=rec)
         except Exception as e:
@@ -821,6 +834,9 @@ def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
                             n_skipped=n_skipped, n_configs=len(configs),
                             error=f"{type(e).__name__}: {e}")
             raise
+        finally:
+            if rec and heartbeat:
+                rec.diag_hook = None
         n_done += 1
         rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
                  status="done", artifacts=count_artifacts(cfg, outdir),

@@ -152,9 +152,12 @@ def static_masks(bg):
     return [pack_bits(m[None, :]) for m in dirs]
 
 
-def planes_bits(bg, spec: Spec, params: StepParams, board_w, dist_pop):
+def planes_bits(bg, spec: Spec, params: StepParams, board_w, dist_pop,
+                count: bool = False):
     """Bit-plane analogue of board._planes: same[] ring planes, boundary
-    mask/count, contiguity, population gate, validity."""
+    mask/count, contiguity, population gate, validity. ``count`` adds
+    ``has_pop`` (C,) — any boundary cell passing the population gate —
+    for the reject-reason taxonomy."""
     masks = static_masks(bg)
     w = bg.w
     offs = [(shift_down, 1), (shift_down, w + 1), (shift_down, w),
@@ -197,8 +200,12 @@ def planes_bits(bg, spec: Spec, params: StepParams, board_w, dist_pop):
     valid = b_mask & contig & pop_ok
     cut_e = diff[0]                       # edge (i, i+1), masked to E
     cut_s = diff[2]                       # edge (i, i+W), masked to S
-    return dict(valid=valid, b_count=b_count, diff=diff,
-                cut_e=cut_e, cut_s=cut_s)
+    out = dict(valid=valid, b_count=b_count, diff=diff,
+               cut_e=cut_e, cut_s=cut_s)
+    if count:
+        out["has_pop"] = (jax.lax.population_count(b_mask & pop_ok)
+                          .astype(jnp.int32).sum(1) > 0)
+    return out
 
 
 def _word_at(words, wi):
@@ -296,10 +303,13 @@ def _eq_const(planes, d: int):
     return acc
 
 
-def planes_bits_pair(bg, spec: Spec, params: StepParams, planes, dist_pop):
+def planes_bits_pair(bg, spec: Spec, params: StepParams, planes, dist_pop,
+                     count: bool = False):
     """Bit-plane analogue of board._planes_pair: per-(node, rook
     direction) pair validity with district dedup, ring contiguity of the
-    origin district, per-chain district-bitmask population gates."""
+    origin district, per-chain district-bitmask population gates.
+    ``count`` adds ``has_pop`` (C,) — any deduped boundary pair passing
+    both population gates — for the reject-reason taxonomy."""
     k = spec.n_districts
     masks = static_masks(bg)
     w = bg.w
@@ -345,6 +355,7 @@ def planes_bits_pair(bg, spec: Spec, params: StepParams, planes, dist_pop):
 
     rook = (0, 2, 4, 6)                      # E, S, W, N (ring indices)
     pair, b_count = [], jnp.zeros(planes[0].shape[0], jnp.int32)
+    hp = None
     for jj, i in enumerate(rook):
         pj = diff8[i]
         for jp in rook[:jj]:                 # dedup repeated districts
@@ -355,10 +366,18 @@ def planes_bits_pair(bg, spec: Spec, params: StepParams, planes, dist_pop):
         b_count = b_count + jax.lax.population_count(pj).astype(
             jnp.int32).sum(1)
         fn, kk = offs[i]
-        pair.append(pj & contig & ok_from & fn(to_plane, kk))
+        gate = ok_from & fn(to_plane, kk)
+        pair.append(pj & contig & gate)
+        if count:
+            pp = pj & gate
+            hp = pp if hp is None else hp | pp
 
-    return dict(valid4=pair, b_count=b_count,
-                cut_e=diff8[0], cut_s=diff8[2])
+    out = dict(valid4=pair, b_count=b_count,
+               cut_e=diff8[0], cut_s=diff8[2])
+    if count:
+        out["has_pop"] = (jax.lax.population_count(hp)
+                          .astype(jnp.int32).sum(1) > 0)
+    return out
 
 
 def select_flat_pair(bg, valid4, u):

@@ -178,6 +178,12 @@ class BoardState:
     exhausted_count: jnp.ndarray  # int32[C] steps with empty valid set
     cut_times_se: Optional[jnp.ndarray] = None  # int32[C, N] lowered body
     cut_times_sw: Optional[jnp.ndarray] = None  # int32[C, N] lowered body
+    # reject-reason taxonomy (ISSUE 3): int32[C, 4] proposals lost to
+    # [non-boundary, pop-bound, disconnect, Metropolis]. None by default
+    # (treedef — and thus compiled graphs and checkpoints — unchanged);
+    # runners enable with .replace(reject_count=zeros) when recording.
+    # Small (C, 4), so it rides the scan carry, NOT _BOOKKEEPING.
+    reject_count: Optional[jnp.ndarray] = None
 
 
 # ---------------------------------------------------------------------------
@@ -369,9 +375,12 @@ def ring_contig_ok(same):
 
 
 def _planes(bg: BoardGraph, spec: Spec, params: StepParams,
-            state: BoardState):
+            state: BoardState, count: bool = False):
     """One fused pass over the board: cut planes, boundary mask, per-node
-    validity, boundary count."""
+    validity, boundary count. ``count`` (a trace-time flag) additionally
+    reduces ``has_pop`` — "some boundary cell survives the population
+    gate" — for the reject-reason taxonomy; off, the traced graph is
+    exactly the historical one."""
     board = state.board
     same = same_planes(bg, board)
     # small-range planes stay int8: half/quarter the HBM traffic of the
@@ -412,8 +421,11 @@ def _planes(bg: BoardGraph, spec: Spec, params: StepParams,
     pop_ok = popn <= jnp.where(is1, thr1[:, None], thr0[:, None])
 
     valid = b_mask & contig & pop_ok
-    return dict(valid=valid, b_count=b_count, diff_deg=diff_deg,
-                cut_e=cut_e, cut_s=cut_s)
+    planes = dict(valid=valid, b_count=b_count, diff_deg=diff_deg,
+                  cut_e=cut_e, cut_s=cut_s)
+    if count:
+        planes["has_pop"] = (b_mask & pop_ok).any(axis=1)
+    return planes
 
 
 # ---------------------------------------------------------------------------
@@ -476,9 +488,11 @@ def _stencil_patch_ok(bg: BoardGraph, board):
 
 
 def _planes_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
-                    state: BoardState):
+                    state: BoardState, count: bool = False):
     """The lowered body's fused plane pass: 8 masked same-planes, full
-    graph degree, 4 forward cut planes (E, SE, S, SW), B2 contiguity."""
+    graph degree, 4 forward cut planes (E, SE, S, SW), B2 contiguity.
+    ``count`` adds the reject-taxonomy ``has_pop`` reduce (see
+    ``_planes``)."""
     board = state.board
     same = _same_planes_stencil(bg, board)
     same_deg = same[0].astype(jnp.int8)
@@ -510,8 +524,11 @@ def _planes_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
     pop_ok = popn <= jnp.where(is1, thr1[:, None], thr0[:, None])
 
     valid = b_mask & contig & pop_ok
-    return dict(valid=valid, b_count=b_count, diff_deg=diff_deg,
-                cut_e=cut_e, cut_se=cut_se, cut_s=cut_s, cut_sw=cut_sw)
+    planes = dict(valid=valid, b_count=b_count, diff_deg=diff_deg,
+                  cut_e=cut_e, cut_se=cut_se, cut_s=cut_s, cut_sw=cut_sw)
+    if count:
+        planes["has_pop"] = (b_mask & pop_ok).any(axis=1)
+    return planes
 
 
 def _interface_stencil(bg: BoardGraph, cuts):
@@ -638,8 +655,11 @@ def _transition_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
     dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
     dist_pop = dist_pop.at[:, 1].add(popv * sgn)
 
+    rej = (_reject_increment(planes["b_count"], planes["has_pop"], accept,
+                             any_valid)
+           if state.reject_count is not None else None)
     return _commit_transition(state, params, board, dist_pop, flat, d_to,
-                              dcut, accept, any_valid)
+                              dcut, accept, any_valid, rej=rej)
 
 
 # ---------------------------------------------------------------------------
@@ -702,11 +722,35 @@ def _record_common(state: BoardState, b_count, cur_wait):
     return state, out, log
 
 
+def _reject_increment(b_count, has_pop, accept, any_valid):
+    """(C, 4) int32 one-hot per step: why this step's single masked draw
+    produced no accepted move — [non-boundary (no boundary cell at all),
+    pop-bound (boundary exists, none passes the population gate),
+    disconnect (a cell passes pop but contiguity/validity kills them
+    all), Metropolis (a valid cell was drawn, the coin said no)]. The
+    board kernel makes one draw per step, so an exhausted step is one
+    attributed rejection and reject_count.sum() + accept_count ==
+    tries_sum exactly (tested)."""
+    ex = ~any_valid
+    has_bnd = b_count > 0
+    nonbnd = ex & ~has_bnd
+    pop = ex & has_bnd & ~has_pop
+    disc = ex & has_bnd & has_pop
+    met = any_valid & ~accept
+    return jnp.stack([nonbnd, pop, disc, met], axis=1).astype(jnp.int32)
+
+
 def _commit_transition(state: BoardState, params: StepParams, board,
-                       dist_pop, flat, d_to, dcut, accept, any_valid):
+                       dist_pop, flat, d_to, dcut, accept, any_valid,
+                       rej=None):
     """The accept-commit shared by both bodies (board/dist_pop given in
-    the body's own representation)."""
+    the body's own representation). ``rej`` is the optional (C, 4)
+    reject-reason increment from ``_reject_increment`` — present exactly
+    when ``state.reject_count`` is enabled."""
     acc_i = accept.astype(jnp.int32)
+    extra = {}
+    if rej is not None:
+        extra["reject_count"] = state.reject_count + rej
     return state.replace(
         board=board,
         dist_pop=dist_pop,
@@ -720,6 +764,7 @@ def _commit_transition(state: BoardState, params: StepParams, board,
         tries_sum=state.tries_sum + 1,
         exhausted_count=state.exhausted_count
         + (~any_valid).astype(jnp.int32),
+        **extra,
     )
 
 
@@ -848,8 +893,11 @@ def _transition(bg: BoardGraph, spec: Spec, params: StepParams,
     dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
     dist_pop = dist_pop.at[:, 1].add(popv * sgn)
 
+    rej = (_reject_increment(planes["b_count"], planes["has_pop"], accept,
+                             any_valid)
+           if state.reject_count is not None else None)
     return _commit_transition(state, params, board, dist_pop, flat, d_to,
-                              dcut, accept, any_valid)
+                              dcut, accept, any_valid, rej=rej)
 
 
 # ---------------------------------------------------------------------------
@@ -875,7 +923,7 @@ def _nbr_value_planes(bg: BoardGraph, board):
 
 
 def _planes_pair(bg: BoardGraph, spec: Spec, params: StepParams,
-                 state: BoardState):
+                 state: BoardState, count: bool = False):
     """Per-(node, direction) pair validity for the k-district proposal
     (slow_reversible_propose, grid_chain_sec11.py:117-130): uniform over
     DISTINCT (boundary node, adjacent district != own) pairs. A direction
@@ -916,6 +964,7 @@ def _planes_pair(bg: BoardGraph, spec: Spec, params: StepParams,
 
     pairs = []
     b_count = jnp.zeros(board.shape[0], jnp.int32)
+    hp = None
     for j, (v, ex) in enumerate(nbrs):
         pj = diff[j]
         for jp in range(j):
@@ -928,10 +977,17 @@ def _planes_pair(bg: BoardGraph, spec: Spec, params: StepParams,
         vi = jnp.maximum(v.astype(jnp.int32), 0)
         ok_to = ((to_bits[:, None] >> vi) & 1) == 1
         pairs.append(pj & contig & ok_from & ok_to)
+        if count:
+            # "some pair survives the population gates" (pre-contiguity)
+            pop_pass = pj & ok_from & ok_to
+            hp = pop_pass if hp is None else hp | pop_pass
 
     # row-major (node, direction) interleave: flat' = v*4 + j
     valid = jnp.stack(pairs, axis=2).reshape(board.shape[0], -1)
-    return dict(valid=valid, b_count=b_count, cut_e=cut_e, cut_s=cut_s)
+    planes = dict(valid=valid, b_count=b_count, cut_e=cut_e, cut_s=cut_s)
+    if count:
+        planes["has_pop"] = hp.any(axis=1)
+    return planes
 
 
 def _transition_pair(bg: BoardGraph, spec: Spec, params: StepParams,
@@ -978,8 +1034,11 @@ def _transition_pair(bg: BoardGraph, spec: Spec, params: StepParams,
     dist_pop = state.dist_pop + popv[:, None] * (
         oh_to.astype(jnp.int32) - oh_from.astype(jnp.int32))
 
+    rej = (_reject_increment(planes["b_count"], planes["has_pop"], accept,
+                             any_valid)
+           if state.reject_count is not None else None)
     return _commit_transition(state, params, board, dist_pop, flat, d_to,
-                              dcut, accept, any_valid)
+                              dcut, accept, any_valid, rej=rej)
 
 
 # ---------------------------------------------------------------------------
@@ -1123,12 +1182,13 @@ def _scan_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
     — heavy accumulators (4 cut_times planes) ride int16 beside the
     carry and fold afterwards."""
     c, n = loop_state.board.shape
+    count = loop_state.reject_count is not None
 
     def body(carry, _):
         state, cts16 = carry
         key, kprop, kacc, kwait = _split4(state.key)
         state = state.replace(key=key)
-        planes = _planes_stencil(bg, spec, params, state)
+        planes = _planes_stencil(bg, spec, params, state, count=count)
         cur_wait = _complete_wait(spec, state, planes["b_count"], kwait,
                                   bg.n_real)
         state, cts16, out, log = _record_stencil(
@@ -1153,13 +1213,14 @@ def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
     tests/test_bitboard.py asserts equality field-for-field)."""
     n = bg.n
     c = loop_state.board.shape[0]
+    count = loop_state.reject_count is not None
 
     def body(carry, _):
         state, ct_e_sl, ct_s_sl = carry
         key, kprop, kacc, kwait = _split4(state.key)
         state = state.replace(key=key)
         planes = bitboard.planes_bits(bg, spec, params, state.board,
-                                      state.dist_pop)
+                                      state.dist_pop, count=count)
         cur_wait = _complete_wait(spec, state, planes["b_count"], kwait, n)
 
         # record (grid_chain_sec11.py:366-402)
@@ -1184,9 +1245,11 @@ def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
         sgn = jnp.where(d_from == 0, 1, -1)
         dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
         dist_pop = dist_pop.at[:, 1].add(popv * sgn)
+        rej = (_reject_increment(planes["b_count"], planes["has_pop"],
+                                 accept, any_valid) if count else None)
         state = _commit_transition(
             state, params, bitboard.flip_bit(state.board, flat, accept),
-            dist_pop, flat, d_to, dcut, accept, any_valid)
+            dist_pop, flat, d_to, dcut, accept, any_valid, rej=rej)
         return (state, ct_e_sl, ct_s_sl), (out if collect else {}, log)
 
     nw = bitboard.n_words(n)
@@ -1213,13 +1276,14 @@ def _scan_bits_pair(bg: BoardGraph, spec: Spec, params: StepParams,
     c = loop_state.board.shape[0]
     k = spec.n_districts
     w = bg.w
+    count = loop_state.reject_count is not None
 
     def body(carry, _):
         state, ct_e_sl, ct_s_sl = carry
         key, kprop, kacc, kwait = _split4(state.key)
         state = state.replace(key=key)
         planes = bitboard.planes_bits_pair(bg, spec, params, state.board,
-                                           state.dist_pop)
+                                           state.dist_pop, count=count)
         cur_wait = _complete_wait(spec, state, planes["b_count"], kwait, n)
         state, out, log = _record_common(state, planes["b_count"],
                                          cur_wait)
@@ -1258,8 +1322,11 @@ def _scan_bits_pair(bg: BoardGraph, spec: Spec, params: StepParams,
         oh_from = jnp.arange(k)[None, :] == d_from[:, None]
         dist_pop = state.dist_pop + popv[:, None] * (
             oh_to.astype(jnp.int32) - oh_from.astype(jnp.int32))
+        rej = (_reject_increment(planes["b_count"], planes["has_pop"],
+                                 accept, any_valid) if count else None)
         state = _commit_transition(state, params, new_planes, dist_pop,
-                                   flat, d_to, dcut, accept, any_valid)
+                                   flat, d_to, dcut, accept, any_valid,
+                                   rej=rej)
         return (state, ct_e_sl, ct_s_sl), (out if collect else {}, log)
 
     nw = bitboard.n_words(n)
@@ -1331,11 +1398,13 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
         make_transition = (_transition_pair if spec.proposal == "pair"
                            else _transition)
 
+        count = state.reject_count is not None
+
         def body(carry, _):
             state, ct_e16, ct_s16 = carry
             key, kprop, kacc, kwait = _split4(state.key)
             state = state.replace(key=key)
-            planes = make_planes(bg, spec, params, state)
+            planes = make_planes(bg, spec, params, state, count=count)
             cur_wait = _complete_wait(spec, state, planes["b_count"],
                                       kwait, n)
             state, ct_e16, ct_s16, out, log = _record(

@@ -222,32 +222,58 @@ def _frame_counts(dg: DeviceGraph, spec: Spec, state: ChainState):
     return jnp.zeros(spec.n_districts, jnp.int32).at[a_f].add(1)
 
 
-def _validate(dg: DeviceGraph, spec: Spec, params: StepParams,
-              state: ChainState, v, d_to, sampled_ok, frame_counts=None):
-    """Population bounds + contiguity for a tentative flip of v to d_to."""
+def _validate_parts(dg: DeviceGraph, spec: Spec, params: StepParams,
+                    state: ChainState, v, d_to, sampled_ok,
+                    frame_counts=None):
+    """Component predicates of proposal validation for a tentative flip
+    of v to d_to: ``(sampled_eff, pop_ok, conn_ok)``. ``sampled_eff`` is
+    "the draw hit a real boundary move"; ``conn_ok`` folds in the frame-
+    interface constraint (its failures count as disconnects in the
+    reject taxonomy: both are connectivity-shape vetoes). The proposal
+    is valid iff all three hold — exposed separately so the reject-
+    reason counters can attribute each invalid draw."""
     d_from = state.assignment[v].astype(jnp.int32)
     popv = dg.pop[v]
     pop_from_new = (state.dist_pop[d_from] - popv).astype(jnp.float32)
     pop_to_new = (state.dist_pop[d_to] + popv).astype(jnp.float32)
-    ok = sampled_ok & (d_to != d_from)
-    ok &= pop_from_new >= params.pop_lo
-    ok &= pop_to_new <= params.pop_hi
+    sampled_eff = sampled_ok & (d_to != d_from)
+    pop_ok = (pop_from_new >= params.pop_lo) & (pop_to_new <= params.pop_hi)
     conn = contiguity.check(dg, state.assignment, v, d_from, spec.contiguity)
-    ok &= conn
     if spec.frame_interface:
         # boundary_condition (grid_chain_sec11.py:43-52): after the flip,
         # the outer-frame nodes must not all lie in one district. Post-flip
         # per-district frame counts = current counts adjusted for v.
         vf = dg.frame_mask[v].astype(jnp.int32)
         counts = frame_counts.at[d_from].add(-vf).at[d_to].add(vf)
-        ok &= counts.max() < dg.frame_idx.shape[0]
-    return ok
+        conn &= counts.max() < dg.frame_idx.shape[0]
+    return sampled_eff, pop_ok, conn
+
+
+def _validate(dg: DeviceGraph, spec: Spec, params: StepParams,
+              state: ChainState, v, d_to, sampled_ok, frame_counts=None):
+    """Population bounds + contiguity for a tentative flip of v to d_to."""
+    sampled_eff, pop_ok, conn = _validate_parts(
+        dg, spec, params, state, v, d_to, sampled_ok, frame_counts)
+    return sampled_eff & pop_ok & conn
+
+
+def _reject_reason(sampled_eff, pop_ok, valid):
+    """int32[3] one-hot of why an invalid draw died, priority-ordered to
+    match the validation short-circuit: [non-boundary, pop-bound,
+    disconnect]. All-zero when the draw is valid."""
+    reason = jnp.where(~sampled_eff, 0, jnp.where(~pop_ok, 1, 2))
+    return ((jnp.arange(3) == reason) & ~valid).astype(jnp.int32)
 
 
 def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
-            state: ChainState, key):
+            state: ChainState, key, count: bool = False):
     """Draw a proposal per the invalid-move policy. Returns
-    (v, d_to, valid, tries)."""
+    (v, d_to, valid, tries), plus a trailing int32[3] reject-reason
+    vector ([non-boundary, pop, disconnect] over this step's invalid
+    draws) when ``count`` — the trace-time flag the runners derive from
+    ``state.reject_count is not None``. With ``count=False`` the traced
+    graph (and the PRNG stream either way: counting draws nothing) is
+    exactly the historical one."""
     k = spec.n_districts
     frame_counts = _frame_counts(dg, spec, state) if spec.frame_interface \
         else None
@@ -261,10 +287,20 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
             v, d_to, ok = _sample_pair(key, dg, state, k)
         else:
             raise ValueError(f"proposal {spec.proposal!r}")
-        return v, d_to, _validate(dg, spec, params, state, v, d_to, ok,
-                                  frame_counts)
+        if not count:
+            return v, d_to, _validate(dg, spec, params, state, v, d_to, ok,
+                                      frame_counts)
+        sampled_eff, pop_ok, conn = _validate_parts(
+            dg, spec, params, state, v, d_to, ok, frame_counts)
+        valid = sampled_eff & pop_ok & conn
+        return v, d_to, valid, _reject_reason(sampled_eff, pop_ok, valid)
+
+    zero3 = jnp.zeros(3, jnp.int32)
 
     if spec.invalid == "selfloop":
+        if count:
+            v, d_to, valid, rej = draw(key)
+            return v, d_to, valid, jnp.int32(1), rej
         v, d_to, valid = draw(key)
         return v, d_to, valid, jnp.int32(1)
 
@@ -280,18 +316,40 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
                          f"[1, max_tries={spec.max_tries}]")
     if kp > 1:
         key, kdraw = jax.random.split(key)
-        vs, d_tos, valids = jax.vmap(draw)(jax.random.split(kdraw, kp))
+        if count:
+            vs, d_tos, valids, rejs = jax.vmap(draw)(
+                jax.random.split(kdraw, kp))
+        else:
+            vs, d_tos, valids = jax.vmap(draw)(jax.random.split(kdraw, kp))
         first = jnp.argmax(valids).astype(jnp.int32)
         any_valid = valids.any()
-        init = (key, vs[first], d_tos[first], any_valid,
-                jnp.where(any_valid, first + 1, kp))
+        tries0 = jnp.where(any_valid, first + 1, kp)
+        init = (key, vs[first], d_tos[first], any_valid, tries0)
+        if count:
+            # the consumed draws are 0..tries0-1; all but a winning last
+            # one are invalid, and each rejs row is already zero when
+            # its draw was valid
+            consumed = (jnp.arange(kp) < tries0)[:, None]
+            init += (jnp.sum(rejs * consumed, axis=0, dtype=jnp.int32),)
     else:
         init = (key, jnp.int32(0), jnp.int32(0), jnp.bool_(False),
                 jnp.int32(0))
+        if count:
+            init += (zero3,)
 
     def cond(carry):
-        _, _, _, valid, tries = carry
+        valid, tries = carry[3], carry[4]
         return (~valid) & (tries < spec.max_tries)
+
+    if count:
+        def body(carry):
+            key, _, _, _, tries, rej = carry
+            key, kd = jax.random.split(key)
+            v, d_to, valid, r = draw(kd)
+            return key, v, d_to, valid, tries + 1, rej + r
+
+        _, v, d_to, valid, tries, rej = jax.lax.while_loop(cond, body, init)
+        return v, d_to, valid, tries, rej
 
     def body(carry):
         key, _, _, _, tries = carry
@@ -308,7 +366,12 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
     """One chain step: propose(+retries), Metropolis-accept, commit."""
     k = spec.n_districts
     key, kprop, kacc, kwait = jax.random.split(state.key, 4)
-    v, d_to, valid, tries = propose(dg, spec, params, state, kprop)
+    count = state.reject_count is not None
+    if count:
+        v, d_to, valid, tries, rej3 = propose(dg, spec, params, state,
+                                              kprop, count=True)
+    else:
+        v, d_to, valid, tries = propose(dg, spec, params, state, kprop)
 
     d_from = state.assignment[v].astype(jnp.int32)
     nb = dg.nbr[v]                       # (D,), pad = v
@@ -388,6 +451,14 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
         cur_wait = state.cur_wait
     cur_flip_node = jnp.where(accept, v, state.cur_flip_node)
 
+    extra = {}
+    if count:
+        # fourth taxon: a valid proposal the Metropolis coin rejected.
+        # Invariant (tested): reject_count.sum() + accept_count ==
+        # tries_sum — every draw is accepted or attributed a reason.
+        met = (valid & ~accept).astype(jnp.int32)
+        extra["reject_count"] = state.reject_count + jnp.concatenate(
+            [rej3, met[None]])
     return state.replace(
         key=key, assignment=a_new, cut=cut, cut_deg=cut_deg,
         dist_pop=dist_pop, cut_count=cut_count, b_count=b_count,
@@ -396,6 +467,7 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
         accept_count=state.accept_count + accept.astype(jnp.int32),
         tries_sum=state.tries_sum + tries,
         exhausted_count=state.exhausted_count + (~valid).astype(jnp.int32),
+        **extra,
     )
 
 

@@ -12,15 +12,26 @@ process-wide with ``set_default_recorder``.
 
 from .events import (EVENT_FIELDS, SCHEMA_VERSION, SWEEP_STATUSES,
                      validate_event, validate_line)
-from .recorder import (NULL, JitWatch, NullRecorder, Recorder,
-                       default_recorder, dict_nbytes, from_spec,
-                       jit_cache_size, profile_region, resolve_recorder,
+from .recorder import (NULL, JitWatch, NullRecorder, Recorder, aot_cost,
+                       default_recorder, device_memory_snapshot,
+                       dict_nbytes, from_spec, jit_cache_size,
+                       profile_region, resolve_recorder,
                        set_default_recorder)
 
 __all__ = [
     "EVENT_FIELDS", "SCHEMA_VERSION", "SWEEP_STATUSES",
     "validate_event", "validate_line",
-    "NULL", "NullRecorder", "Recorder", "JitWatch",
+    "NULL", "NullRecorder", "Recorder", "JitWatch", "ChainMonitor",
     "default_recorder", "set_default_recorder", "resolve_recorder",
     "from_spec", "profile_region", "jit_cache_size", "dict_nbytes",
+    "aot_cost", "device_memory_snapshot",
 ]
+
+
+def __getattr__(name):
+    # ChainMonitor pulls numpy + stats.diagnostics; load it lazily so
+    # the package keeps its stdlib-only-at-import contract for tools
+    if name == "ChainMonitor":
+        from .monitor import ChainMonitor
+        return ChainMonitor
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -34,6 +34,26 @@ Core field semantics:
 - ``sweep_config``: driver progress, ``status`` in SWEEP_STATUSES with
   per-config artifact counts.
 - ``error``: a failure the emitter survived or is about to re-raise.
+- ``diag``: one per observed chunk from ``obs.monitor.ChainMonitor`` —
+  streaming convergence health. ``observable`` names the tracked series
+  (e.g. ``cut_count``), ``samples`` the per-chain sample count folded so
+  far, ``rhat``/``ess``/``ess_per_s`` the split Gelman-Rubin statistic,
+  total effective sample size, and ESS per wall-second over the
+  monitor's bounded thinning buffer (null until enough samples, or when
+  non-finite — e.g. R-hat diverges on chains frozen apart), and
+  ``accept_ewma``/``throughput_ewma`` the run's own exponentially
+  weighted trends (null until first observed).
+- ``anomaly``: the monitor crossed a health threshold. ``kind`` is one
+  of ``frozen_chain`` / ``acceptance_collapse`` /
+  ``pop_bound_saturation`` / ``throughput_regression``; ``detail`` is a
+  kind-specific object. Each kind re-arms after recovery, so a stream
+  records episodes, not one line per chunk.
+
+Adding a new event *type* (as ``diag``/``anomaly`` were added) does NOT
+bump SCHEMA_VERSION: readers fold by type and validation rejects only
+events claiming a type they don't define, so old streams stay valid and
+old readers simply ignore lines they don't know. Only a change to the
+*meaning* of an existing core field bumps the version.
 """
 
 from __future__ import annotations
@@ -50,6 +70,9 @@ EVENT_FIELDS = {
     "run_end": frozenset({"runner", "n_yields", "wall_s", "flips_per_s"}),
     "sweep_config": frozenset({"tag", "family", "status"}),
     "error": frozenset({"message"}),
+    "diag": frozenset({"observable", "samples", "rhat", "ess",
+                       "ess_per_s", "accept_ewma", "throughput_ewma"}),
+    "anomaly": frozenset({"kind", "detail"}),
 }
 
 SWEEP_STATUSES = ("start", "done", "skip")

@@ -1,0 +1,288 @@
+"""In-flight chain health: streaming convergence diagnostics (ISSUE 3).
+
+``ChainMonitor`` rides the host side of the runner loops, consuming the
+per-chunk history blocks they already copy back — it introduces **no new
+device syncs**. Per chunk it folds the observable series into online
+per-chain Welford moments and a bounded thinning buffer, computes split
+R-hat and ESS over that buffer with the *same* host oracles the offline
+analysis uses (``stats.diagnostics.gelman_rubin`` / ``ess`` — when the
+buffer is unthinned the streaming numbers are exactly the oracle
+numbers), tracks EWMA acceptance/throughput trends, and emits a ``diag``
+event. Health thresholds emit ``anomaly`` events:
+
+- ``frozen_chain``: a chain accepted nothing for ``freeze_chunks``
+  consecutive observed chunks (the paper's frozen-phase signature —
+  10^5 dead steps no longer look like healthy throughput).
+- ``acceptance_collapse``: the acceptance EWMA fell below
+  ``collapse_rate`` after warmup.
+- ``pop_bound_saturation``: the chunk's reject breakdown attributes more
+  than ``pop_sat_frac`` of proposals to the population bound.
+- ``throughput_regression``: chunk throughput fell below
+  ``regression_frac`` of the run's own EWMA after warmup.
+
+Each kind re-arms when the condition clears, so a long sick run records
+episodes rather than one anomaly per chunk. Memory is bounded: the
+buffer caps at ``buffer_cap`` samples per chain, after which it is
+decimated 2x and the keep-stride doubles (classic stride-doubling
+thinning — the kept samples stay an evenly spaced grid over the whole
+run, which is what split R-hat and the Sokal ESS window want).
+
+numpy is imported here (and stats.diagnostics transitively) — the obs
+package keeps its stdlib-only import contract by exporting ChainMonitor
+lazily via module ``__getattr__``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..stats.diagnostics import ess as _ess
+from ..stats.diagnostics import gelman_rubin as _gelman_rubin
+
+REJECT_KEYS = ("nonboundary", "pop", "disconnect", "metropolis")
+
+
+def _finite(x):
+    """float(x) when finite else None — JSONL streams carry null, not
+    Infinity/NaN (strict parsers reject bare Infinity tokens)."""
+    if x is None:
+        return None
+    x = float(x)
+    return x if math.isfinite(x) else None
+
+
+class ChainMonitor:
+    """Streaming per-run convergence/health monitor.
+
+    One instance per run (the runners build one when a truthy recorder
+    is attached). ``observe_chunk`` is fed whatever the runner already
+    has on the host at its existing chunk boundary: the thinned history
+    block (``outs``, dict of (T, C) arrays — optional: without history
+    the monitor still tracks EWMA trends and reject anomalies), the
+    chunk wall/throughput, and the reject breakdown read back from the
+    device counters.
+    """
+
+    def __init__(self, rec, observable="cut_count", total=None, path=None,
+                 runner=None, buffer_cap=4096, ewma_alpha=0.3,
+                 freeze_chunks=3, collapse_rate=0.02, pop_sat_frac=0.9,
+                 regression_frac=0.5, warmup_chunks=3):
+        self._rec = rec
+        self.observable = observable
+        self.total = total
+        self.path = path
+        self.runner = runner
+        self.buffer_cap = max(int(buffer_cap), 8)
+        self.ewma_alpha = float(ewma_alpha)
+        self.freeze_chunks = int(freeze_chunks)
+        self.collapse_rate = float(collapse_rate)
+        self.pop_sat_frac = float(pop_sat_frac)
+        self.regression_frac = float(regression_frac)
+        self.warmup_chunks = int(warmup_chunks)
+        # Welford per chain (exact over ALL samples, not just the buffer)
+        self._n = 0
+        self._mean = None          # f64[C]
+        self._m2 = None            # f64[C]
+        # bounded thinning buffer: f64[C, L], keep-stride doubles at cap
+        self._buf = None
+        self._stride = 1
+        self._seen = 0             # samples consumed (thinned-grid index)
+        # trends / anomaly arming
+        self._chunks = 0
+        self._wall = 0.0
+        self._acc_ewma = None
+        self._thr_ewma = None
+        self._last_accepts = None  # f64[C] cumulative accepts at last chunk
+        self._freeze_streak = None  # int[C] consecutive zero-accept chunks
+        self._frozen = None        # bool[C] already reported frozen
+        self._collapsed = False
+        self._pop_saturated = False
+        self._regressed = False
+
+    # ---- streaming moments ------------------------------------------
+
+    def _fold_welford(self, arr):
+        """Merge a (C, T) block into the per-chain running moments."""
+        t = arr.shape[1]
+        if t == 0:
+            return
+        bmean = arr.mean(axis=1)
+        bm2 = ((arr - bmean[:, None]) ** 2).sum(axis=1)
+        if self._n == 0:
+            self._mean, self._m2, self._n = bmean, bm2, t
+            return
+        n, tot = self._n, self._n + t
+        delta = bmean - self._mean
+        self._mean = self._mean + delta * (t / tot)
+        self._m2 = self._m2 + bm2 + delta * delta * (n * t / tot)
+        self._n = tot
+
+    def _fold_buffer(self, arr):
+        """Append the block's stride-aligned columns; decimate at cap."""
+        t = arr.shape[1]
+        idx = np.arange(self._seen, self._seen + t)
+        self._seen += t
+        keep = arr[:, idx % self._stride == 0]
+        if keep.shape[1]:
+            self._buf = (keep if self._buf is None
+                         else np.concatenate([self._buf, keep], axis=1))
+        while self._buf is not None and self._buf.shape[1] > self.buffer_cap:
+            self._buf = self._buf[:, ::2]
+            self._stride *= 2
+
+    def _diagnostics(self):
+        """(rhat, ess_total) over the buffer via the host oracles; None
+        where not yet computable. gelman_rubin needs >= 4 kept samples
+        (it splits each chain in half)."""
+        if self._buf is None or self._buf.shape[1] < 4:
+            return None, None
+        rhat = _finite(_gelman_rubin(self._buf))
+        # ESS is computed on the kept grid; with stride s each kept
+        # sample stands for s raw samples, so scale back up
+        _, ess_total = _ess(self._buf)
+        ess_total = _finite(ess_total)
+        if ess_total is not None:
+            ess_total *= self._stride
+        return rhat, ess_total
+
+    def _ewma(self, prev, x):
+        if x is None:
+            return prev
+        x = float(x)
+        return x if prev is None else (self.ewma_alpha * x
+                                       + (1 - self.ewma_alpha) * prev)
+
+    def _anomaly(self, kind, **detail):
+        self._rec.emit("anomaly", kind=kind, detail=detail,
+                       observable=self.observable, runner=self.runner,
+                       path=self.path)
+
+    # ---- per-chunk entry point --------------------------------------
+
+    def observe_chunk(self, outs=None, wall_s=None, flips_per_s=None,
+                      accept_rate=None, reject=None, done=None,
+                      ts=None):
+        """Fold one chunk's host-side data; emit ``diag`` (+ any
+        ``anomaly``). Returns the emitted diag event dict.
+
+        ``outs``: dict of (T, C) host arrays (the runner's thinned
+        history block). Uses ``self.observable`` for convergence and,
+        when present, the cumulative ``accepts`` series for per-chain
+        freeze detection. ``reject``: the chunk event's breakdown
+        ({nonboundary, pop, disconnect, metropolis, accepted,
+        proposals}).
+        """
+        self._chunks += 1
+        if wall_s:
+            self._wall += float(wall_s)
+
+        accepts_delta = None
+        if outs:
+            obs_series = outs.get(self.observable)
+            if obs_series is not None:
+                arr = np.asarray(obs_series, np.float64)
+                if arr.ndim == 1:
+                    arr = arr[:, None]
+                arr = arr.T  # (C, T)
+                self._fold_welford(arr)
+                self._fold_buffer(arr)
+            acc = outs.get("accepts")
+            if acc is not None:
+                acc = np.asarray(acc, np.float64)
+                if acc.ndim == 1:
+                    acc = acc[:, None]
+                last = acc[-1]  # cumulative per-chain accepts at chunk end
+                if self._last_accepts is not None:
+                    accepts_delta = last - self._last_accepts
+                else:
+                    accepts_delta = last - np.asarray(acc[0], np.float64)
+                self._last_accepts = last
+
+        if accept_rate is None and reject is not None:
+            prop = reject.get("proposals") or 0
+            if prop:
+                accept_rate = reject.get("accepted", 0) / prop
+        self._acc_ewma = self._ewma(self._acc_ewma, accept_rate)
+
+        rhat, ess_total = self._diagnostics()
+        ess_per_s = (ess_total / self._wall
+                     if ess_total is not None and self._wall > 0 else None)
+
+        diag = self._rec.emit(
+            "diag", ts=ts, observable=self.observable,
+            samples=self._n, rhat=rhat, ess=ess_total,
+            ess_per_s=_finite(ess_per_s),
+            accept_ewma=_finite(self._acc_ewma),
+            throughput_ewma=_finite(self._thr_ewma),
+            mean=_finite(self._mean.mean()) if self._mean is not None
+            else None,
+            chunks=self._chunks, runner=self.runner, path=self.path,
+            done=done, total=self.total)
+
+        self._check_anomalies(accepts_delta, flips_per_s, reject)
+        # throughput EWMA updates AFTER the regression check — the
+        # comparison is "this chunk vs the run's own trend so far"
+        self._thr_ewma = self._ewma(self._thr_ewma, flips_per_s)
+
+        hook = getattr(self._rec, "diag_hook", None)
+        if hook is not None and diag is not None:
+            try:
+                hook(diag)
+            except Exception:
+                pass
+        return diag
+
+    # ---- anomaly thresholds -----------------------------------------
+
+    def _check_anomalies(self, accepts_delta, flips_per_s, reject):
+        if accepts_delta is not None:
+            c = accepts_delta.shape[0]
+            if self._freeze_streak is None:
+                self._freeze_streak = np.zeros(c, np.int64)
+                self._frozen = np.zeros(c, bool)
+            stalled = accepts_delta <= 0
+            self._freeze_streak = np.where(stalled,
+                                           self._freeze_streak + 1, 0)
+            hit = self._freeze_streak >= self.freeze_chunks
+            fresh = hit & ~self._frozen
+            if fresh.any():
+                idx = np.flatnonzero(fresh)
+                self._anomaly("frozen_chain",
+                              chains=int(hit.sum()),
+                              new_chains=[int(i) for i in idx[:16]],
+                              streak_chunks=int(self._freeze_streak.max()))
+            self._frozen = hit  # thawed chains re-arm
+
+        if self._acc_ewma is not None and self._chunks > self.warmup_chunks:
+            if self._acc_ewma < self.collapse_rate and not self._collapsed:
+                self._collapsed = True
+                self._anomaly("acceptance_collapse",
+                              accept_ewma=float(self._acc_ewma),
+                              threshold=self.collapse_rate)
+            elif self._acc_ewma >= self.collapse_rate:
+                self._collapsed = False
+
+        if reject:
+            prop = reject.get("proposals") or 0
+            frac = (reject.get("pop", 0) / prop) if prop else 0.0
+            if frac > self.pop_sat_frac and not self._pop_saturated:
+                self._pop_saturated = True
+                self._anomaly("pop_bound_saturation",
+                              pop_reject_frac=float(frac),
+                              threshold=self.pop_sat_frac)
+            elif frac <= self.pop_sat_frac:
+                self._pop_saturated = False
+
+        if (flips_per_s is not None and self._thr_ewma is not None
+                and self._chunks > self.warmup_chunks):
+            floor = self.regression_frac * self._thr_ewma
+            if flips_per_s < floor and not self._regressed:
+                self._regressed = True
+                self._anomaly("throughput_regression",
+                              flips_per_s=float(flips_per_s),
+                              ewma=float(self._thr_ewma),
+                              frac=self.regression_frac)
+            elif flips_per_s >= floor:
+                self._regressed = False

@@ -109,6 +109,14 @@ class Recorder:
         if self._file is not None:
             self._file.write(line + "\n")
             self._file.flush()
+            if event == "error":
+                # an error event is usually the last thing a dying sweep
+                # writes — force it to stable storage so the post-mortem
+                # stream ends with the diagnosis, not mid-buffer
+                try:
+                    os.fsync(self._file.fileno())
+                except OSError:
+                    pass
         if self._stream is not None:
             print(line, file=self._stream, flush=True)
         self.n_emitted += 1
@@ -185,6 +193,66 @@ def jit_cache_size(fn):
         return None
 
 
+def device_memory_snapshot():
+    """Per-device ``memory_stats()`` where the platform exposes them
+    (TPU/GPU report bytes_in_use etc.; CPU returns None). Guarded: any
+    runtime that lacks the API degrades to None, never an exception."""
+    try:
+        import jax
+
+        out = {}
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats:
+                out[f"{d.platform}:{d.id}"] = {
+                    k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)
+                }
+        return out or None
+    except Exception:
+        return None
+
+
+def aot_cost(fn, *args, **kwargs):
+    """Compile-time cost introspection for a jitted callable on concrete
+    args: ``{"flops", "bytes_accessed", "memory": {...}}`` from
+    ``Compiled.cost_analysis()`` / ``memory_analysis()``, or None when
+    the backend doesn't expose them. ``fn.lower(...).compile()`` is a
+    *fresh* compile (the jit execution cache is separate), so call this
+    only when a specialization is new — JitWatch.poll's ``cost=``
+    callable is invoked exactly on cache growth for this reason."""
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+    except Exception:
+        return None
+    out = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            for src, dst in (("flops", "flops"),
+                             ("bytes accessed", "bytes_accessed")):
+                v = ca.get(src)
+                if v is not None:
+                    out[dst] = float(v)
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        mem = {}
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(ma, k, None)
+            if v is not None:
+                mem[k] = int(v)
+        if mem:
+            out["memory"] = mem
+    except Exception:
+        pass
+    return out or None
+
+
 class JitWatch:
     """Cache-miss watcher for one jitted callable: ``poll(rec)`` after a
     call emits a ``compile`` event when the trace cache grew, giving
@@ -197,12 +265,28 @@ class JitWatch:
         self.name = name
         self.last = jit_cache_size(fn)
 
-    def poll(self, rec, **fields):
+    def poll(self, rec, cost=None, **fields):
+        """``cost`` is an optional zero-arg callable (typically a
+        closure over ``aot_cost`` with the call's concrete args) invoked
+        only when the cache grew; its dict — plus a device-memory
+        snapshot where supported — is merged into the compile event."""
         n = jit_cache_size(self.fn)
         grew = n is not None and (self.last is None or n > self.last)
         self.last = n
         if grew:
-            rec.emit("compile", fn=self.name, cache_size=n, **fields)
+            extra = {}
+            if cost is not None:
+                try:
+                    c = cost()
+                except Exception:
+                    c = None
+                if c:
+                    extra.update(c)
+            mem = device_memory_snapshot()
+            if mem:
+                extra["device_memory"] = mem
+            rec.emit("compile", fn=self.name, cache_size=n,
+                     **fields, **extra)
         return grew
 
 

@@ -88,27 +88,46 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
                      waits_total=waits_total, n_yields=n_steps)
 
 
-def _emit_board_chunks(rec, chunk_meta, acc0, n_chains, n_transitions,
-                       transfer_total, hbm_bytes, path="board"):
+def _reject_dict(delta, proposals):
+    """Chunk-event ``reject`` breakdown from a per-chunk (4,) counter
+    delta. On the board path every step consumes exactly one proposal,
+    so accepted = proposals - rejects by the kernel invariant."""
+    d = [int(x) for x in delta]
+    return {"nonboundary": d[0], "pop": d[1], "disconnect": d[2],
+            "metropolis": d[3], "accepted": proposals - sum(d),
+            "proposals": proposals}
+
+
+def _emit_board_chunks(rec, chunk_meta, acc0, rej0, n_chains,
+                       n_transitions, transfer_total, hbm_bytes,
+                       path="board"):
     """Flush the deferred per-chunk telemetry of a board run. The board
-    loop never syncs mid-run (waits and accept counts are stashed as
-    device refs so dispatch pipelines); the accept readbacks happen HERE,
-    at the run-end sync that already exists, and each chunk event is
-    back-stamped with its dispatch-time ``ts``. Per-chunk ``wall_s`` is
-    therefore a dispatch interval — the run_end wall is the
-    authoritative end-to-end time (obs.events docstring)."""
+    loop never syncs mid-run (waits, accept and reject counts are
+    stashed as device refs so dispatch pipelines); those readbacks
+    happen HERE, at the run-end sync that already exists, and each chunk
+    event is back-stamped with its dispatch-time ``ts``. Per-chunk
+    ``wall_s`` is therefore a dispatch interval — the run_end wall is
+    the authoritative end-to-end time (obs.events docstring). Chunks
+    whose loop iteration already synced (host history copies) carry a
+    precomputed ``reject`` dict instead of a device ref."""
     last_acc = int(np.asarray(acc0, np.int64).sum())
     acc_start = last_acc
+    last_rej = (np.asarray(rej0, np.int64).sum(axis=0)
+                if rej0 is not None else None)
     done = 0
-    for steps, wall, tb, hbm, acc_ref, ts in chunk_meta:
+    for steps, wall, tb, hbm, acc_ref, rej_ref, reject, ts in chunk_meta:
         acc = int(np.asarray(acc_ref, np.int64).sum())
         done += steps
+        if reject is None and rej_ref is not None:
+            rej = np.asarray(rej_ref, np.int64).sum(axis=0)
+            reject = _reject_dict(rej - last_rej, n_chains * steps)
+            last_rej = rej
         rec.emit("chunk", ts=ts, runner="board", path=path, steps=steps,
                  chains=n_chains, flips=n_chains * steps, wall_s=wall,
                  flips_per_s=n_chains * steps / max(wall, 1e-12),
                  accept_rate=(acc - last_acc) / (n_chains * steps),
                  transfer_bytes=tb, hbm_history_bytes=hbm,
-                 done=done, total=n_transitions)
+                 done=done, total=n_transitions, reject=reject)
         last_acc = acc
     return (last_acc - acc_start) / max(n_chains * n_transitions, 1)
 
@@ -154,6 +173,10 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
     # — tagged on every event so fallback regressions are visible in
     # scoreboards (tools/obs_report.py breaks throughput out per path)
     path = kboard.body_for(bg, spec, bits)
+    had_rej = state.reject_count is not None
+    if rec and not had_rej:
+        state = state.replace(
+            reject_count=jnp.zeros((n_chains, 4), jnp.int32))
     if rec:
         rec.emit("run_start", runner="board", path=path, chains=n_chains,
                  n_steps=n_transitions, chunk=chunk,
@@ -163,6 +186,10 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                              "board.run_board_chunk")
         acc0, chunk_meta, hbm_bytes, transfer_total = \
             state.accept_count, [], 0, 0
+        rej0 = state.reject_count
+        last_rej = np.asarray(rej0, np.int64).sum(axis=0)
+        mon = obs.ChainMonitor(rec, total=n_transitions, path=path,
+                               runner="board")
         t_run0 = t_prev = time.perf_counter()
 
     done = 0
@@ -172,13 +199,20 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                                              collect=record_history,
                                              bits=bits)
         if rec:
-            watch.poll(rec, chunk=this)
+            watch.poll(rec, chunk=this,
+                       cost=lambda: obs.aot_cost(
+                           kboard.run_board_chunk, bg, spec, params,
+                           state, this, collect=record_history,
+                           bits=bits))
         transfer_bytes = 0
+        host_outs = None
         if record_history:
             # board chunks record BEFORE transitioning, so block-local
             # index 0 is already on the global grid
             outs = maybe_host(thin_outs(outs, record_every, offset=0),
                               history_device)
+            if not history_device:
+                host_outs = outs
             if rec:
                 nb = obs.dict_nbytes(outs)
                 if history_device:
@@ -192,9 +226,27 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
         done += this
         if rec:
             now = time.perf_counter()
-            chunk_meta.append((this, now - t_prev, transfer_bytes,
-                               hbm_bytes, state.accept_count, time.time()))
+            wall = now - t_prev
             t_prev = now
+            reject = None
+            if host_outs is not None:
+                # the history copy above already synchronized on this
+                # chunk, so the (C, 4) counter readback costs no new
+                # sync; without host copies the ref is stashed and read
+                # at the run-end sync like the accepts
+                rej = np.asarray(state.reject_count, np.int64).sum(axis=0)
+                reject = _reject_dict(rej - last_rej, n_chains * this)
+                last_rej = rej
+            chunk_meta.append((this, wall, transfer_bytes, hbm_bytes,
+                               state.accept_count, state.reject_count,
+                               reject, time.time()))
+            # wall is a dispatch interval when the loop pipelines; with
+            # host history copies (the common telemetry config) the copy
+            # synced above and it is real chunk wall time
+            mon.observe_chunk(outs=host_outs, wall_s=wall,
+                              flips_per_s=n_chains * this
+                              / max(wall, 1e-12),
+                              reject=reject, done=done)
 
     waits_total = _sum_pending(waits_total, pending_waits)
     history = assemble_history(hist_parts, record_history, history_device)
@@ -202,7 +254,7 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
         wall = time.perf_counter() - t_run0
         flips = n_chains * n_transitions
         accept_rate = _emit_board_chunks(
-            rec, chunk_meta, acc0, n_chains, n_transitions,
+            rec, chunk_meta, acc0, rej0, n_chains, n_transitions,
             transfer_total, hbm_bytes, path=path)
         rec.emit("run_end", runner="board", path=path,
                  n_yields=n_transitions,
@@ -210,6 +262,8 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=accept_rate, transfer_bytes=transfer_total,
                  hbm_history_bytes=hbm_bytes)
+        if not had_rej:
+            state = state.replace(reject_count=None)
     return RunResult(state=state, history=history,
                      waits_total=waits_total, n_yields=n_transitions)
 

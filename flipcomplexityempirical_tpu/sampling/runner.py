@@ -188,14 +188,24 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
 
     ``recorder``: an obs.Recorder emits one ``run_start``, one ``chunk``
     event per executed chunk (wall time, aggregate flips/s, accept rate,
-    history transfer/HBM bytes), a ``compile`` event per fresh
-    ``_run_chunk`` specialization, and one ``run_end``. The per-chunk
-    accept/timing readbacks piggyback on this runner's EXISTING per-chunk
-    sync (the waits drain) — no extra device syncs — and the default
-    NullRecorder skips all of it.
+    history transfer/HBM bytes, the kernel's reject-reason breakdown), a
+    ``compile`` event per fresh ``_run_chunk`` specialization (with AOT
+    flops/bytes cost analysis), a ``diag`` convergence snapshot per
+    chunk, ``anomaly`` events from the health thresholds, and one
+    ``run_end``. The per-chunk accept/reject/timing readbacks piggyback
+    on this runner's EXISTING per-chunk sync (the waits drain) — no
+    extra device syncs — and the default NullRecorder skips all of it.
+    Attaching a recorder enables the kernel's reject-reason counters
+    (``states.reject_count``), which respecializes the jit via the
+    pytree treedef; the sampled trajectories are bit-identical either
+    way (counting draws no randomness).
     """
     rec = obs.resolve_recorder(recorder)
     n_chains = states.assignment.shape[0]
+    had_rej = states.reject_count is not None
+    if rec and not had_rej:
+        states = states.replace(
+            reject_count=jnp.zeros((n_chains, 4), jnp.int32))
     if record_every < 1:
         raise ValueError(f"record_every must be >= 1, got {record_every}")
     if chunk is None:
@@ -214,6 +224,11 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         t_run0 = time.perf_counter()
         last_acc = int(np.asarray(states.accept_count, np.int64).sum())
         acc_start, hbm_bytes, transfer_total = last_acc, 0, 0
+        last_tries = int(np.asarray(states.tries_sum, np.int64).sum())
+        last_rej = (np.asarray(states.reject_count, np.int64).sum(axis=0)
+                    if states.reject_count is not None else None)
+        mon = obs.ChainMonitor(rec, total=n_steps, path="general",
+                               runner="general")
 
     if record_initial:
         states, out0 = _record_initial(dg, spec, params, states)
@@ -245,10 +260,16 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         states, outs = _run_chunk(dg, spec, params, states, this,
                                   collect=record_history)
         if rec:
-            watch.poll(rec, chunk=this)
+            watch.poll(rec, chunk=this,
+                       cost=lambda: obs.aot_cost(
+                           _run_chunk, dg, spec, params, states, this,
+                           collect=record_history))
         transfer_bytes = 0
+        host_outs = None
         if record_history:
             outs = maybe_host(thin_outs(outs, record_every), history_device)
+            if not history_device:
+                host_outs = outs
             if rec:
                 nb = obs.dict_nbytes(outs)
                 if history_device:
@@ -263,21 +284,38 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         done += this
         if rec:
             # the waits drain above already synchronized on this chunk,
-            # so the accept readback and the wall stamp cost no new sync
+            # so the accept/reject readbacks and the wall stamp cost no
+            # new sync
             acc = int(np.asarray(states.accept_count, np.int64).sum())
             now = time.perf_counter()
             wall = now - t_prev
             t_prev = now
+            reject = None
+            if last_rej is not None:
+                rej = np.asarray(states.reject_count, np.int64).sum(axis=0)
+                tries = int(np.asarray(states.tries_sum, np.int64).sum())
+                d = rej - last_rej
+                reject = {"nonboundary": int(d[0]), "pop": int(d[1]),
+                          "disconnect": int(d[2]), "metropolis": int(d[3]),
+                          "accepted": acc - last_acc,
+                          "proposals": tries - last_tries}
+                last_rej, last_tries = rej, tries
+            accept_rate = (acc - last_acc) / (n_chains * this)
+            flips_per_s = n_chains * this / max(wall, 1e-12)
             rec.emit("chunk", runner="general", path="general",
                      steps=this,
                      chains=n_chains, flips=n_chains * this,
                      wall_s=wall,
-                     flips_per_s=n_chains * this / max(wall, 1e-12),
-                     accept_rate=(acc - last_acc) / (n_chains * this),
+                     flips_per_s=flips_per_s,
+                     accept_rate=accept_rate,
                      transfer_bytes=transfer_bytes,
                      hbm_history_bytes=hbm_bytes,
-                     done=done, total=n_steps)
+                     done=done, total=n_steps, reject=reject)
             last_acc = acc
+            mon.observe_chunk(outs=host_outs, wall_s=wall,
+                              flips_per_s=flips_per_s,
+                              accept_rate=accept_rate, reject=reject,
+                              done=done)
 
     history = assemble_history(hist_parts, record_history, history_device)
     if rec:
@@ -290,5 +328,9 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                  accept_rate=(last_acc - acc_start) / max(flips, 1),
                  transfer_bytes=transfer_total,
                  hbm_history_bytes=hbm_bytes)
+    if rec and not had_rej:
+        # the counters were telemetry-enabled here; hand back the
+        # caller's treedef (checkpoints, downstream jits) unchanged
+        states = states.replace(reject_count=None)
     return RunResult(state=states, history=history,
                      waits_total=waits_total, n_yields=n_steps)

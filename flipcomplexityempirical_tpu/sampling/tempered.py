@@ -172,12 +172,15 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                             thin_outs(outs, record_every, offset=offset))
         for k, v in outs.items():
             hist_parts.setdefault(k, []).append(v.T)
-        return obs.dict_nbytes(outs)
+        return obs.dict_nbytes(outs), outs
 
     transitions = n_steps if segment else n_steps - 1
     rec = obs.resolve_recorder(recorder)
     path = (kboard.body_for(graph_handle, spec, bits) if is_board
             else "general")
+    had_rej = states.reject_count is not None
+    if rec and not had_rej:
+        states = states.replace(reject_count=jnp.zeros((c, 4), jnp.int32))
     if rec:
         chunk_fn = kboard.run_board_chunk if is_board else runner._run_chunk
         watch = obs.JitWatch(
@@ -192,6 +195,13 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
         t_run0 = t_prev = time.perf_counter()
         last_acc = int(np.asarray(states.accept_count, np.int64).sum())
         acc_start, transfer_total = last_acc, 0
+        last_rej = np.asarray(states.reject_count, np.int64).sum(axis=0)
+        last_tries = int(np.asarray(states.tries_sum, np.int64).sum())
+        # one monitor across the whole ladder: R-hat/ESS here mix rungs
+        # (hot chains explore wider), so read the diag stream as a
+        # health signal, not a cold-chain convergence certificate
+        mon = obs.ChainMonitor(rec, total=transitions, path=path,
+                               runner="tempered")
     done = 0
     parity = start_parity
     if not is_board and record_initial:
@@ -217,29 +227,45 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
         if rec:
             watch.poll(rec, chunk=this)
         transfer_bytes = 0
+        host_outs = None
         if record_history:
-            transfer_bytes = collect(outs, 0 if is_board else
-                                     record_every - 1)
+            transfer_bytes, host_outs = collect(outs, 0 if is_board else
+                                                record_every - 1)
         pending.append(states.waits_sum)
         states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
         done += this
         if rec:
             # _host_rungs / the swap below synchronize every round
-            # anyway; piggyback the round's accept readback on it
+            # anyway; piggyback the round's accept/reject readbacks on it
             acc = int(np.asarray(states.accept_count, np.int64).sum())
             now = time.perf_counter()
             wall = now - t_prev
             t_prev = now
             transfer_total += transfer_bytes
+            rej = np.asarray(states.reject_count, np.int64).sum(axis=0)
+            tries = int(np.asarray(states.tries_sum, np.int64).sum())
+            d = rej - last_rej
+            reject = {"nonboundary": int(d[0]), "pop": int(d[1]),
+                      "disconnect": int(d[2]), "metropolis": int(d[3]),
+                      "accepted": acc - last_acc,
+                      "proposals": tries - last_tries}
+            last_rej, last_tries = rej, tries
+            accept_rate = (acc - last_acc) / (c * this)
+            flips_per_s = c * this / max(wall, 1e-12)
             rec.emit("chunk", runner="tempered", path=path, steps=this,
                      chains=c,
                      flips=c * this, wall_s=wall,
-                     flips_per_s=c * this / max(wall, 1e-12),
-                     accept_rate=(acc - last_acc) / (c * this),
+                     flips_per_s=flips_per_s,
+                     accept_rate=accept_rate,
                      transfer_bytes=transfer_bytes, hbm_history_bytes=0,
                      done=done, total=transitions,
-                     round=len(beta_rows) - 1, parity=parity)
+                     round=len(beta_rows) - 1, parity=parity,
+                     reject=reject)
             last_acc = acc
+            mon.observe_chunk(outs=host_outs, wall_s=wall,
+                              flips_per_s=flips_per_s,
+                              accept_rate=accept_rate, reject=reject,
+                              done=done)
         if done < transitions or segment:
             # swaps sit BETWEEN rounds only: no trailing swap on a FULL
             # run, so the final recorded yield still belongs to
@@ -253,6 +279,10 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                               attempts, accepts, n_ladders)
             parity ^= 1
 
+    if rec and not had_rej:
+        # drop the telemetry-enabled counters so the returned state (and
+        # the finalize jit below) keeps the caller's treedef
+        states = states.replace(reject_count=None)
     if is_board and not segment:
         res = board_runner.finalize_board_run(
             graph_handle, spec, params, states, hist_parts, waits_total,

@@ -15,6 +15,8 @@ part_sum per node) and its in-memory lists (waits).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -48,6 +50,13 @@ class ChainState:
     accept_count: jnp.ndarray  # int32
     tries_sum: jnp.ndarray     # int32 proposals drawn (incl. invalid retries)
     exhausted_count: jnp.ndarray  # int32 re-propose loops that hit the cap
+    # reject-reason taxonomy (ISSUE 3): int32[4] counts of proposals lost
+    # to [non-boundary, pop-bound, disconnect, Metropolis]. None (the
+    # default everywhere) keeps the pytree treedef — and thus every
+    # compiled graph and checkpoint — identical to before; runners
+    # enable it with .replace(reject_count=zeros) when a recorder is
+    # attached, which respecializes the jit via the treedef change.
+    reject_count: Optional[jnp.ndarray] = None
 
     @property
     def n_districts(self) -> int:

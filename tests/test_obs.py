@@ -336,3 +336,284 @@ def test_obs_report_check_fails_bad_stream(tmp_path):
     assert r.returncode == 1
     assert f"{path}:1:" in r.stderr and f"{path}:2:" in r.stderr
     assert "2/3 events failed" in r.stderr
+
+
+# --------------------------------------- chain health monitor (ISSUE 3)
+
+
+class _Cap:
+    """Minimal truthy recorder: captures emitted events in memory."""
+
+    def __init__(self):
+        self.events = []
+
+    def __bool__(self):
+        return True
+
+    def emit(self, event, ts=None, **fields):
+        e = {"event": event, **fields}
+        self.events.append(e)
+        return e
+
+
+def test_chain_monitor_matches_offline_oracles():
+    """While the thinning buffer is below cap (stride 1) the streaming
+    R-hat/ESS are EXACTLY the stats.diagnostics oracles applied to the
+    concatenated history, and the Welford mean matches numpy."""
+    from flipcomplexityempirical_tpu.stats import diagnostics as dx
+    rng = np.random.default_rng(0)
+    # 6 chains with slightly offset means so R-hat is > 1 but finite
+    blocks = [rng.normal(size=(50, 6)) + 0.05 * np.arange(6)
+              for _ in range(4)]
+    rec = _Cap()
+    mon = obs.ChainMonitor(rec, observable="cut_count", total=200,
+                           path="general", runner="general")
+    for i, b in enumerate(blocks):
+        mon.observe_chunk(outs={"cut_count": b}, wall_s=0.5,
+                          done=(i + 1) * 50)
+    full = np.concatenate(blocks, axis=0).T  # (C, T)
+    diags = [e for e in rec.events if e["event"] == "diag"]
+    assert len(diags) == 4
+    d = diags[-1]
+    assert d["samples"] == 200 and d["chunks"] == 4
+    assert d["rhat"] == pytest.approx(dx.gelman_rubin(full), rel=1e-12)
+    assert d["ess"] == pytest.approx(dx.ess(full)[1], rel=1e-12)
+    assert d["ess_per_s"] == pytest.approx(d["ess"] / 2.0, rel=1e-12)
+    assert d["mean"] == pytest.approx(full.mean(), rel=1e-12)
+    assert not [e for e in rec.events if e["event"] == "anomaly"]
+
+
+def test_chain_monitor_thinning_stays_bounded():
+    """Past buffer_cap the keep-stride doubles and memory stays bounded;
+    diagnostics remain finite and ESS is scaled back to raw samples."""
+    rng = np.random.default_rng(1)
+    rec = _Cap()
+    mon = obs.ChainMonitor(rec, buffer_cap=64)
+    for _ in range(10):
+        mon.observe_chunk(outs={"cut_count": rng.normal(size=(100, 4))})
+    assert mon._stride > 1
+    assert mon._buf.shape[1] <= 64
+    assert mon._n == 1000  # Welford still saw every sample
+    d = rec.events[-1]
+    assert d["event"] == "diag" and d["rhat"] is not None
+    # white noise: ESS scaled by stride lands near the raw sample count
+    assert d["ess"] > 64
+
+
+def test_chain_monitor_anomaly_thresholds_fire_and_rearm():
+    """Synthetic feeds trip each detector: a chain that stops accepting
+    goes frozen after freeze_chunks, acceptance EWMA below the floor
+    collapses after warmup, and a pop-saturated reject breakdown fires
+    immediately; a recovery re-arms the edge-triggered events."""
+    rec = _Cap()
+    mon = obs.ChainMonitor(rec, freeze_chunks=2, warmup_chunks=1,
+                           collapse_rate=0.2, pop_sat_frac=0.9)
+
+    def feed(acc_per_chain, rate, pop_frac):
+        # cumulative accepts series: chain c gains acc_per_chain[c]
+        base = feed.cum.copy()
+        feed.cum = feed.cum + np.asarray(acc_per_chain, float)
+        accepts = np.linspace(base, feed.cum, 10)  # (T, C)
+        prop = 100
+        rej = {"nonboundary": 0, "pop": int(pop_frac * prop),
+               "disconnect": 0, "metropolis": 0,
+               "accepted": int(rate * prop), "proposals": prop}
+        rej["nonboundary"] = prop - rej["pop"] - rej["accepted"]
+        mon.observe_chunk(outs={"accepts": accepts},
+                          accept_rate=rate, reject=rej)
+
+    feed.cum = np.zeros(3)
+    feed([5, 5, 5], 0.5, 0.1)           # healthy
+    feed([5, 0, 5], 0.5, 0.1)           # chain 1 stalls (streak 1)
+    feed([5, 0, 5], 0.01, 0.95)         # streak 2 -> frozen; pop sat
+    kinds = [e["kind"] for e in rec.events if e["event"] == "anomaly"]
+    assert "frozen_chain" in kinds and "pop_bound_saturation" in kinds
+    frozen = next(e for e in rec.events if e["event"] == "anomaly"
+                  and e["kind"] == "frozen_chain")
+    assert frozen["detail"]["new_chains"] == [1]
+    feed([5, 0, 5], 0.01, 0.95)         # EWMA sinks below collapse_rate
+    feed([5, 0, 5], 0.01, 0.95)
+    kinds = [e["kind"] for e in rec.events if e["event"] == "anomaly"]
+    assert "acceptance_collapse" in kinds
+    n_before = len(kinds)
+    feed([5, 0, 5], 0.01, 0.95)         # still sick: no re-fire
+    kinds = [e["kind"] for e in rec.events if e["event"] == "anomaly"]
+    assert len(kinds) == n_before
+    feed([5, 5, 5], 0.9, 0.1)           # recovery re-arms everything
+    feed([5, 0, 5], 0.5, 0.95)
+    feed([5, 0, 5], 0.5, 0.95)          # second frozen episode
+    kinds = [e["kind"] for e in rec.events if e["event"] == "anomaly"]
+    assert kinds.count("frozen_chain") == 2
+    assert kinds.count("pop_bound_saturation") == 2
+
+
+# ------------------------------------- reject-reason taxonomy (ISSUE 3)
+
+
+def test_reject_breakdown_general_path(tmp_path):
+    """run_chains chunk events carry a reject breakdown whose reasons +
+    accepted sum exactly to the proposals drawn that chunk, and the
+    counter plumbing never leaks into the returned state."""
+    g, plan, spec = _grid_setup(6)
+    # n_chains=3 is unique in this file: the chunk body really compiles
+    # here (not a jit-cache hit from an earlier test), so the compile
+    # event with its AOT cost analysis must appear
+    dg, st, params = fce.init_batch(g, plan, n_chains=3, seed=0,
+                                    spec=spec, base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "rej.jsonl")
+    with obs.Recorder(path=path) as rec:
+        res = fce.run_chains(dg, spec, params, st, n_steps=76, chunk=25,
+                             recorder=rec)
+    assert res.state.reject_count is None  # stripped before return
+    events = read_events(path)
+    assert_stream_valid(events)
+    chunks = [e for e in events if e["event"] == "chunk"]
+    assert len(chunks) == 3
+    for c in chunks:
+        r = c["reject"]
+        parts = (r["nonboundary"] + r["pop"] + r["disconnect"]
+                 + r["metropolis"] + r["accepted"])
+        assert parts == r["proposals"] > 0
+        assert all(v >= 0 for v in r.values())
+    diags = [e for e in events if e["event"] == "diag"]
+    assert len(diags) == len(chunks)
+    assert all(d["observable"] == "cut_count" for d in diags)
+    # compile events carry the AOT cost analysis when XLA provides it
+    comp = [e for e in events if e["event"] == "compile"]
+    assert comp and any("flops" in e or "cost_error" in e for e in comp)
+
+
+def test_reject_breakdown_lowered_path(tmp_path):
+    """The queen-adjacency grid takes the surgical-stencil LOWERED board
+    body; its reject counters obey the same sum-to-proposals invariant
+    (board proposals = chains * steps, one draw per step)."""
+    from flipcomplexityempirical_tpu.kernel import board as kboard
+    g = fce.graphs.square_grid(8, 8, queen=True)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=4, seed=0, spec=spec, base=1.3, pop_tol=0.4)
+    assert kboard.body_for(bg, spec) == "lowered"
+    path = str(tmp_path / "low.jsonl")
+    with obs.Recorder(path=path) as rec:
+        res = fce.sampling.run_board(bg, spec, params, st, n_steps=61,
+                                     chunk=20, recorder=rec)
+    assert res.state.reject_count is None
+    events = read_events(path)
+    assert_stream_valid(events)
+    chunks = [e for e in events if e["event"] == "chunk"]
+    assert len(chunks) == 3
+    for c in chunks:
+        r = c["reject"]
+        assert r["proposals"] == c["flips"] == 4 * c["steps"]
+        parts = (r["nonboundary"] + r["pop"] + r["disconnect"]
+                 + r["metropolis"] + r["accepted"])
+        assert parts == r["proposals"]
+        assert r["accepted"] == round(c["accept_rate"] * c["flips"])
+
+
+def test_frozen_board_run_emits_anomalies_and_strict_gate(tmp_path):
+    """pop_tol=0 rejects every proposal on the population bound: the
+    stream must carry pop_bound_saturation, frozen_chain, and
+    acceptance_collapse anomalies, pass --check, and fail --strict."""
+    g, plan, spec = _grid_setup()
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=4, seed=0, spec=spec, base=1.3, pop_tol=0.0)
+    path = str(tmp_path / "frozen.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.sampling.run_board(bg, spec, params, st, n_steps=121,
+                               chunk=15, recorder=rec)
+    events = read_events(path)
+    assert_stream_valid(events)
+    kinds = {e["kind"] for e in events if e["event"] == "anomaly"}
+    assert {"pop_bound_saturation", "frozen_chain",
+            "acceptance_collapse"} <= kinds
+    for c in (e for e in events if e["event"] == "chunk"):
+        assert c["reject"]["pop"] == c["reject"]["proposals"]
+        assert c["reject"]["accepted"] == 0
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, REPORT, "--check", path],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run([sys.executable, REPORT, "--strict", path],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 2
+    assert "anomal" in r.stderr
+    assert "## Health" in r.stdout and "pop_bound_saturation" in r.stdout
+
+
+def test_obs_report_synthesizes_partial_run(tmp_path):
+    """A stream that ends mid-run (no run_end: crash or in flight) still
+    reports the run, marked partial, with totals from its chunks."""
+    g, plan, spec = _grid_setup(6)
+    dg, st, params = fce.init_batch(g, plan, n_chains=4, seed=0,
+                                    spec=spec, base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "part.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.run_chains(dg, spec, params, st, n_steps=51, chunk=25,
+                       recorder=rec)
+    lines = [ln for ln in open(path, encoding="utf-8")
+             if '"run_end"' not in ln]
+    with open(path, "w", encoding="utf-8") as f:
+        f.writelines(lines)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, REPORT, path],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "general*" in r.stdout
+    assert "synthesized" in r.stdout
+
+
+# ----------------------------------------- recorder durability (ISSUE 3)
+
+
+def test_recorder_fsyncs_on_error_event(tmp_path, monkeypatch):
+    """The error event an aborting sweep emits must hit the disk before
+    the process dies: emit('error') flushes AND fsyncs the stream."""
+    from flipcomplexityempirical_tpu.obs import recorder as rmod
+    synced = []
+    monkeypatch.setattr(rmod.os, "fsync", lambda fd: synced.append(fd))
+    cfg = ex.ExperimentConfig(family="dual", dual_source="bogus",
+                              alignment=0, base=0.3, pop_tol=0.5,
+                              total_steps=50, n_chains=2)
+    path = str(tmp_path / "err.jsonl")
+    with obs.Recorder(path=path) as rec:
+        with pytest.raises(ValueError, match="dual_source"):
+            ex.run_sweep([cfg], str(tmp_path / "out"), verbose=False,
+                         recorder=rec)
+        assert synced  # fsync happened at emit time, not at close
+    events = read_events(path)
+    errs = [e for e in events if e["event"] == "error"]
+    assert len(errs) == 1 and "dual_source" in errs[0]["message"]
+    assert errs[0]["tag"] == cfg.tag
+
+
+def test_heartbeat_embeds_latest_diag(tmp_path, monkeypatch):
+    """While a config runs, each runner diag snapshot refreshes the
+    sweep heartbeat under the active config's tag; the hook is cleared
+    once the config finishes."""
+    from flipcomplexityempirical_tpu.experiments import driver as drv
+    seen = []
+    real = drv.write_heartbeat
+
+    def spy(hb_path, **payload):
+        if "diag" in payload:
+            seen.append(payload)
+        return real(hb_path, **payload)
+
+    monkeypatch.setattr(drv, "write_heartbeat", spy)
+    cfg = ex.ExperimentConfig(family="frank", alignment=0, base=0.3,
+                              pop_tol=0.5, total_steps=120, n_chains=2)
+    out = str(tmp_path / "plots")
+    os.makedirs(out)
+    path = str(tmp_path / "sw.jsonl")
+    hb = str(tmp_path / "hb.json")
+    with obs.Recorder(path=path) as rec:
+        ex.run_sweep([cfg], out, verbose=False, recorder=rec,
+                     heartbeat=hb)
+        assert getattr(rec, "diag_hook", "unset") is None
+    assert seen, "no diag-bearing heartbeat refresh while running"
+    snap = seen[-1]["diag"][cfg.tag]
+    assert snap["event"] == "diag" and snap["samples"] > 0
+    assert seen[-1]["status"] == "running"
+    assert seen[-1]["current"] == cfg.tag

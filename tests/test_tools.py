@@ -161,3 +161,66 @@ def test_vsb_at_least_gate(sandbox):
         f.write_text(content)
         r = drive(sandbox, "ok", f"vsb_at_least bench_runs/x.json {floor}")
         assert r.returncode == expect, (content, floor, r.returncode)
+
+
+# --------------------------------------------------------------------
+# tools/bench_compare.py: diffing two bench records
+
+import json
+import sys
+
+BENCH_COMPARE = os.path.join(REPO, "tools", "bench_compare.py")
+
+
+def _bench_record(path, value, seconds=2.0, metric="flips_per_sec_total"):
+    """Write a BENCH_r*-shaped record: a parsed block plus a captured
+    tail holding a metric line and a config line."""
+    tail = (json.dumps({"metric": metric, "value": value,
+                        "unit": "flips/s"}) + "\n"
+            + "some non-json log line\n"
+            + json.dumps({"path": "board", "body": "bitboard", "grid": 64,
+                          "chains": 8, "steps": 101,
+                          "seconds": seconds}) + "\n")
+    doc = {"n": 1, "rc": 0, "tail": tail,
+           "parsed": {"metric": metric + "_parsed", "value": value}}
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def _compare(a, b, *extra):
+    return subprocess.run(
+        [sys.executable, BENCH_COMPARE, str(a), str(b), *extra],
+        capture_output=True, text=True)
+
+
+def test_bench_compare_improvement_passes(tmp_path):
+    a = _bench_record(tmp_path / "a.json", 1000.0)
+    b = _bench_record(tmp_path / "b.json", 1100.0, seconds=1.8)
+    r = _compare(a, b)
+    assert r.returncode == 0, r.stderr
+    assert "flips_per_sec_total" in r.stdout
+    # the derived per-config throughput is in the table too
+    assert "config[" in r.stdout and ".flips_per_s" in r.stdout
+    assert "REGRESSED" not in r.stdout
+
+
+def test_bench_compare_regression_gates(tmp_path):
+    a = _bench_record(tmp_path / "a.json", 1000.0)
+    b = _bench_record(tmp_path / "b.json", 800.0)  # -20%
+    r = _compare(a, b)
+    assert r.returncode == 1
+    assert "REGRESSED" in r.stdout
+    assert "flips_per_sec_total" in r.stderr
+    # a loose enough tolerance lets the same pair through
+    r = _compare(a, b, "--tolerance", "0.25")
+    assert r.returncode == 0, r.stderr
+    assert "REGRESSED" not in r.stdout
+
+
+def test_bench_compare_disjoint_metrics_warns(tmp_path):
+    a = _bench_record(tmp_path / "a.json", 1000.0, metric="m_old")
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"parsed": {"metric": "m_new", "value": 1.0}}))
+    r = _compare(a, b)
+    assert r.returncode == 0
+    assert "nothing to gate on" in r.stderr

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Diff two bench records (BENCH_r*.json): per-metric flips/s deltas.
+
+    python tools/bench_compare.py OLD.json NEW.json [--tolerance 0.05]
+
+Walks both documents for anything metric-shaped — ``parsed`` blocks and
+``{"metric": ..., "value": ...}`` JSON lines embedded in the captured
+``tail`` — plus per-config throughput derived from bench config lines
+(``chains * (steps - 1) / seconds``, named by path/body/grid/chains so
+the same configuration matches across records). Prints a delta table
+and exits nonzero when any metric present in BOTH records regressed by
+more than ``--tolerance`` (a fraction: 0.05 = 5%), so a bench wrapper
+can gate on throughput drift between rounds the way obs_report.py
+--check gates on stream shape. Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _config_name(d: dict) -> str:
+    """Stable name for a bench config line, from the fields that define
+    the workload (not the measurement)."""
+    parts = []
+    for k in ("path", "body", "grid", "k", "chains", "device"):
+        if k in d:
+            parts.append(f"{k}={d[k]}")
+    return "config[" + ",".join(parts) + "]"
+
+
+def extract_metrics(doc, out: dict | None = None) -> dict:
+    """name -> float over everything metric-shaped in a bench record."""
+    if out is None:
+        out = {}
+    if isinstance(doc, dict):
+        if "metric" in doc and isinstance(doc.get("value"), (int, float)):
+            out[str(doc["metric"])] = float(doc["value"])
+        elif ("seconds" in doc and "chains" in doc and "steps" in doc
+              and doc.get("seconds")):
+            # a bench config line: derive the throughput it measured
+            flips = doc["chains"] * max(doc["steps"] - 1, 1)
+            out[_config_name(doc) + ".flips_per_s"] = \
+                flips / float(doc["seconds"])
+        for key in ("parsed", "results", "metrics"):
+            if key in doc:
+                extract_metrics(doc[key], out)
+        tail = doc.get("tail")
+        if isinstance(tail, str):
+            for line in tail.splitlines():
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    extract_metrics(json.loads(line), out)
+                except ValueError:
+                    pass
+    elif isinstance(doc, list):
+        for item in doc:
+            extract_metrics(item, out)
+    return out
+
+
+def compare(a: dict, b: dict, tolerance: float, out=sys.stdout):
+    """Print the delta table; return the list of regressed metric names.
+    Higher is better (every extracted metric is a throughput)."""
+    names = sorted(set(a) | set(b))
+    regressed = []
+    print("| metric | A | B | delta |", file=out)
+    print("|---|---|---|---|", file=out)
+    for name in names:
+        va, vb = a.get(name), b.get(name)
+        if va is None or vb is None:
+            side = "A" if vb is None else "B"
+            print(f"| {name} | {_num(va)} | {_num(vb)} "
+                  f"| only in {side} |", file=out)
+            continue
+        delta = (vb - va) / va if va else 0.0
+        flag = ""
+        if delta < -tolerance:
+            flag = " REGRESSED"
+            regressed.append(name)
+        print(f"| {name} | {_num(va)} | {_num(vb)} "
+              f"| {delta:+.1%}{flag} |", file=out)
+    return regressed
+
+
+def _num(v):
+    return "-" if v is None else f"{v:,.1f}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two BENCH_r*.json records; exit nonzero on "
+                    "throughput regression past --tolerance")
+    ap.add_argument("old", help="baseline bench record (A)")
+    ap.add_argument("new", help="candidate bench record (B)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed fractional regression before the "
+                         "nonzero exit (default 0.05 = 5%%)")
+    args = ap.parse_args(argv)
+
+    with open(args.old, encoding="utf-8") as f:
+        a = extract_metrics(json.load(f))
+    with open(args.new, encoding="utf-8") as f:
+        b = extract_metrics(json.load(f))
+
+    common = set(a) & set(b)
+    if not common:
+        print("bench_compare: no metric appears in both records — "
+              "nothing to gate on", file=sys.stderr)
+        return 0
+
+    regressed = compare(a, b, args.tolerance)
+    if regressed:
+        print(f"bench_compare: {len(regressed)} metric(s) regressed "
+              f"past {args.tolerance:.0%}: " + ", ".join(regressed),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,13 +10,21 @@ wall, aggregate flips/s, accept rate, host-transfer and HBM-resident
 history bytes, and compile (jit cache miss) counts — plus a per-chunk
 throughput spread so a single degraded chunk (the round-5 "history
 readback dwarfs sampling" class of finding) is visible without a
-profiler. A trailing sweep section summarizes driver progress events.
+profiler. Runs whose stream ends without a run_end (crash / still in
+flight) get partial totals synthesized from their chunk events, marked
+with a trailing ``*``. A Health section renders the in-flight monitor's
+output: anomaly events, the kernel reject-reason breakdown per path,
+and each run's R-hat trajectory from its ``diag`` stream. A trailing
+sweep section summarizes driver progress events.
 
 ``--check`` validates every line against the event schema
 (obs.events.EVENT_FIELDS envelope + per-type core fields) and exits
 nonzero listing each malformed/unknown event — the CI gate on anything
-that emits telemetry. Stdlib-only: the schema module is loaded by file
-path, so the check needs no jax (and no package import) at all.
+that emits telemetry. ``--strict`` additionally exits nonzero (after
+printing the report) when the stream carries any ``anomaly`` events —
+the CI gate on chain HEALTH rather than stream shape. Stdlib-only: the
+schema module is loaded by file path, so neither gate needs jax (or any
+package import) at all.
 """
 
 from __future__ import annotations
@@ -92,7 +100,8 @@ def fold_runs(events) -> list[dict]:
         kind = e["event"]
         if kind == "run_start":
             open_run = {"start": e, "chunks": [], "compiles": 0,
-                        "transfers": 0, "end": None}
+                        "transfers": 0, "diags": [], "anomalies": [],
+                        "end": None}
             runs.append(open_run)
         elif open_run is not None:
             if kind == "chunk":
@@ -101,10 +110,38 @@ def fold_runs(events) -> list[dict]:
                 open_run["compiles"] += 1
             elif kind == "transfer":
                 open_run["transfers"] += e.get("bytes", 0)
+            elif kind == "diag":
+                open_run["diags"].append(e)
+            elif kind == "anomaly":
+                open_run["anomalies"].append(e)
             elif kind == "run_end":
                 open_run["end"] = e
                 open_run = None
     return runs
+
+
+def synthesize_totals(run) -> dict | None:
+    """run_end-shaped partial totals for a run that never closed,
+    rebuilt from its chunk events: wall and flips are sums, the accept
+    rate is flips-weighted, and the byte totals come from the running
+    per-chunk fields (hbm is cumulative on chunk events; transfers are
+    per-chunk). None when not even one chunk landed."""
+    chunks = run["chunks"]
+    if not chunks:
+        return None
+    flips = sum(c.get("flips", 0) for c in chunks)
+    wall = sum(c.get("wall_s", 0.0) for c in chunks)
+    acc = sum(c.get("accept_rate", 0.0) * c.get("flips", 0)
+              for c in chunks if c.get("accept_rate") is not None)
+    return {
+        "flips": flips,
+        "wall_s": wall,
+        "flips_per_s": flips / max(wall, 1e-12),
+        "accept_rate": (acc / flips) if flips else None,
+        "transfer_bytes": sum(c.get("transfer_bytes", 0) for c in chunks),
+        "hbm_history_bytes": chunks[-1].get("hbm_history_bytes", 0),
+        "done": chunks[-1].get("done", 0),
+    }
 
 
 def report_runs(runs, out):
@@ -113,23 +150,32 @@ def report_runs(runs, out):
     print("## Runs", file=out)
     print("| " + " | ".join(cols) + " |", file=out)
     print("|" + "---|" * len(cols), file=out)
+    partials = 0
     for r in runs:
         s, e = r["start"], r["end"]
+        mark = ""
         if e is None:
-            done = r["chunks"][-1]["done"] if r["chunks"] else 0
-            print(f"| {s['runner']} | {s.get('path', '-')} "
-                  f"| {s['chains']} | {s['n_steps']} "
-                  f"| {len(r['chunks'])} | UNFINISHED@{done} | - | - "
-                  f"| - | - | {r['compiles']} |", file=out)
-            continue
+            e = synthesize_totals(r)
+            if e is None:
+                print(f"| {s['runner']} | {s.get('path', '-')} "
+                      f"| {s['chains']} | {s['n_steps']} "
+                      f"| 0 | UNFINISHED@0 | - | - "
+                      f"| - | - | {r['compiles']} |", file=out)
+                continue
+            mark = "*"
+            partials += 1
         rate = e.get("accept_rate")
-        print(f"| {s['runner']} | {s.get('path', '-')} | {s['chains']} "
+        print(f"| {s['runner']}{mark} | {s.get('path', '-')} "
+              f"| {s['chains']} "
               f"| {s['n_steps']} | {len(r['chunks'])} "
               f"| {e['wall_s']:.3f} | {e['flips_per_s'] / 1e6:.3f} "
               f"| {'-' if rate is None else format(rate, '.3f')} "
               f"| {_mb(e.get('transfer_bytes', 0) + r['transfers'])} "
               f"| {_mb(e.get('hbm_history_bytes', 0))} "
               f"| {r['compiles']} |", file=out)
+    if partials:
+        print("\n\\* no run_end in stream (crash or in flight): totals "
+              "synthesized from its chunk events", file=out)
 
     report_paths(runs, out)
 
@@ -153,7 +199,7 @@ def report_paths(runs, out):
     'lowered')."""
     by_path: dict = {}
     for r in runs:
-        e = r["end"]
+        e = r["end"] or synthesize_totals(r)
         if e is None:
             continue
         path = r["start"].get("path", e.get("path", "-"))
@@ -172,6 +218,85 @@ def report_paths(runs, out):
         rate = a["flips"] / max(a["wall"], 1e-12)
         print(f"| {path} | {a['runs']} | {a['flips']} "
               f"| {a['wall']:.3f} | {rate / 1e6:.3f} |", file=out)
+
+
+def _fmt_rhat(x):
+    return "-" if x is None else f"{x:.3f}"
+
+
+def report_health(events, runs, out):
+    """The in-flight monitor's section: anomaly events, the kernel
+    reject-reason breakdown per path (from the chunk events' ``reject``
+    dicts), and each run's R-hat trajectory from its ``diag`` stream.
+    Rendered only when the stream carries health data at all (older
+    streams without diag/anomaly/reject stay byte-identical)."""
+    anomalies = [e for e in events if e["event"] == "anomaly"]
+    by_path: dict = {}
+    for e in events:
+        r = e.get("reject") if e["event"] == "chunk" else None
+        if not r:
+            continue
+        agg = by_path.setdefault(e.get("path", "-"), {})
+        for k, v in r.items():
+            if isinstance(v, (int, float)):
+                agg[k] = agg.get(k, 0) + v
+    trajectories = [(i, r) for i, r in enumerate(runs) if r["diags"]]
+    if not (anomalies or by_path or trajectories):
+        return
+
+    print("\n## Health", file=out)
+    if anomalies:
+        t0 = events[0]["ts"]
+        print(f"{len(anomalies)} anomaly event(s):", file=out)
+        print("| t+s | kind | runner | path | detail |", file=out)
+        print("|---|---|---|---|---|", file=out)
+        for a in anomalies:
+            detail = ", ".join(f"{k}={v}" for k, v in
+                               sorted((a.get("detail") or {}).items()))
+            print(f"| {a['ts'] - t0:.1f} | {a['kind']} "
+                  f"| {a.get('runner', '-')} | {a.get('path', '-')} "
+                  f"| {detail} |", file=out)
+    else:
+        print("no anomalies.", file=out)
+
+    if by_path:
+        print("\n### Reject reasons by kernel path", file=out)
+        print("| path | proposals | accepted | nonboundary | pop "
+              "| disconnect | metropolis |", file=out)
+        print("|---|---|---|---|---|---|---|", file=out)
+        for path in sorted(by_path):
+            a = by_path[path]
+            prop = a.get("proposals", 0)
+
+            def cell(k, a=a, prop=prop):
+                v = a.get(k, 0)
+                return (f"{v} ({v / prop:.1%})" if prop else str(v))
+
+            print(f"| {path} | {prop} | {cell('accepted')} "
+                  f"| {cell('nonboundary')} | {cell('pop')} "
+                  f"| {cell('disconnect')} | {cell('metropolis')} |",
+                  file=out)
+
+    if trajectories:
+        print("\n### R-hat trajectory (diag stream)", file=out)
+        print("| run | runner | observable | rhat trajectory "
+              "| final ESS | ESS/s |", file=out)
+        print("|---|---|---|---|---|---|", file=out)
+        for i, r in trajectories:
+            ds = r["diags"]
+            # first / quartile-ish / last keeps the row width bounded
+            # while showing whether the run was converging
+            k = max(1, (len(ds) - 1 + 3) // 4)
+            picked = ds[:-1:k] + [ds[-1]] if len(ds) > 1 else ds
+            traj = " → ".join(_fmt_rhat(d.get("rhat")) for d in picked)
+            last = ds[-1]
+            ess = last.get("ess")
+            ess_s = last.get("ess_per_s")
+            print(f"| {i} | {r['start']['runner']} "
+                  f"| {last.get('observable', '-')} | {traj} "
+                  f"| {'-' if ess is None else format(ess, '.0f')} "
+                  f"| {'-' if ess_s is None else format(ess_s, '.1f')} |",
+                  file=out)
 
 
 def report_sweep(events, out):
@@ -211,6 +336,9 @@ def main(argv=None):
     ap.add_argument("--check", action="store_true",
                     help="validate only: exit nonzero on any "
                          "unknown/malformed event (CI gate)")
+    ap.add_argument("--strict", action="store_true",
+                    help="after the report, exit nonzero if the stream "
+                         "carries any anomaly events (health gate)")
     args = ap.parse_args(argv)
     schema = _load_schema()
 
@@ -232,7 +360,14 @@ def main(argv=None):
     runs = fold_runs(events)
     if runs:
         report_runs(runs, out)
+    report_health(events, runs, out)
     report_sweep(events, out)
+    if args.strict:
+        n_anom = sum(1 for e in events if e["event"] == "anomaly")
+        if n_anom:
+            print(f"--strict: {n_anom} anomaly event(s) in stream",
+                  file=sys.stderr)
+            return 2
     return 0
 
 
