@@ -152,6 +152,7 @@ def static_masks(bg):
     return [pack_bits(m[None, :]) for m in dirs]
 
 
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
 def planes_bits(bg, spec: Spec, params: StepParams, board_w, dist_pop,
                 count: bool = False):
     """Bit-plane analogue of board._planes: same[] ring planes, boundary
@@ -239,6 +240,7 @@ def _pick_row(rowcnt, u):
     return row, m - before, any_valid, oh_row
 
 
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
 def select_flat(bg, valid, u):
     """The (m+1)-th valid cell in flat row-major order — identical choice
     to the int8 path's two-matmul selection, via popcounts.
@@ -303,6 +305,7 @@ def _eq_const(planes, d: int):
     return acc
 
 
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
 def planes_bits_pair(bg, spec: Spec, params: StepParams, planes, dist_pop,
                      count: bool = False):
     """Bit-plane analogue of board._planes_pair: per-(node, rook
@@ -380,6 +383,7 @@ def planes_bits_pair(bg, spec: Spec, params: StepParams, planes, dist_pop,
     return out
 
 
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
 def select_flat_pair(bg, valid4, u):
     """The (m+1)-th valid (node, direction) slot in the int8 pair body's
     row-major order (flat' = v*4 + j). Returns (flat4, any_valid)."""

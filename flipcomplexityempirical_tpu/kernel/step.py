@@ -154,6 +154,7 @@ def geom_denom_finite(n_nodes: int, k: int) -> bool:
     return k * math.log(float(n_nodes)) < math.log(3.4028235e38)
 
 
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
 def sample_geom_minus1(key, b_count, n_nodes: int, k: int):
     """The reference waiting-time sample (grid_chain_sec11.py:147-148):
     Geometric(p) - 1 with p = |b_nodes| / (n_nodes**k - 1), via inverse CDF.
@@ -265,6 +266,7 @@ def _reject_reason(sampled_eff, pop_ok, valid):
     return ((jnp.arange(3) == reason) & ~valid).astype(jnp.int32)
 
 
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
 def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
             state: ChainState, key, count: bool = False):
     """Draw a proposal per the invalid-move policy. Returns
@@ -361,6 +363,7 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
     return v, d_to, valid, tries
 
 
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
 def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
                state: ChainState) -> ChainState:
     """One chain step: propose(+retries), Metropolis-accept, commit."""
@@ -471,6 +474,7 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
     )
 
 
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
 def record(dg: DeviceGraph, spec: Spec, params: StepParams,
            state: ChainState):
     """One yield of the measurement loop (grid_chain_sec11.py:366-402):

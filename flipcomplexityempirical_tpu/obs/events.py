@@ -60,20 +60,58 @@ from __future__ import annotations
 
 SCHEMA_VERSION = 1
 
-EVENT_FIELDS = {
-    "run_start": frozenset({"runner", "chains", "n_steps", "chunk"}),
-    "chunk": frozenset({"runner", "steps", "chains", "flips", "wall_s",
-                        "flips_per_s", "accept_rate", "transfer_bytes",
-                        "hbm_history_bytes", "done", "total"}),
-    "compile": frozenset({"fn", "cache_size"}),
-    "transfer": frozenset({"what", "bytes"}),
-    "run_end": frozenset({"runner", "n_yields", "wall_s", "flips_per_s"}),
-    "sweep_config": frozenset({"tag", "family", "status"}),
-    "error": frozenset({"message"}),
-    "diag": frozenset({"observable", "samples", "rhat", "ess",
-                       "ess_per_s", "accept_ewma", "throughput_ewma"}),
-    "anomaly": frozenset({"kind", "detail"}),
+# THE single source of truth for the event schema. Both validators
+# consume it: ``Recorder.emit`` checks each emitted event's name and
+# core-field coverage at runtime, and ``tools.graftlint`` rule G004
+# parses this literal out of the AST to check every ``.emit(...)`` call
+# site statically — so keep it a PURE LITERAL (string keys, tuple
+# ``fields``), no computed values.
+EVENT_REGISTRY = {
+    "run_start": {
+        "fields": ("runner", "chains", "n_steps", "chunk"),
+        "doc": "one per runner entry",
+    },
+    "chunk": {
+        "fields": ("runner", "steps", "chains", "flips", "wall_s",
+                   "flips_per_s", "accept_rate", "transfer_bytes",
+                   "hbm_history_bytes", "done", "total"),
+        "doc": "one per executed device chunk",
+    },
+    "compile": {
+        "fields": ("fn", "cache_size"),
+        "doc": "jit cache miss observed by JitWatch.poll",
+    },
+    "transfer": {
+        "fields": ("what", "bytes"),
+        "doc": "one-off device->host copy outside the chunk stream",
+    },
+    "run_end": {
+        "fields": ("runner", "n_yields", "wall_s", "flips_per_s"),
+        "doc": "totals for the run",
+    },
+    "sweep_config": {
+        "fields": ("tag", "family", "status"),
+        "doc": "driver progress; status in SWEEP_STATUSES",
+    },
+    "error": {
+        "fields": ("message",),
+        "doc": "a failure the emitter survived or is about to re-raise",
+    },
+    "diag": {
+        "fields": ("observable", "samples", "rhat", "ess", "ess_per_s",
+                   "accept_ewma", "throughput_ewma"),
+        "doc": "streaming convergence health (obs.monitor.ChainMonitor)",
+    },
+    "anomaly": {
+        "fields": ("kind", "detail"),
+        "doc": "monitor health-threshold episode",
+    },
 }
+
+# Derived view (event -> frozenset of core fields) kept for existing
+# consumers: validate_event below, tools/obs_report.py, tests.
+EVENT_FIELDS = {name: frozenset(entry["fields"])
+                for name, entry in EVENT_REGISTRY.items()}
 
 SWEEP_STATUSES = ("start", "done", "skip")
 

@@ -69,9 +69,10 @@ class Recorder:
     Each line is flushed as written, so ``tail -f`` and post-crash reads
     see every event emitted so far — the telemetry exists precisely for
     runs that may not end cleanly. ``emit`` rejects unknown event types
-    at the call site (a typo'd emitter must fail its own tests, not
-    poison downstream streams); field content is the emitter's contract
-    with obs.events.EVENT_FIELDS, checked by ``obs_report.py --check``.
+    and missing core fields at the call site (a typo'd emitter must fail
+    its own tests, not poison downstream streams); the schema is
+    obs.events.EVENT_REGISTRY — the same registry graftlint rule G004
+    checks statically and ``obs_report.py --check`` applies to streams.
     """
 
     enabled = True
@@ -101,6 +102,11 @@ class Recorder:
             raise ValueError(f"unknown event type {event!r} "
                              f"(schema v{SCHEMA_VERSION}: "
                              f"{sorted(EVENT_FIELDS)})")
+        missing = EVENT_FIELDS[event] - fields.keys()
+        if missing:
+            raise ValueError(f"emit({event!r}): missing core field(s) "
+                             f"{sorted(missing)} (see obs/events.py "
+                             "EVENT_REGISTRY)")
         obj = {"v": SCHEMA_VERSION,
                "ts": time.time() if ts is None else float(ts),
                "event": event}

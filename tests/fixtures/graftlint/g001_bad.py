@@ -1,0 +1,15 @@
+"""G001 positive fixture: host syncs inside a traced context."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(state):
+    if state.energy > 0:          # python `if` on a traced value
+        state = state + 1
+    while state.min() < 0:        # python `while` on a traced value
+        state = state + 1
+    x = float(state)              # host conversion of a traced value
+    y = state.item()              # blocking device->host sync
+    z = np.asarray(state)         # host copy of a traced value
+    return x + y + z

@@ -1,0 +1,21 @@
+"""G001 negative fixture: static control flow and traced-safe ops."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(state, n: int):
+    if n > 2:                         # static: annotated python int
+        state = state + 1
+    if state.ndim == 2:               # static: array metadata
+        state = state.sum(axis=-1)
+    if state is None:                 # static: structural None test
+        return jnp.zeros(())
+    clipped = jnp.where(state > 0, state, 0.0)   # traced select, no sync
+    flag = bool(n)                    # bool() on a static value
+    return clipped if flag else -clipped
+
+
+def host_summary(res):
+    # not a traced context: host conversions are fine here
+    return float(res.mean())

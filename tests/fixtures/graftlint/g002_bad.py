@@ -1,0 +1,15 @@
+"""G002 positive fixture: PRNG key reuse."""
+import jax
+
+
+def sample(key):
+    a = jax.random.uniform(key)
+    b = jax.random.normal(key)    # straight-line reuse of a consumed key
+    return a + b
+
+
+def walk(key, n: int):
+    total = 0.0
+    for _ in range(n):
+        total = total + jax.random.uniform(key)   # cross-iteration reuse
+    return total

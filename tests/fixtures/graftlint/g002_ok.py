@@ -1,0 +1,24 @@
+"""G002 negative fixture: disciplined key splitting."""
+import jax
+
+
+def sample(key):
+    key, k1 = jax.random.split(key)
+    a = jax.random.uniform(k1)
+    key, k2 = jax.random.split(key)
+    b = jax.random.normal(k2)
+    return a + b
+
+
+def walk(key, n: int):
+    total = 0.0
+    for i in range(n):
+        key, sub = jax.random.split(key)    # re-split before each use
+        total = total + jax.random.uniform(sub)
+    return total
+
+
+def guarded(key, flag: bool):
+    if flag:
+        return jax.random.uniform(key)      # early return: no reuse below
+    return jax.random.normal(key)

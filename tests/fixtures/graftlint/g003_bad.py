@@ -1,0 +1,14 @@
+"""G003 positive fixture: treedef-unstable state fields."""
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class DemoState:
+    key: jnp.ndarray
+    board: jnp.ndarray
+    count: jnp.ndarray = None                     # default but not Optional
+    extra: Optional[jnp.ndarray] = 0              # Optional but non-None
+    tail: jnp.ndarray                             # non-default after default

@@ -1,0 +1,18 @@
+"""G003 negative fixture: trailing Optional-with-None fields only."""
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class DemoState:
+    key: jnp.ndarray
+    board: jnp.ndarray
+    h: int = struct.field(pytree_node=False, default=0)   # static, exempt
+    cut_times_se: Optional[jnp.ndarray] = None
+    reject_count: Optional[jnp.ndarray] = None
+
+
+class NotAState:
+    limit: int = 7          # unrelated class: out of scope

@@ -1,0 +1,8 @@
+"""G004 positive fixture: emit sites off the event registry."""
+
+
+def run(rec):
+    rec.emit("not_an_event", runner="general")        # unknown event type
+    rec.emit("run_start", runner="general")           # missing core fields
+    etype = "chunk"
+    rec.emit(etype, runner="general")                 # non-literal name

@@ -1,0 +1,10 @@
+"""G004 negative fixture: schema-conforming emit sites."""
+
+
+def run(rec):
+    rec.emit("run_start", runner="general", chains=4, n_steps=10, chunk=5)
+    rec.emit("error", message="boom", extra="extras are fine")
+    fields = {"what": "final_record", "bytes": 96}
+    rec.emit("transfer", **fields)    # splat: field coverage is dynamic
+    rec.emit("run_end", ts=0.0, runner="general", n_yields=10,
+             wall_s=0.1, flips_per_s=100.0)

@@ -1,0 +1,8 @@
+"""G005 positive fixture: unguarded obs traffic in a dispatching runner."""
+
+
+def run_segment(bg, spec, params, state, rec, mon):
+    state, outs = run_board_chunk(bg, spec, params, state, 100)
+    rec.emit("transfer", what="chunk", bytes=128)    # unguarded: runs on
+    mon.observe_chunk(outs=outs)                     # the NullRecorder path
+    return state

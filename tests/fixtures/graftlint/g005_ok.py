@@ -1,0 +1,18 @@
+"""G005 negative fixture: guarded obs traffic; deferred emitters exempt."""
+
+
+def run_segment(bg, spec, params, state, rec, mon):
+    state, outs = run_board_chunk(bg, spec, params, state, 100)
+    if rec:
+        rec.emit("transfer", what="chunk", bytes=128)
+        mon.observe_chunk(outs=outs)
+    if rec and mon is not None:
+        watch.poll(rec, chunk=100)
+    return state
+
+
+def _emit_chunks_after_sync(rec, metas):
+    # no device dispatch in this function: it runs after the run-end
+    # sync, so unguarded emits are fine (the caller already gated on rec)
+    for meta in metas:
+        rec.emit("transfer", what="history", bytes=meta)

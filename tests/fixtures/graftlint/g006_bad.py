@@ -1,0 +1,18 @@
+"""G006 positive fixture: heavy tests without the slow marker."""
+import jax
+
+
+def test_long_walk(dg, spec, params, states):
+    res = run_chains(dg, spec, params, states, n_steps=50000)
+    assert res is not None
+
+
+def test_device_sweep():
+    for dev in jax.devices():
+        assert dev is not None
+
+
+def test_bound_steps(dg, spec, params, states):
+    n_steps = 99999
+    res = run_chains(dg, spec, params, states, n_steps=n_steps)
+    assert res is not None

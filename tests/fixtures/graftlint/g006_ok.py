@@ -1,0 +1,20 @@
+"""G006 negative fixture: short tests, or marked slow."""
+import jax
+import pytest
+
+
+def test_short_walk(dg, spec, params, states):
+    res = run_chains(dg, spec, params, states, n_steps=200)
+    assert res is not None
+
+
+@pytest.mark.slow
+def test_long_walk(dg, spec, params, states):
+    res = run_chains(dg, spec, params, states, n_steps=50000)
+    assert res is not None
+
+
+@pytest.mark.slow
+def test_device_sweep():
+    for dev in jax.devices():
+        assert dev is not None

@@ -1,0 +1,187 @@
+"""Per-rule fixture tests for tools.graftlint (tier-1, host-only: no
+JAX work — the linter is pure stdlib ast)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.graftlint import LintConfig, RULES, lint_file
+from tools.graftlint.baseline import load_baseline, partition, write_baseline
+from tools.graftlint.engine import Pragmas, run_lint
+from tools.graftlint.findings import Finding
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
+
+RULE_IDS = [r.RULE_ID for r in RULES]
+
+
+def _lint_fixture(name, rule):
+    cfg = LintConfig(root=REPO, rules=frozenset({rule}))
+    return lint_file(os.path.join(FIXTURES, name), cfg)
+
+
+# ---- one positive + one negative fixture per rule ----------------------
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_rule_positive_fixture(rule):
+    findings = _lint_fixture(f"{rule.lower()}_bad.py", rule)
+    assert findings, f"{rule} found nothing in its positive fixture"
+    assert all(f.rule == rule for f in findings)
+
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_rule_negative_fixture(rule):
+    findings = _lint_fixture(f"{rule.lower()}_ok.py", rule)
+    assert findings == [], [f.render() for f in findings]
+
+
+# ---- specific findings the fixtures encode -----------------------------
+
+def test_g001_catches_each_hazard_kind():
+    msgs = "\n".join(f.message for f in _lint_fixture("g001_bad.py", "G001"))
+    for needle in ("`if`", "`while`", "float()", ".item()", "np.asarray"):
+        assert needle in msgs, f"missing hazard {needle!r}:\n{msgs}"
+
+
+def test_g002_catches_loop_and_straightline_reuse():
+    lines = sorted(f.line for f in _lint_fixture("g002_bad.py", "G002"))
+    assert len(lines) == 2  # one straight-line, one cross-iteration
+
+
+def test_g003_catches_each_contract_breach():
+    msgs = "\n".join(f.message for f in _lint_fixture("g003_bad.py", "G003"))
+    assert "must be annotated Optional" in msgs
+    assert "must default to None" in msgs
+    assert "must be trailing" in msgs
+
+
+def test_g004_catches_unknown_missing_and_dynamic():
+    msgs = "\n".join(f.message for f in _lint_fixture("g004_bad.py", "G004"))
+    assert "unknown event type 'not_an_event'" in msgs
+    assert "missing core field" in msgs
+    assert "string literal" in msgs
+
+
+def test_g006_threshold_is_configurable():
+    cfg = LintConfig(root=REPO, rules=frozenset({"G006"}),
+                     max_test_steps=100000)
+    loosened = lint_file(os.path.join(FIXTURES, "g006_bad.py"), cfg)
+    # only the device loop survives a loosened step threshold
+    assert [("devices" in f.message) for f in loosened] == [True]
+
+
+# ---- pragmas -----------------------------------------------------------
+
+def test_disable_pragma_suppresses_same_line(tmp_path):
+    src = ("import jax\n\n"
+           "@jax.jit\n"
+           "def f(state):\n"
+           "    return float(state)  # graftlint: disable=G001(host probe)\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    cfg = LintConfig(root=str(tmp_path), rules=frozenset({"G001"}))
+    assert lint_file(str(p), cfg) == []
+
+
+def test_disable_pragma_on_preceding_comment_line(tmp_path):
+    src = ("import jax\n\n"
+           "@jax.jit\n"
+           "def f(state):\n"
+           "    # graftlint: disable=G001(intentional sync)\n"
+           "    return float(state)\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    cfg = LintConfig(root=str(tmp_path), rules=frozenset({"G001"}))
+    assert lint_file(str(p), cfg) == []
+
+
+def test_pragma_does_not_leak_to_other_rules_or_lines(tmp_path):
+    src = ("import jax\n\n"
+           "@jax.jit\n"
+           "def f(state):\n"
+           "    x = float(state)  # graftlint: disable=G002(wrong rule)\n"
+           "    return x\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    cfg = LintConfig(root=str(tmp_path), rules=frozenset({"G001"}))
+    assert len(lint_file(str(p), cfg)) == 1
+
+
+def test_traced_pragma_marks_cross_module_kernels(tmp_path):
+    body = ("def kernel(state):\n"
+            "    return float(state)\n")
+    p = tmp_path / "mod.py"
+    p.write_text("# graftlint: traced\n" + body)
+    cfg = LintConfig(root=str(tmp_path), rules=frozenset({"G001"}))
+    assert len(lint_file(str(p), cfg)) == 1
+    # without the marker the same function is host code: clean
+    p2 = tmp_path / "mod2.py"
+    p2.write_text(body)
+    assert lint_file(str(p2), cfg) == []
+
+
+def test_pragma_reasons_are_recorded():
+    pr = Pragmas(["x = 1  # graftlint: disable=G001(why not)"])
+    assert pr.suppressed("G001", 1)
+    assert not pr.suppressed("G002", 1)
+    assert pr.reasons[(1, "G001")] == "why not"
+
+
+# ---- baseline workflow -------------------------------------------------
+
+def test_baseline_roundtrip_and_partition(tmp_path):
+    f1 = Finding("G001", "a.py", 3, 0, "msg one", snippet="x = float(y)")
+    f2 = Finding("G002", "b.py", 9, 4, "msg two", snippet="u(key)")
+    path = tmp_path / "base.json"
+    write_baseline(str(path), [f1])
+    fps = load_baseline(str(path))
+    assert fps == {f1.fingerprint}
+    new, old = partition([f1, f2], fps)
+    assert new == [f2] and old == [f1]
+
+
+def test_fingerprint_stable_across_line_shift():
+    a = Finding("G001", "a.py", 3, 0, "m", snippet="x = float(y)")
+    b = Finding("G001", "a.py", 300, 7, "m", snippet="x = float(y)")
+    assert a.fingerprint == b.fingerprint
+
+
+def test_fixture_dirs_excluded_from_walks():
+    findings = run_lint([os.path.join(REPO, "tests")],
+                        LintConfig(root=REPO))
+    assert not any("fixtures/graftlint" in f.path for f in findings)
+
+
+# ---- CLI ---------------------------------------------------------------
+
+def _cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "tools.graftlint", *args],
+                          cwd=cwd, capture_output=True, text=True)
+
+
+def test_cli_nonzero_on_fixture_violation():
+    res = _cli([os.path.join(FIXTURES, "g003_bad.py")])
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "G003" in res.stdout
+
+
+def test_cli_json_format():
+    res = _cli(["--format", "json", os.path.join(FIXTURES, "g003_bad.py")])
+    assert res.returncode == 1
+    doc = json.loads(res.stdout)
+    assert doc["counts"]["new"] >= 1
+    assert all(f["rule"] == "G003" for f in doc["new"])
+
+
+def test_cli_baseline_grandfathers(tmp_path):
+    fixture = os.path.join(FIXTURES, "g003_bad.py")
+    base = tmp_path / "base.json"
+    res = _cli(["--baseline", str(base), "--write-baseline", fixture])
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = _cli(["--baseline", str(base), fixture])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "baselined" in res.stdout

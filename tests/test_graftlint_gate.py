@@ -1,0 +1,63 @@
+"""End-to-end graftlint gate (tier-1, `not slow`): the real package must
+lint clean against the committed baseline, and the gate must actually
+bite when a violation is introduced. Mirrors the ROADMAP verify flow —
+this is the test that makes the contracts in PROFILE.md enforceable."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
+
+
+def _cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "tools.graftlint", *args],
+                          cwd=cwd, capture_output=True, text=True)
+
+
+def test_repo_lints_clean():
+    """The acceptance-criteria invocation: zero non-baselined findings
+    over the shipped package + tools."""
+    res = _cli(["flipcomplexityempirical_tpu", "tools"])
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_committed_baseline_is_empty():
+    """Violations are fixed or pragma'd, never grandfathered: the
+    committed baseline must stay empty (obs_report --check prints this
+    count so drift is visible)."""
+    with open(os.path.join(REPO, "graftlint_baseline.json"),
+              encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["findings"] == []
+
+
+def test_gate_bites_on_injected_violation(tmp_path):
+    """Copy the package skeleton, inject one single-rule fixture
+    violation into kernel/, and the same invocation must exit nonzero."""
+    pkg = tmp_path / "flipcomplexityempirical_tpu"
+    (pkg / "kernel").mkdir(parents=True)
+    obs_dir = pkg / "obs"
+    obs_dir.mkdir()
+    shutil.copy(os.path.join(REPO, "flipcomplexityempirical_tpu", "obs",
+                             "events.py"), obs_dir / "events.py")
+    shutil.copy(os.path.join(FIXTURES, "g001_bad.py"),
+                pkg / "kernel" / "hot.py")
+    res = _cli(["--root", str(tmp_path), str(pkg)])
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "G001" in res.stdout
+
+
+def test_obs_report_check_surfaces_baseline_count(tmp_path):
+    stream = tmp_path / "events.jsonl"
+    stream.write_text('{"v": 1, "ts": 1.0, "event": "error", '
+                      '"message": "x"}\n')
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--check", str(stream)],
+        cwd=REPO, capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "graftlint baseline: 0 grandfathered" in res.stdout

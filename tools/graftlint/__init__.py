@@ -1,0 +1,45 @@
+"""graftlint: JAX-aware static analysis for this repo's hot-path contracts.
+
+The flip-walk throughput story (PROFILE.md) rests on conventions that no
+runtime test can cheaply police: runners only sync at chunk boundaries,
+kernel state pytrees grow only via trailing ``Optional`` fields, every
+telemetry event matches ``obs/events.py``, and the ``NullRecorder`` path
+stays byte-identical. graftlint turns those review-enforced conventions
+into a stdlib-``ast`` gate that fails before anything compiles:
+
+- G001 host-sync-hazard: ``float()``/``int()``/``bool()``/``.item()``/
+  ``np.asarray`` on traced values, and ``if``/``while`` on array
+  expressions, inside jit/scan bodies in ``kernel/`` and ``sampling/``.
+- G002 prng-reuse: a PRNG key consumed twice (or consumed inside a loop)
+  without an intervening ``jax.random.split``/``fold_in``.
+- G003 treedef-stability: new ``ChainState``/``BoardState`` fields must
+  be trailing ``Optional`` with a ``None`` default (checkpoint/jit-cache
+  compatibility, PR 3's contract).
+- G004 event-schema: every ``.emit("<type>", ...)`` call site names an
+  event type and covers the core fields declared in
+  ``obs/events.py::EVENT_REGISTRY`` (one source of truth for the static
+  and the runtime validator).
+- G005 recorder-purity: recorder/monitor/watch traffic in the sampling
+  runners must be guarded on recorder truthiness, so the NullRecorder
+  path does no extra host work between device dispatch and the runner's
+  existing sync point.
+- G006 pytest-hygiene: tests driving > ``max_test_steps`` chain steps or
+  looping over devices must carry ``@pytest.mark.slow``.
+
+Usage::
+
+    python -m tools.graftlint [--format text|json]
+        [--baseline graftlint_baseline.json] [--write-baseline] paths...
+
+Exit status is nonzero iff any non-baselined finding remains. Intentional
+host-side code carries ``# graftlint: disable=G001(<reason>)`` pragmas;
+``# graftlint: traced`` marks a function as a traced context when the
+jit/scan seeding cannot see it (e.g. kernels entered via cross-module
+``vmap``). No dependencies beyond the stdlib.
+"""
+
+from .engine import LintConfig, lint_file, run_lint  # noqa: F401
+from .findings import Finding  # noqa: F401
+from .rules import RULES  # noqa: F401
+
+__version__ = "1.0"

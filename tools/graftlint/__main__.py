@@ -1,0 +1,63 @@
+"""CLI entry point: ``python -m tools.graftlint [opts] paths...``"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .baseline import (DEFAULT_BASELINE, load_baseline, partition,
+                       write_baseline)
+from .engine import LintConfig, run_lint
+from .reporter import render_json, render_text
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX-aware static analysis for this repo's tracing, "
+                    "sync, RNG, and event-schema contracts.")
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline JSON of grandfathered fingerprints "
+                         f"(default: ./{DEFAULT_BASELINE} if present)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings to the baseline file "
+                         "and exit 0")
+    ap.add_argument("--root", default=".",
+                    help="repo root for relative paths (default: cwd)")
+    args = ap.parse_args(argv)
+
+    config = LintConfig(root=args.root)
+    findings = run_lint(args.paths, config)
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        candidate = os.path.join(args.root, DEFAULT_BASELINE)
+        if os.path.exists(candidate):
+            baseline_path = candidate
+
+    if args.write_baseline:
+        path = baseline_path or os.path.join(args.root, DEFAULT_BASELINE)
+        write_baseline(path, findings)
+        print(f"graftlint: wrote {len(findings)} fingerprint(s) to {path}")
+        return 0
+
+    baseline = set()
+    if baseline_path is not None:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"graftlint: bad baseline {baseline_path}: {exc}",
+                  file=sys.stderr)
+            return 2
+
+    new, grandfathered = partition(findings, baseline)
+    render = render_json if args.format == "json" else render_text
+    print(render(new, grandfathered))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

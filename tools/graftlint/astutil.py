@@ -1,0 +1,341 @@
+"""Shared AST machinery: call-name resolution, traced-context seeding,
+and the trace-time staticness evaluator the rules lean on.
+
+Everything here is heuristic in the way a linter must be: the goal is
+zero false negatives on the contract patterns this repo actually uses
+(documented per rule) with false positives rare enough that a
+``# graftlint: disable=...`` pragma per intentional exception is cheap.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+
+def walk_with_parents(tree: ast.AST) -> None:
+    """Annotate every node with ``._gl_parent`` (None at the root)."""
+    tree._gl_parent = None  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._gl_parent = node  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "_gl_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_gl_parent", None)
+
+
+def terminal_name(func: ast.AST) -> Optional[str]:
+    """The rightmost name of a call target: ``jax.lax.scan`` -> "scan",
+    ``split`` -> "split". None for computed targets."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Full dotted path when the expression is a plain name chain
+    (``jax.lax.while_loop``), else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost name of an attribute/subscript chain (``state.key`` ->
+    "state")."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Traced-context detection
+# ---------------------------------------------------------------------------
+
+# Calling one of these hands the callee to the tracer: any function (or
+# lambda) passed by name is a traced context.
+TRACING_COMBINATORS = frozenset({
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan",
+    "vmap", "pmap", "map", "grad", "value_and_grad", "checkpoint", "remat",
+    "pallas_call", "custom_vjp", "custom_jvp", "shard_map",
+})
+
+TRACED_MARK = "traced"  # "# graftlint: traced" pragma key
+
+
+def _jit_decorator(dec: ast.AST) -> bool:
+    """True for ``@jax.jit``, ``@jit``, ``@functools.partial(jax.jit,
+    ...)``, ``@partial(jit, ...)``."""
+    if isinstance(dec, ast.Call):
+        if terminal_name(dec.func) == "partial" and dec.args:
+            return _jit_decorator(dec.args[0])
+        return terminal_name(dec.func) == "jit"
+    return terminal_name(dec) == "jit"
+
+
+def jit_static_argnames(dec: ast.AST) -> frozenset:
+    """static_argnames/static_argnums is unavailable positionally here;
+    pull the names from a partial(jax.jit, static_argnames=(...))
+    decorator so G001 treats those parameters as trace-static."""
+    if not (isinstance(dec, ast.Call)
+            and terminal_name(dec.func) == "partial"):
+        return frozenset()
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            names = []
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value,
+                                                                str):
+                    names.append(elt.value)
+            return frozenset(names)
+    return frozenset()
+
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def collect_traced_functions(tree: ast.AST, marked_lines=frozenset()):
+    """The set of function/lambda nodes considered traced contexts.
+
+    Seeds: jit-decorated defs, defs/lambdas passed (by name or inline)
+    to a tracing combinator, and defs whose ``def`` line carries a
+    ``# graftlint: traced`` pragma (``marked_lines``). Propagation:
+    lexically nested defs, and same-module call closure (a traced
+    function calling module-level ``f`` by bare name makes ``f``
+    traced). Cross-module calls are invisible by design — mark the
+    entry point with the pragma instead.
+    """
+    walk_with_parents(tree)
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    all_funcs = []
+    for node in ast.walk(tree):
+        if isinstance(node, FuncNode):
+            all_funcs.append(node)
+            if not isinstance(node, ast.Lambda):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+    traced: set[ast.AST] = set()
+    for fn in all_funcs:
+        if not isinstance(fn, ast.Lambda):
+            if any(_jit_decorator(d) for d in fn.decorator_list):
+                traced.add(fn)
+            if fn.lineno in marked_lines:
+                traced.add(fn)
+
+    # names / lambdas handed to combinators anywhere in the module
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node.func) not in TRACING_COMBINATORS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                traced.add(arg)
+            elif isinstance(arg, ast.Name):
+                for d in defs_by_name.get(arg.id, ()):
+                    traced.add(d)
+
+    # fixpoint: nesting + same-module bare-name calls
+    changed = True
+    while changed:
+        changed = False
+        for fn in all_funcs:
+            if fn in traced:
+                continue
+            if any(p in traced for p in parents(fn)):
+                traced.add(fn)
+                changed = True
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)):
+                    for d in defs_by_name.get(node.func.id, ()):
+                        # only module-level helpers: a local def is
+                        # already covered by the nesting rule
+                        if d not in traced and isinstance(
+                                getattr(d, "_gl_parent", None), ast.Module):
+                            traced.add(d)
+                            changed = True
+    return traced
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for p in parents(node):
+        if isinstance(p, FuncNode):
+            return p
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Trace-time staticness evaluation (G001's core)
+# ---------------------------------------------------------------------------
+
+# Annotations that make a parameter trace-static (python values baked
+# into the compiled graph) vs traced arrays.
+STATIC_ANNOTATIONS = frozenset({
+    "int", "float", "bool", "str", "tuple", "Spec", "StencilSpec",
+})
+
+# Attribute names that are static regardless of their base: array
+# metadata, and this repo's struct.field(pytree_node=False) fields on
+# BoardGraph / the hashable Spec config (kernel/board.py, kernel/step.py).
+STATIC_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size",
+    # Spec (frozen dataclass, jit cache key)
+    "n_districts", "proposal", "contiguity", "invalid", "accept", "anneal",
+    "frame_interface", "weighted_cut", "max_tries", "propose_parallel",
+    "record_interface", "parity_metrics", "geom_waits",
+    "record_assignment_bits",
+    # BoardGraph static fields + derived int properties
+    "h", "w", "uniform_pop", "surgical", "real_nodes", "b2_offsets",
+    "b2_iters", "patch_exact", "iface_ok", "iface_decode", "center",
+    "n", "n_real", "n_nodes", "n_edges",
+})
+
+# Call names whose results are trace-static. Split by call shape:
+# ``min(a, b)`` (bare builtin over python ints) is static, but
+# ``state.min()`` (array method reduction) is traced — the bare set must
+# not whitelist attribute calls.
+STATIC_CALLS = frozenset({
+    "len", "isinstance", "max", "min", "range", "tuple", "zip", "enumerate",
+    "getattr", "hasattr", "abs", "int", "float", "bool", "str", "sorted",
+    "supported", "supported_pair", "geom_denom_finite", "kstep_geom_ok",
+    "n_words", "field",
+})
+# attribute calls: host predicates over static config + python int methods
+STATIC_ATTR_CALLS = frozenset({
+    "bit_length", "n_words", "supported", "supported_pair",
+    "geom_denom_finite", "kstep_geom_ok", "field", "get", "keys", "values",
+    "items",
+})
+
+
+def _annotation_name(ann: Optional[ast.AST]) -> Optional[str]:
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Subscript):  # Optional[X] -> look at X? no: name
+        return _annotation_name(ann.value)
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split("[")[0].split(".")[-1]
+    name = dotted_name(ann)
+    return name.split(".")[-1] if name else None
+
+
+class StaticEnv:
+    """Per-function map of local names to trace-time staticness.
+
+    Built in one forward pass over the function body: parameters are
+    classified by annotation (static python types vs traced pytrees) or
+    by a jit decorator's ``static_argnames``; single-assignment locals
+    inherit the staticness of their right-hand side. Names never
+    assigned in the function (globals, builtins, module imports) are
+    static. Assign-once is not verified — a rebinding simply overwrites,
+    matching forward program order, which is what the rules evaluate
+    under.
+    """
+
+    def __init__(self, fn: ast.AST):
+        self.known: dict[str, bool] = {}
+        static_params = frozenset()
+        if not isinstance(fn, ast.Lambda):
+            for dec in fn.decorator_list:
+                static_params |= jit_static_argnames(dec)
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            ann = _annotation_name(getattr(a, "annotation", None))
+            self.known[a.arg] = (a.arg in static_params
+                                 or ann in STATIC_ANNOTATIONS)
+        self._locals = set(self.known)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Store):
+                self._locals.add(node.id)
+
+    def bind(self, target: ast.AST, static: bool) -> None:
+        if isinstance(target, ast.Name):
+            self.known[target.id] = static
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.bind(elt, static)
+        # attribute/subscript stores don't change name staticness
+
+    def is_static(self, node: ast.AST) -> bool:
+        """Conservative: True only when the expression is certainly
+        trace-static; anything unknown is treated as traced."""
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            if node.id in self.known:
+                return self.known[node.id]
+            # not a local: module global / import / builtin
+            return node.id not in self._locals
+        if isinstance(node, ast.Attribute):
+            if self.is_static(node.value):
+                return True
+            return node.attr in STATIC_ATTRS
+        if isinstance(node, ast.Call):
+            name = terminal_name(node.func)
+            if isinstance(node.func, ast.Name):
+                return name in STATIC_CALLS
+            return name in STATIC_ATTR_CALLS
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is a structural (treedef)
+            # test — static no matter what x is
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) \
+                    and all(isinstance(c, ast.Constant) and c.value is None
+                            for c in node.comparators):
+                return True
+            return (self.is_static(node.left)
+                    and all(self.is_static(c) for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return all(self.is_static(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self.is_static(node.left) and self.is_static(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_static(node.operand)
+        if isinstance(node, ast.IfExp):
+            return (self.is_static(node.test) and self.is_static(node.body)
+                    and self.is_static(node.orelse))
+        if isinstance(node, ast.Subscript):
+            return self.is_static(node.value) and self.is_static(node.slice)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return all(self.is_static(e) for e in node.elts)
+        if isinstance(node, ast.JoinedStr):
+            return True
+        if isinstance(node, ast.Starred):
+            return self.is_static(node.value)
+        return False
+
+    def fold_statement(self, stmt: ast.AST) -> None:
+        """Update name staticness for one statement (forward order)."""
+        if isinstance(stmt, ast.Assign):
+            static = self.is_static(stmt.value)
+            for t in stmt.targets:
+                if (isinstance(t, (ast.Tuple, ast.List))
+                        and isinstance(stmt.value, (ast.Tuple, ast.List))
+                        and len(t.elts) == len(stmt.value.elts)):
+                    for te, ve in zip(t.elts, stmt.value.elts):
+                        self.bind(te, self.is_static(ve))
+                else:
+                    self.bind(t, static)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.bind(stmt.target, self.is_static(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            self.bind(stmt.target, self.is_static(stmt.value)
+                      and self.is_static(stmt.target))

@@ -1,0 +1,48 @@
+"""Baseline file: grandfathered finding fingerprints.
+
+The baseline is a JSON document committed at the repo root. Findings
+whose fingerprint appears in it are reported as grandfathered and do not
+fail the gate; everything else does. Fingerprints hash the rule, file,
+and offending line text — not line numbers — so unrelated edits don't
+churn the file.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Tuple
+
+from .findings import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "graftlint_baseline.json"
+
+
+def load_baseline(path: str) -> set:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{doc.get('version')!r}")
+    return {e["fingerprint"] for e in doc.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    entries = sorted(
+        ({"fingerprint": f.fingerprint, "rule": f.rule, "path": f.path,
+          "message": f.message} for f in findings),
+        key=lambda e: (e["path"], e["rule"], e["fingerprint"]))
+    doc = {"version": BASELINE_VERSION, "tool": "graftlint",
+           "findings": entries}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def partition(findings: Iterable[Finding], baseline: set
+              ) -> Tuple[List[Finding], List[Finding]]:
+    """Split into (new, grandfathered)."""
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint in baseline else new).append(f)
+    return new, old

@@ -1,0 +1,180 @@
+"""Rule engine: file discovery, pragma handling, and rule dispatch."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Iterator, List, Optional
+
+from .findings import Finding
+
+# ``# graftlint: disable=G001(reason),G002`` — reasons are free text in
+# balanced-paren-free parens; ``# graftlint: traced`` marks the next (or
+# same) line's ``def`` as a traced context.
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*(disable=([^#]*)|traced(?:\s*\([^)]*\))?)\s*$")
+_RULE_TOKEN_RE = re.compile(r"(G\d{3}|all)(?:\(([^)]*)\))?")
+
+# Directory names never linted when walking (fixtures are deliberately
+# violating sources; lint_file() bypasses this filter).
+EXCLUDED_DIRS = frozenset({"__pycache__", ".git", "fixtures", ".venv",
+                           "build", "dist"})
+
+
+@dataclasses.dataclass
+class LintConfig:
+    root: str = "."                  # repo root; paths reported relative to it
+    max_test_steps: int = 5000       # G006: unmarked tests may step <= this
+    rules: Optional[frozenset] = None  # restrict to these rule ids (tests)
+
+
+class Pragmas:
+    """Per-file suppression map parsed from ``# graftlint:`` comments.
+
+    A ``disable=`` pragma suppresses the named rules on its own line; on
+    a comment-only line it suppresses them on the next non-blank source
+    line instead. ``traced`` marks the next/same line for the traced-
+    context seeder.
+    """
+
+    def __init__(self, source_lines: List[str]):
+        self._disabled: dict = {}     # lineno -> set of rule ids / {"all"}
+        self.reasons: dict = {}       # (lineno, rule) -> reason text
+        self.traced_lines: set = set()
+        pending: List[tuple] = []     # comment-only pragmas awaiting code
+        pending_traced = False
+        for i, raw in enumerate(source_lines, start=1):
+            stripped = raw.strip()
+            m = _PRAGMA_RE.search(raw)
+            comment_only = stripped.startswith("#")
+            code_line = bool(stripped) and not comment_only
+            if code_line:
+                for rule, reason in pending:
+                    self._disabled.setdefault(i, set()).add(rule)
+                    if reason:
+                        self.reasons[(i, rule)] = reason
+                pending = []
+                if pending_traced:
+                    self.traced_lines.add(i)
+                    pending_traced = False
+            if not m:
+                continue
+            if m.group(1).startswith("traced"):
+                if comment_only:
+                    pending_traced = True
+                else:
+                    self.traced_lines.add(i)
+                continue
+            for rm in _RULE_TOKEN_RE.finditer(m.group(2) or ""):
+                rule, reason = rm.group(1), (rm.group(2) or "").strip()
+                if comment_only:
+                    pending.append((rule, reason))
+                else:
+                    self._disabled.setdefault(i, set()).add(rule)
+                    if reason:
+                        self.reasons[(i, rule)] = reason
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        active = self._disabled.get(line, ())
+        return rule in active or "all" in active
+
+
+class ParsedModule:
+    """One source file, parsed once and shared by every rule."""
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.path = relpath                       # posix, repo-relative
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.pragmas = Pragmas(self.lines)
+        self._traced = None
+
+    @property
+    def is_test(self) -> bool:
+        base = os.path.basename(self.path)
+        return base.startswith("test_") or base == "conftest.py"
+
+    @property
+    def traced_functions(self):
+        if self._traced is None:
+            from .astutil import collect_traced_functions
+            self._traced = collect_traced_functions(
+                self.tree, frozenset(self.pragmas.traced_lines))
+        return self._traced
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=rule, path=self.path, line=line,
+                       col=getattr(node, "col_offset", 0), message=message,
+                       snippet=self.snippet(line))
+
+
+def _relpath(path: str, root: str) -> str:
+    try:
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    except ValueError:
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def iter_py_files(paths: Iterable[str], root: str) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in EXCLUDED_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_file(path: str, config: Optional[LintConfig] = None
+              ) -> List[Finding]:
+    """Lint one file, bypassing directory exclusions (used on fixtures)."""
+    from .rules import RULES
+    config = config or LintConfig()
+    relpath = _relpath(path, config.root)
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        module = ParsedModule(os.path.abspath(path), relpath, source)
+    except SyntaxError as exc:
+        return [Finding(rule="G000", path=relpath,
+                        line=exc.lineno or 1, col=(exc.offset or 1) - 1,
+                        message=f"syntax error: {exc.msg}")]
+    findings: List[Finding] = []
+    for rule in RULES:
+        if config.rules is not None:
+            # explicit rule selection (fixture tests) bypasses the
+            # path-scoping in applies()
+            if rule.RULE_ID not in config.rules:
+                continue
+        elif not rule.applies(module):
+            continue
+        for f in rule.check(module, config):
+            if not module.pragmas.suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def run_lint(paths: Iterable[str], config: Optional[LintConfig] = None
+             ) -> List[Finding]:
+    config = config or LintConfig()
+    findings: List[Finding] = []
+    for path in iter_py_files(paths, config.root):
+        findings.extend(lint_file(path, config))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,35 @@
+"""Finding record + content-based fingerprint (baseline identity)."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str        # "G001"
+    path: str        # repo-relative posix path
+    line: int        # 1-based
+    col: int         # 0-based
+    message: str
+    snippet: str = ""  # stripped source line, for fingerprint stability
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable across line-number shifts: hashes the rule, the file,
+        and the offending line's stripped text (plus the message, so two
+        distinct findings on one line stay distinct)."""
+        h = hashlib.sha1()
+        h.update(f"{self.rule}|{self.path}|{self.snippet}|{self.message}"
+                 .encode("utf-8"))
+        return h.hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: " \
+               f"{self.rule} {self.message}"

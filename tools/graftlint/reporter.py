@@ -1,0 +1,33 @@
+"""Text and JSON rendering of lint results."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from .findings import Finding
+
+
+def render_text(new: List[Finding], grandfathered: List[Finding]) -> str:
+    out = []
+    for f in new:
+        out.append(f.render())
+    for f in grandfathered:
+        out.append(f"{f.render()} [baselined]")
+    n_new, n_old = len(new), len(grandfathered)
+    if n_new or n_old:
+        out.append(f"graftlint: {n_new} finding(s)"
+                   + (f", {n_old} baselined" if n_old else ""))
+    else:
+        out.append("graftlint: clean")
+    return "\n".join(out)
+
+
+def render_json(new: List[Finding], grandfathered: List[Finding]) -> str:
+    doc = {
+        "tool": "graftlint",
+        "new": [f.to_dict() for f in new],
+        "baselined": [f.to_dict() for f in grandfathered],
+        "counts": {"new": len(new), "baselined": len(grandfathered)},
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

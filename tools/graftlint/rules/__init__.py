@@ -1,0 +1,16 @@
+"""Rule registry. Each rule module exposes:
+
+- ``RULE_ID``: "Gnnn"
+- ``applies(module) -> bool``: path scoping (bypassed when a LintConfig
+  selects rules explicitly, so fixtures outside the scoped trees still
+  exercise the rule)
+- ``check(module, config) -> list[Finding]``
+"""
+
+from . import (g001_host_sync, g002_prng, g003_treedef, g004_events,
+               g005_recorder, g006_pytest)
+
+RULES = (g001_host_sync, g002_prng, g003_treedef, g004_events,
+         g005_recorder, g006_pytest)
+
+RULE_IDS = tuple(r.RULE_ID for r in RULES)

@@ -1,0 +1,158 @@
+"""G001: host-sync hazards inside traced (jit/scan/vmap) contexts.
+
+Inside a traced function in ``kernel/`` or ``sampling/``, flag:
+
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` on a value that is not
+  trace-static (a ConcretizationError at trace time, or — worse — a
+  silent device sync if the value is already concrete on some paths);
+- ``x.item()`` and ``np.asarray(x)`` / ``np.array(x)`` /
+  ``jax.device_get(x)`` / ``x.block_until_ready()`` on non-static
+  values (always a blocking device->host copy);
+- ``if`` / ``while`` whose test is not trace-static (python control
+  flow on an array expression cannot be traced).
+
+Staticness follows astutil.StaticEnv: constants, annotated python-typed
+params, ``static_argnames``, ``is None`` tests, array metadata, and this
+repo's ``pytree_node=False`` config attributes are static; everything
+else is assumed traced.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import (FuncNode, StaticEnv, dotted_name, parents,
+                       terminal_name)
+
+RULE_ID = "G001"
+
+_CONVERTERS = frozenset({"float", "int", "bool", "complex"})
+_NP_ROOTS = frozenset({"np", "numpy", "onp"})
+_NP_COPIES = frozenset({"asarray", "array", "device_get"})
+_SYNC_METHODS = frozenset({"item", "block_until_ready", "tolist"})
+
+
+def applies(module) -> bool:
+    if module.is_test:
+        return False
+    return "kernel/" in module.path or "sampling/" in module.path
+
+
+def _outermost_traced(module):
+    traced = module.traced_functions
+    for fn in traced:
+        if not any(p in traced for p in parents(fn)):
+            yield fn
+
+
+def _child_env(env, fn):
+    child = StaticEnv(fn)
+    for name, static in env.known.items():
+        if name not in child.known and name not in child._locals:
+            child.known[name] = static
+    child._locals |= env._locals
+    return child
+
+
+class _Checker:
+    def __init__(self, module, findings):
+        self.module = module
+        self.findings = findings
+
+    def report(self, node, message):
+        self.findings.append(self.module.finding(RULE_ID, node, message))
+
+    # -- expression scan (conversions / syncs), skipping nested funcs --
+
+    def scan_expr(self, node, env):
+        if isinstance(node, FuncNode):
+            self.check_function(node, _child_env(env, node))
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node, env)
+        for child in ast.iter_child_nodes(node):
+            self.scan_expr(child, env)
+
+    def _check_call(self, call, env):
+        name = terminal_name(call.func)
+        args_static = all(env.is_static(a) for a in call.args)
+        if (isinstance(call.func, ast.Name) and name in _CONVERTERS
+                and call.args and not args_static):
+            self.report(call, f"{name}() on a traced value forces a host "
+                            "sync inside a traced context")
+            return
+        if name in _SYNC_METHODS and isinstance(call.func, ast.Attribute):
+            if not env.is_static(call.func.value):
+                self.report(call, f".{name}() on a traced value forces a "
+                                "device sync inside a traced context")
+            return
+        dn = dotted_name(call.func) or ""
+        root = dn.split(".")[0] if dn else None
+        if name in _NP_COPIES and call.args and not args_static:
+            if root in _NP_ROOTS or dn == "jax.device_get" \
+                    or name == "device_get":
+                self.report(call, f"{dn}() copies a traced value to host "
+                                "inside a traced context")
+
+    # -- statement walk (forward order, folding staticness) ------------
+
+    def check_body(self, stmts, env):
+        for stmt in stmts:
+            self.check_stmt(stmt, env)
+            env.fold_statement(stmt)
+
+    def check_stmt(self, stmt, env):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.check_function(stmt, _child_env(env, stmt))
+            return
+        if isinstance(stmt, ast.If):
+            if not env.is_static(stmt.test):
+                self.report(stmt, "`if` on a traced value inside a traced "
+                                "context (use lax.cond/jnp.where)")
+            self.scan_expr(stmt.test, env)
+            self.check_body(stmt.body, env)
+            self.check_body(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.While):
+            if not env.is_static(stmt.test):
+                self.report(stmt, "`while` on a traced value inside a "
+                                "traced context (use lax.while_loop)")
+            self.scan_expr(stmt.test, env)
+            self.check_body(stmt.body, env)
+            self.check_body(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.For):
+            self.scan_expr(stmt.iter, env)
+            env.bind(stmt.target, env.is_static(stmt.iter))
+            self.check_body(stmt.body, env)
+            self.check_body(stmt.orelse, env)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.scan_expr(item.context_expr, env)
+            self.check_body(stmt.body, env)
+            return
+        if isinstance(stmt, ast.Try):
+            self.check_body(stmt.body, env)
+            for h in stmt.handlers:
+                self.check_body(h.body, env)
+            self.check_body(stmt.orelse, env)
+            self.check_body(stmt.finalbody, env)
+            return
+        # simple statement: scan all contained expressions
+        for child in ast.iter_child_nodes(stmt):
+            self.scan_expr(child, env)
+
+    def check_function(self, fn, env):
+        if isinstance(fn, ast.Lambda):
+            self.scan_expr(fn.body, env)
+        else:
+            self.check_body(fn.body, env)
+
+
+def check(module, config):
+    findings = []
+    checker = _Checker(module, findings)
+    for fn in _outermost_traced(module):
+        checker.check_function(fn, StaticEnv(fn))
+    return findings

@@ -1,0 +1,221 @@
+"""G002: PRNG key discipline.
+
+A key value may be consumed (passed bare to a call) at most once; the
+next use must come from a fresh binding via ``jax.random.split`` /
+``fold_in`` / ``PRNGKey`` (or this repo's ``_split4``). Two patterns
+are flagged, per function, in forward program order:
+
+- straight-line reuse: ``a = random.uniform(key); b = random.normal(key)``;
+- loop reuse: a key consumed inside a ``for``/``while`` body that is not
+  rebound from a key-maker before the body repeats (the body is replayed
+  once with the first pass's exit state to catch cross-iteration reuse).
+
+Only bare ``Name`` arguments count as consumption — keys riding inside
+carry tuples (``lax.scan`` carries) or subscripted key batches
+(``keys[i]``) are not consumptions, which keeps the rule quiet on the
+repo's carry-threading style.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import FuncNode, terminal_name
+
+RULE_ID = "G002"
+
+KEY_MAKERS = frozenset({"split", "fold_in", "PRNGKey", "key",
+                        "wrap_key_data", "_split4", "split4"})
+# calls a key may be passed to any number of times (making fresh keys,
+# or pure metadata)
+_NONCONSUMING = frozenset({"len", "isinstance", "print", "repr", "type",
+                           "key_data", "unwrap"})
+
+
+def applies(module) -> bool:
+    return not module.is_test
+
+
+def _keyish_param(arg: ast.arg) -> bool:
+    """JAX key params by name ("key", "kprop", "init_key"...). Stateful
+    host RNGs (``rng: np.random.Generator``) are mutable and reusable —
+    not keys."""
+    if "key" not in arg.arg:
+        return False
+    ann = arg.annotation
+    if ann is not None:
+        for n in ast.walk(ann):
+            if isinstance(n, ast.Attribute) and n.attr == "Generator":
+                return False
+    return True
+
+
+def _terminates(body) -> bool:
+    """The branch body unconditionally leaves the enclosing suite."""
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                              ast.Continue)) for s in body)
+
+
+class _KeyTracker:
+    def __init__(self, module, findings):
+        self.module = module
+        self.findings = findings
+        self.reported = set()      # (name, lineno) dedupe across replays
+
+    def run(self, fn):
+        state = {}
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            if _keyish_param(a):
+                state[a.arg] = "fresh"
+        body = fn.body if not isinstance(fn, ast.Lambda) else [
+            ast.Expr(value=fn.body)]
+        self.walk_body(body, state)
+
+    # -- state ops -----------------------------------------------------
+
+    def _consume(self, name_node, state):
+        name = name_node.id
+        if name not in state:
+            return
+        if state[name] == "consumed":
+            key = (name, name_node.lineno)
+            if key not in self.reported:
+                self.reported.add(key)
+                self.findings.append(self.module.finding(
+                    RULE_ID, name_node,
+                    f"PRNG key `{name}` reused after being consumed — "
+                    "split/fold_in a fresh key first"))
+        else:
+            state[name] = "consumed"
+
+    def _bind_fresh(self, target, state):
+        if isinstance(target, ast.Name):
+            state[target.id] = "fresh"
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_fresh(elt, state)
+
+    def _bind_unknown(self, target, state):
+        if isinstance(target, ast.Name):
+            state.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_unknown(elt, state)
+
+    # -- expression scan: consumption happens at calls -----------------
+
+    def scan_expr(self, node, state):
+        if isinstance(node, FuncNode):
+            return  # nested functions tracked separately
+        if isinstance(node, ast.Call):
+            name = terminal_name(node.func)
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and name not in _NONCONSUMING:
+                    self._consume(arg, state)
+                else:
+                    self.scan_expr(arg, state)
+            for kw in node.keywords:
+                if isinstance(kw.value, ast.Name) \
+                        and name not in _NONCONSUMING:
+                    self._consume(kw.value, state)
+                else:
+                    self.scan_expr(kw.value, state)
+            self.scan_expr(node.func, state)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.scan_expr(child, state)
+
+    def _is_key_maker(self, value) -> bool:
+        return (isinstance(value, ast.Call)
+                and terminal_name(value.func) in KEY_MAKERS)
+
+    # -- statements ----------------------------------------------------
+
+    def walk_body(self, stmts, state):
+        for stmt in stmts:
+            self.walk_stmt(stmt, state)
+
+    def walk_stmt(self, stmt, state):
+        if isinstance(stmt, FuncNode + (ast.ClassDef,)):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            if value is None:
+                return
+            self.scan_expr(value, state)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                if self._is_key_maker(value):
+                    self._bind_fresh(t, state)
+                else:
+                    self._bind_unknown(t, state)
+            return
+        if isinstance(stmt, ast.If):
+            self.scan_expr(stmt.test, state)
+            then_state = dict(state)
+            else_state = dict(state)
+            self.walk_body(stmt.body, then_state)
+            self.walk_body(stmt.orelse, else_state)
+            # a branch that returns/raises doesn't flow into the code
+            # after the if — early-return guards must not poison keys
+            then_ends = _terminates(stmt.body)
+            else_ends = bool(stmt.orelse) and _terminates(stmt.orelse)
+            if then_ends and not else_ends:
+                state.clear()
+                state.update(else_state)
+                return
+            if else_ends and not then_ends:
+                state.clear()
+                state.update(then_state)
+                return
+            if then_ends and else_ends:
+                return  # code after is unreachable from here; keep entry
+            # merge: consumed in either branch -> consumed
+            for name in set(then_state) | set(else_state):
+                a = then_state.get(name)
+                b = else_state.get(name)
+                if a is None or b is None:
+                    state.pop(name, None)
+                else:
+                    state[name] = "consumed" if "consumed" in (a, b) \
+                        else "fresh"
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self.scan_expr(stmt.iter, state)
+                self._bind_unknown(stmt.target, state)
+            else:
+                self.scan_expr(stmt.test, state)
+            # two passes: the second replays the body with the first
+            # pass's exit state, so a key consumed in iteration N and
+            # not re-split before iteration N+1 is caught
+            self.walk_body(stmt.body, state)
+            self.walk_body(stmt.body, state)
+            self.walk_body(stmt.orelse, state)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.scan_expr(item.context_expr, state)
+            self.walk_body(stmt.body, state)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body, state)
+            for h in stmt.handlers:
+                self.walk_body(h.body, state)
+            self.walk_body(stmt.orelse, state)
+            self.walk_body(stmt.finalbody, state)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            self.scan_expr(child, state)
+
+
+def check(module, config):
+    findings = []
+    tracker = _KeyTracker(module, findings)
+    for node in ast.walk(module.tree):
+        if isinstance(node, FuncNode):
+            tracker.run(node)
+    return findings

@@ -1,0 +1,106 @@
+"""G003: treedef stability for kernel state dataclasses.
+
+``ChainState`` / ``BoardState`` (and any ``struct.dataclass`` whose name
+ends in ``State``) are jit-cache keys and checkpoint payloads: their
+pytree structure must not change under existing callers. PR 3's
+contract: every field WITH a default must be ``Optional[...] = None``
+(so the default treedef — and every compiled graph and checkpoint —
+stays identical, and enabling the field is an explicit respecialize),
+and no non-defaulted field may follow a defaulted one (new fields go at
+the end).
+
+Static config fields declared via ``struct.field(pytree_node=False,
+...)`` are not part of the treedef leaves and are exempt from the
+Optional requirement.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted_name, terminal_name
+
+RULE_ID = "G003"
+
+_STATE_CLASSES = frozenset({"ChainState", "BoardState"})
+
+
+def applies(module) -> bool:
+    return not module.is_test
+
+
+def _is_struct_dataclass(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target) or ""
+        if name.endswith("struct.dataclass") or name == "dataclass":
+            return True
+    return False
+
+
+def _is_optional(ann) -> bool:
+    if isinstance(ann, ast.Subscript):
+        base = dotted_name(ann.value) or ""
+        return base.split(".")[-1] == "Optional"
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        return any(isinstance(s, ast.Constant) and s.value is None
+                   for s in (ann.left, ann.right))
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return "Optional[" in ann.value or "| None" in ann.value
+    return False
+
+
+def _is_static_field(default) -> bool:
+    """``struct.field(pytree_node=False, ...)`` — not a treedef leaf."""
+    if not (isinstance(default, ast.Call)
+            and terminal_name(default.func) == "field"):
+        return False
+    for kw in default.keywords:
+        if kw.arg == "pytree_node" and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False
+    return False
+
+
+def check(module, config):
+    findings = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if not (node.name in _STATE_CLASSES
+                or (node.name.endswith("State")
+                    and _is_struct_dataclass(node))):
+            continue
+        seen_default = None  # field name of first defaulted field
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign) \
+                    or not isinstance(stmt.target, ast.Name):
+                continue
+            fname = stmt.target.id
+            if stmt.value is None:
+                if seen_default is not None:
+                    findings.append(module.finding(
+                        RULE_ID, stmt,
+                        f"{node.name}.{fname}: non-defaulted field after "
+                        f"defaulted `{seen_default}` — new fields must "
+                        "be trailing"))
+                continue
+            if _is_static_field(stmt.value):
+                if seen_default is None:
+                    seen_default = fname
+                continue
+            if seen_default is None:
+                seen_default = fname
+            is_none = (isinstance(stmt.value, ast.Constant)
+                       and stmt.value.value is None)
+            if not is_none:
+                findings.append(module.finding(
+                    RULE_ID, stmt,
+                    f"{node.name}.{fname}: defaulted field must default "
+                    "to None (treedef/checkpoint stability)"))
+            if not _is_optional(stmt.annotation):
+                findings.append(module.finding(
+                    RULE_ID, stmt,
+                    f"{node.name}.{fname}: defaulted field must be "
+                    "annotated Optional[...] (treedef/checkpoint "
+                    "stability)"))
+    return findings

@@ -1,0 +1,132 @@
+"""G004: obs event conformance at emit call sites.
+
+Every ``<recorder>.emit("<type>", field=..., ...)`` call must name an
+event type declared in ``obs/events.py`` and cover that type's core
+fields with keyword arguments. The registry is read STATICALLY from the
+``EVENT_REGISTRY`` literal (falling back to ``EVENT_FIELDS``) in the
+events module — the same single source of truth ``Recorder.emit``
+validates against at runtime, so the two cannot drift.
+
+A ``**splat`` in the call suppresses the field-coverage check (the
+fields are dynamic); the event-name check still applies when the first
+argument is a string literal, and a non-literal event name is itself a
+finding (a typo'd dynamic name would only fail at runtime).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+RULE_ID = "G004"
+
+EVENTS_RELPATH = os.path.join("flipcomplexityempirical_tpu", "obs",
+                              "events.py")
+
+_registry_cache = {}
+
+
+def applies(module) -> bool:
+    return not module.is_test
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _extract_registry(tree):
+    """{event: frozenset(core fields)} from the EVENT_REGISTRY literal,
+    else from the legacy EVENT_FIELDS frozenset literals."""
+    for name in ("EVENT_REGISTRY", "EVENT_FIELDS"):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == name
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                event = _const_str(k)
+                if event is None:
+                    continue
+                fields = _extract_fields(v)
+                if fields is not None:
+                    out[event] = fields
+            if out:
+                return out
+    return None
+
+
+def _extract_fields(value):
+    # EVENT_REGISTRY style: {"fields": ("a", "b"), ...}
+    if isinstance(value, ast.Dict):
+        for k, v in zip(value.keys, value.values):
+            if _const_str(k) == "fields":
+                return _extract_fields(v)
+        return None
+    # frozenset({...}) / set / tuple / list of string constants
+    if isinstance(value, ast.Call) and value.args:
+        return _extract_fields(value.args[0])
+    if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+        fields = [_const_str(e) for e in value.elts]
+        if all(f is not None for f in fields):
+            return frozenset(fields)
+    return None
+
+
+def load_registry(root):
+    """Parse the event registry out of obs/events.py under ``root``.
+    Returns None (rule disabled) when the file is missing — fixture
+    checkouts — rather than erroring."""
+    path = os.path.join(root, EVENTS_RELPATH)
+    key = os.path.abspath(path)
+    if key not in _registry_cache:
+        registry = None
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                registry = _extract_registry(ast.parse(fh.read()))
+        except (OSError, SyntaxError):
+            registry = None
+        _registry_cache[key] = registry
+    return _registry_cache[key]
+
+
+def check(module, config):
+    registry = load_registry(config.root)
+    if registry is None:
+        return []
+    findings = []
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"):
+            continue
+        if not node.args:
+            continue  # emit() with no event arg fails at runtime anyway
+        event = _const_str(node.args[0])
+        if event is None:
+            findings.append(module.finding(
+                RULE_ID, node,
+                "event type passed to .emit() must be a string literal "
+                "so the schema is statically checkable"))
+            continue
+        if event not in registry:
+            findings.append(module.finding(
+                RULE_ID, node,
+                f"unknown event type {event!r} — not declared in "
+                "obs/events.py EVENT_REGISTRY"))
+            continue
+        has_splat = any(kw.arg is None for kw in node.keywords)
+        if has_splat:
+            continue
+        given = {kw.arg for kw in node.keywords if kw.arg is not None}
+        missing = registry[event] - given - {"ts"}
+        if missing:
+            findings.append(module.finding(
+                RULE_ID, node,
+                f"emit({event!r}, ...) missing core field(s) "
+                f"{sorted(missing)} declared in obs/events.py"))
+    return findings

@@ -1,0 +1,98 @@
+"""G005: recorder-contract purity in the sampling runners.
+
+The NullRecorder contract (PR 1): with no recorder attached, a runner's
+hot loop must execute byte-identically to the un-instrumented code — no
+metric readbacks, no host formatting, nothing between a device dispatch
+and the runner's own sync point. The enforcement pattern in this repo
+is truthiness gating: ``bool(NullRecorder()) is False``, so every piece
+of telemetry work hangs under an ``if rec:`` (or ``if rec and ...:``)
+guard.
+
+Statically: in ``sampling/`` modules, inside any function that performs
+a device dispatch (calls one of DISPATCH_NAMES), every obs call —
+``.emit`` / ``.observe_chunk`` / ``.poll`` — must be lexically nested
+under an ``if`` whose test mentions a recorder-ish name (``rec``,
+``recorder``, or anything assigned from ``resolve_recorder``).
+Functions that never dispatch (deferred emitters like
+``_emit_board_chunks``, which run after the run-end sync) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import (FuncNode, enclosing_function, parents,
+                       terminal_name, walk_with_parents)
+
+RULE_ID = "G005"
+
+DISPATCH_NAMES = frozenset({
+    "_run_chunk", "run_board_chunk", "run_board_chunk_pallas",
+    "_record_initial", "record_final", "exchange_step",
+})
+OBS_METHODS = frozenset({"emit", "observe_chunk", "poll"})
+_RECORDERISH = frozenset({"rec", "recorder"})
+
+
+def applies(module) -> bool:
+    return "sampling/" in module.path and not module.is_test
+
+
+def _recorderish_names(fn) -> set:
+    names = set(_RECORDERISH)
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and terminal_name(node.value.func) == "resolve_recorder"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _dispatches(fn) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and terminal_name(node.func) in DISPATCH_NAMES:
+            return True
+    return False
+
+
+def _guarded(node, fn, names) -> bool:
+    """Some ancestor ``if`` (within ``fn``) tests a recorder-ish name."""
+    for p in parents(node):
+        if p is fn:
+            return False
+        if isinstance(p, ast.If):
+            for n in ast.walk(p.test):
+                if isinstance(n, ast.Name) and n.id in names:
+                    return True
+    return False
+
+
+def check(module, config):
+    walk_with_parents(module.tree)
+    findings = []
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _dispatches(fn):
+            continue
+        names = _recorderish_names(fn)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in OBS_METHODS):
+                continue
+            if enclosing_function(node) is not fn:
+                continue  # nested function's calls judged in their own fn
+            if isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                continue  # method plumbing, not a runner call site
+            if not _guarded(node, fn, names):
+                findings.append(module.finding(
+                    RULE_ID, node,
+                    f".{node.func.attr}() in a dispatching runner "
+                    "function must be guarded by `if rec:` so the "
+                    "NullRecorder path stays byte-identical"))
+    return findings

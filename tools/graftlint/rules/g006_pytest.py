@@ -1,0 +1,91 @@
+"""G006: pytest hygiene for the tiered suite.
+
+The tier-1 verify command runs ``-m 'not slow'`` under a wall-clock
+budget (tests/conftest.py). A test that drives more than
+``max_test_steps`` chain steps, or loops over physical devices, belongs
+in the slow tier: it must carry ``@pytest.mark.slow`` (or ride a
+module-level ``pytestmark`` that includes it).
+
+Detected step loads: an integer literal > N passed as
+``n_steps=``/``num_steps=``/``steps=`` to any call inside the test, or
+bound to a local of one of those names. Device loops: ``for ... in
+jax.devices()`` / ``jax.local_devices()``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted_name, terminal_name
+
+RULE_ID = "G006"
+
+_STEP_KWARGS = frozenset({"n_steps", "num_steps", "steps"})
+_DEVICE_ITERS = frozenset({"devices", "local_devices"})
+
+
+def applies(module) -> bool:
+    return module.is_test
+
+
+def _has_slow_marker(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target) or ""
+        if name.endswith("mark.slow") or name == "slow":
+            return True
+    return False
+
+
+def _module_marked_slow(tree) -> bool:
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "pytestmark"
+                        for t in node.targets)):
+            for n in ast.walk(node.value):
+                name = dotted_name(n) or ""
+                if name.endswith("mark.slow"):
+                    return True
+    return False
+
+
+def _heavy_reasons(fn, max_steps):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in _STEP_KWARGS \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int) \
+                        and kw.value.value > max_steps:
+                    yield node, (f"drives {kw.value.value} chain steps "
+                                 f"(> {max_steps})")
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in _STEP_KWARGS \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, int) \
+                        and node.value.value > max_steps:
+                    yield node, (f"binds {t.id}={node.value.value} "
+                                 f"(> {max_steps})")
+        elif isinstance(node, ast.For):
+            if isinstance(node.iter, ast.Call) \
+                    and terminal_name(node.iter.func) in _DEVICE_ITERS:
+                yield node, "loops over physical devices"
+
+
+def check(module, config):
+    if _module_marked_slow(module.tree):
+        return []
+    findings = []
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, ast.FunctionDef) \
+                or not fn.name.startswith("test_"):
+            continue
+        if _has_slow_marker(fn):
+            continue
+        for node, reason in _heavy_reasons(fn, config.max_test_steps):
+            findings.append(module.finding(
+                RULE_ID, node,
+                f"{fn.name} {reason} but lacks @pytest.mark.slow "
+                "(tier-1 budget)"))
+    return findings

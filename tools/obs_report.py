@@ -20,7 +20,9 @@ sweep section summarizes driver progress events.
 ``--check`` validates every line against the event schema
 (obs.events.EVENT_FIELDS envelope + per-type core fields) and exits
 nonzero listing each malformed/unknown event — the CI gate on anything
-that emits telemetry. ``--strict`` additionally exits nonzero (after
+that emits telemetry. It also prints the grandfathered-finding count
+from the committed ``graftlint_baseline.json`` so static-analysis debt
+is visible in the same report (target: 0). ``--strict`` additionally exits nonzero (after
 printing the report) when the stream carries any ``anomaly`` events —
 the CI gate on chain HEALTH rather than stream shape. Stdlib-only: the
 schema module is loaded by file path, so neither gate needs jax (or any
@@ -49,6 +51,24 @@ def _load_schema():
     return mod
 
 
+_GRAFTLINT_BASELINE = os.path.join(_HERE, os.pardir,
+                                   "graftlint_baseline.json")
+
+
+def graftlint_baseline_count(path: str = _GRAFTLINT_BASELINE):
+    """Number of grandfathered findings in the committed graftlint
+    baseline, or None when no baseline exists. Surfaced by ``--check``
+    so static-analysis debt is visible next to the schema gate (the
+    target is 0: violations get fixed or pragma'd, not baselined)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    findings = doc.get("findings")
+    return len(findings) if isinstance(findings, list) else None
+
+
 def check(path: str, schema) -> int:
     """Validate every line; print one diagnostic per bad line; return
     the number of bad lines (the exit code driver)."""
@@ -67,6 +87,10 @@ def check(path: str, schema) -> int:
               f"v{schema.SCHEMA_VERSION}", file=sys.stderr)
     else:
         print(f"{path}: ok ({n} events, schema v{schema.SCHEMA_VERSION})")
+    grandfathered = graftlint_baseline_count()
+    if grandfathered is not None:
+        print(f"graftlint baseline: {grandfathered} grandfathered "
+              "finding(s)")
     return bad
 
 
