@@ -1,0 +1,18 @@
+# Convenience entry points; every target is a thin wrapper over a
+# checked-in script so CI and humans run the same thing.
+
+PYTHON ?= python
+
+.PHONY: test obs-check lint
+
+# tier-1 suite (the ROADMAP verify command without the log plumbing)
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+# static telemetry gates: graftlint + event-stream schema/span check +
+# Chrome-trace export validation over the committed fixture stream
+obs-check:
+	PYTHON=$(PYTHON) tools/ci_obs.sh
+
+lint:
+	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

@@ -185,6 +185,20 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
     return jax.jit(train_step)
 
 
+def host_recorder(spec):
+    """Per-host event sink for sharded runs: ``obs.from_spec`` with
+    multi-host path rewriting, so each jax host appends its events and
+    spans to its own ``events.host<K>.jsonl`` (concurrent appends to one
+    shared file would interleave mid-line). ``tools/trace_export.py``
+    merges the per-host files into a single Chrome trace, one ``pid``
+    per host id parsed from the filename; ``tools/obs_report.py``
+    accepts any one of them. Single-host processes get a plain
+    single-file recorder — same spec, same call site either way."""
+    from ..obs import from_spec
+
+    return from_spec(spec, per_host=True)
+
+
 def states_struct():
     """A ChainState of leaf placeholders for building PartitionSpec trees."""
     return ChainState(

@@ -127,12 +127,15 @@ def run_config(cfg: ExperimentConfig, outdir: str,
                checkpoint_dir: Optional[str] = None,
                recorder=None) -> dict:
     os.makedirs(outdir, exist_ok=True)
-    g, plan, geo = build_graph_and_plan(cfg)
+    rec = obs.resolve_recorder(recorder)
+    with obs.span(rec, "build_graph", tag=cfg.tag, family=cfg.family):
+        g, plan, geo = build_graph_and_plan(cfg)
     labels = _labels_for(cfg)
     signed = labels[plan]
     pos = geo.centroid if geo is not None else None
-    render_start(g, cfg.family, outdir, cfg.tag, signed,
-                 cfg.plot_node_size, pos=pos)
+    with obs.span(rec, "render", tag=cfg.tag, phase="start"):
+        render_start(g, cfg.family, outdir, cfg.tag, signed,
+                     cfg.plot_node_size, pos=pos)
     t0 = time.time()
     if cfg.backend == "python":
         if cfg.family not in ("sec11", "frank"):
@@ -148,26 +151,30 @@ def run_config(cfg: ExperimentConfig, outdir: str,
         data = _run_jax(cfg, g, plan, checkpoint_dir, recorder=recorder)
     data["seconds"] = time.time() - t0
     if cfg.n_districts == 2:
-        data["partisan"] = _partisan_summary(cfg, g, data)
+        with obs.span(rec, "partisan", tag=cfg.tag):
+            data["partisan"] = _partisan_summary(cfg, g, data)
 
     if cfg.family in ("sec11", "frank"):
-        render_all(g, cfg.family, outdir, cfg.tag,
-                   end_signed=data["end_signed"],
-                   cut_times=data["cut_times"],
-                   part_sum=data["part_sum"], num_flips=data["num_flips"],
-                   slopes=data["slopes"], angles=data["angles"],
-                   waits_sum=data["waits_sum"],
-                   node_size=cfg.plot_node_size)
+        with obs.span(rec, "render", tag=cfg.tag, phase="all"):
+            render_all(g, cfg.family, outdir, cfg.tag,
+                       end_signed=data["end_signed"],
+                       cut_times=data["cut_times"],
+                       part_sum=data["part_sum"],
+                       num_flips=data["num_flips"],
+                       slopes=data["slopes"], angles=data["angles"],
+                       waits_sum=data["waits_sum"],
+                       node_size=cfg.plot_node_size)
         return data
 
-    render_generic(g, cfg.family, outdir, cfg.tag,
-                   kinds=artifact_kinds(cfg.family),
-                   node_size=cfg.plot_node_size,
-                   end_signed=data["end_signed"],
-                   cut_times=data["cut_times"],
-                   num_flips=data["num_flips"],
-                   part_sum=data.get("part_sum"),
-                   waits_sum=data["waits_sum"], pos=pos)
+    with obs.span(rec, "render", tag=cfg.tag, phase="generic"):
+        render_generic(g, cfg.family, outdir, cfg.tag,
+                       kinds=artifact_kinds(cfg.family),
+                       node_size=cfg.plot_node_size,
+                       end_signed=data["end_signed"],
+                       cut_times=data["cut_times"],
+                       num_flips=data["num_flips"],
+                       part_sum=data.get("part_sum"),
+                       waits_sum=data["waits_sum"], pos=pos)
     j = lambda kind: os.path.join(outdir, cfg.tag + kind)
     if cfg.family == "temper":
         render_rungs(j("rungs.png"), data["rung_cut"], cfg.betas)
@@ -215,6 +222,7 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     graphs) fall back to the general gather kernel."""
     from ..sampling.board_runner import run_board_segment
 
+    rec = obs.resolve_recorder(recorder)
     spec = spec_for(cfg)
     labels = _labels_for(cfg)
     use_board = kboard.supports(g, spec)
@@ -263,10 +271,11 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         done += n
         segments += 1
         if checkpoint_dir:
-            n_parts = save_checkpoint(
-                checkpoint_dir, cfg, res.host_state(), done=done,
-                waits_total=waits_total, new_hist=res.history,
-                part_idx=n_parts)
+            with obs.span(rec, "checkpoint", tag=cfg.tag, done=done):
+                n_parts = save_checkpoint(
+                    checkpoint_dir, cfg, res.host_state(), done=done,
+                    waits_total=waits_total, new_hist=res.history,
+                    part_idx=n_parts)
         if _stop_after_segments and segments >= _stop_after_segments:
             raise _SegmentStop(done)
 
@@ -470,15 +479,17 @@ def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
         done += n
         segments += 1
         if checkpoint_dir:
-            n_parts = save_checkpoint(
-                checkpoint_dir, cfg, res.host_state(), done=done,
-                waits_total=waits_total, new_hist=seg_hist,
-                part_idx=n_parts,
-                extra={"beta": np.asarray(params.beta),
-                       "swap_attempts": attempts,
-                       "swap_accepts": accepts,
-                       "parity": np.int64(parity),
-                       "swap_key": np.asarray(swap_key)})
+            with obs.span(obs.resolve_recorder(recorder), "checkpoint",
+                          tag=cfg.tag, done=done):
+                n_parts = save_checkpoint(
+                    checkpoint_dir, cfg, res.host_state(), done=done,
+                    waits_total=waits_total, new_hist=seg_hist,
+                    part_idx=n_parts,
+                    extra={"beta": np.asarray(params.beta),
+                           "swap_attempts": attempts,
+                           "swap_accepts": accepts,
+                           "parity": np.int64(parity),
+                           "swap_key": np.asarray(swap_key)})
         if _stop_after_segments and segments >= _stop_after_segments:
             raise _SegmentStop(done)
 
@@ -784,72 +795,124 @@ def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
     config (status start/done/skip, artifact counts, seconds) and is
     threaded into every runner underneath for per-chunk telemetry; an
     uncaught per-config failure emits an ``error`` event before
-    re-raising. ``heartbeat``: path of a JSON progress file refreshed
-    before and after each config (write_heartbeat) — while a config is
-    running, each runner ``diag`` snapshot also refreshes it (the
-    ``diag`` key holds the active run's latest convergence/health
-    numbers, so the hang detector doubles as an in-flight health
-    readout).
+    re-raising. The sweep and each attempted config are wrapped in
+    ``sweep`` / ``config`` spans (obs.trace) — closed on the error path
+    too, so the span stream of a failed sweep still validates.
+    ``heartbeat``: path of a JSON progress file refreshed before and
+    after each config (write_heartbeat) — while a config is running,
+    each runner ``diag`` snapshot, each monitor ``anomaly``, and each
+    per-chunk metrics snapshot also refresh it (keys ``diag`` /
+    ``anomalies`` — a per-kind episode tally — / ``metrics`` — latest
+    p50/p95/p99 chunk latency and flips/s), so the hang detector doubles
+    as an in-flight health readout.
     """
     rec = obs.resolve_recorder(recorder)
     configs = list(configs)
     results = []
     n_done = n_skipped = 0
-    for i, cfg in enumerate(configs):
-        if is_done(cfg, outdir):
-            n_skipped += 1
-            if verbose:
-                print(f"[skip] {cfg.family} {cfg.tag} (artifacts complete)")
+    sweep_span = obs.span(rec, "sweep", n_configs=len(configs))
+    sweep_span.begin()
+    try:
+        for i, cfg in enumerate(configs):
+            if is_done(cfg, outdir):
+                n_skipped += 1
+                if verbose:
+                    print(f"[skip] {cfg.family} {cfg.tag} "
+                          f"(artifacts complete)")
+                rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
+                         status="skip",
+                         artifacts=len(artifact_kinds(cfg.family)),
+                         index=i, n_configs=len(configs))
+                write_heartbeat(heartbeat, status="running", current=None,
+                                last=cfg.tag, n_done=n_done,
+                                n_skipped=n_skipped,
+                                n_configs=len(configs))
+                continue
+            t0 = time.time()
             rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
-                     status="skip",
-                     artifacts=len(artifact_kinds(cfg.family)),
+                     status="start",
+                     artifacts=count_artifacts(cfg, outdir),
                      index=i, n_configs=len(configs))
+            write_heartbeat(heartbeat, status="running", current=cfg.tag,
+                            last=None, n_done=n_done, n_skipped=n_skipped,
+                            n_configs=len(configs))
+            cfg_span = obs.span(rec, "config", tag=cfg.tag,
+                                family=cfg.family).begin()
+            if rec and heartbeat:
+                # live heartbeat enrichment for the config in flight:
+                # ChainMonitor calls rec.diag_hook with each diag event
+                # and rec.anomaly_hook with each anomaly episode; the
+                # runners' MetricsRegistry.notify calls rec.metrics_hook
+                # once per chunk. Each refresh carries whatever has been
+                # seen so far.
+                hb_state = {"diag": None, "metrics": None, "anomalies": {}}
+
+                def _hb_refresh(_tag=cfg.tag, _state=hb_state):
+                    extra = {}
+                    if _state["diag"] is not None:
+                        extra["diag"] = {_tag: _state["diag"]}
+                    if _state["metrics"] is not None:
+                        extra["metrics"] = {_tag: _state["metrics"]}
+                    if _state["anomalies"]:
+                        extra["anomalies"] = {_tag:
+                                              dict(_state["anomalies"])}
+                    write_heartbeat(heartbeat, status="running",
+                                    current=_tag, last=None,
+                                    n_done=n_done, n_skipped=n_skipped,
+                                    n_configs=len(configs), **extra)
+
+                def _on_diag(diag, _state=hb_state, _hb=_hb_refresh):
+                    _state["diag"] = diag
+                    _hb()
+
+                def _on_anomaly(anom, _state=hb_state, _hb=_hb_refresh):
+                    kind = anom.get("kind", "unknown")
+                    _state["anomalies"][kind] = \
+                        _state["anomalies"].get(kind, 0) + 1
+                    _hb()
+
+                def _on_metrics(snap, _state=hb_state, _hb=_hb_refresh):
+                    _state["metrics"] = snap
+                    _hb()
+
+                rec.diag_hook = _on_diag
+                rec.anomaly_hook = _on_anomaly
+                rec.metrics_hook = _on_metrics
+            try:
+                data = run_config(cfg, outdir, checkpoint_dir,
+                                  recorder=rec)
+            except Exception as e:
+                rec.emit("error", message=f"{type(e).__name__}: {e}",
+                         tag=cfg.tag, family=cfg.family)
+                cfg_span.end(error=type(e).__name__)
+                write_heartbeat(heartbeat, status="error",
+                                current=cfg.tag, last=None, n_done=n_done,
+                                n_skipped=n_skipped,
+                                n_configs=len(configs),
+                                error=f"{type(e).__name__}: {e}")
+                raise
+            finally:
+                if rec and heartbeat:
+                    rec.diag_hook = None
+                    rec.anomaly_hook = None
+                    rec.metrics_hook = None
+            n_done += 1
+            cfg_span.end(seconds=time.time() - t0)
+            rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
+                     status="done",
+                     artifacts=count_artifacts(cfg, outdir),
+                     seconds=time.time() - t0, index=i,
+                     n_configs=len(configs))
             write_heartbeat(heartbeat, status="running", current=None,
                             last=cfg.tag, n_done=n_done,
                             n_skipped=n_skipped, n_configs=len(configs))
-            continue
-        t0 = time.time()
-        rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
-                 status="start", artifacts=count_artifacts(cfg, outdir),
-                 index=i, n_configs=len(configs))
-        write_heartbeat(heartbeat, status="running", current=cfg.tag,
-                        last=None, n_done=n_done, n_skipped=n_skipped,
-                        n_configs=len(configs))
-        if rec and heartbeat:
-            # ChainMonitor calls rec.diag_hook with each diag event it
-            # emits; embed the latest snapshot so the heartbeat shows
-            # live R-hat / acceptance for the config in flight
-            rec.diag_hook = (
-                lambda diag, _tag=cfg.tag, _i=i: write_heartbeat(
-                    heartbeat, status="running", current=_tag, last=None,
-                    n_done=n_done, n_skipped=n_skipped,
-                    n_configs=len(configs), diag={_tag: diag}))
-        try:
-            data = run_config(cfg, outdir, checkpoint_dir, recorder=rec)
-        except Exception as e:
-            rec.emit("error", message=f"{type(e).__name__}: {e}",
-                     tag=cfg.tag, family=cfg.family)
-            write_heartbeat(heartbeat, status="error", current=cfg.tag,
-                            last=None, n_done=n_done,
-                            n_skipped=n_skipped, n_configs=len(configs),
-                            error=f"{type(e).__name__}: {e}")
-            raise
-        finally:
-            if rec and heartbeat:
-                rec.diag_hook = None
-        n_done += 1
-        rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
-                 status="done", artifacts=count_artifacts(cfg, outdir),
-                 seconds=time.time() - t0, index=i,
-                 n_configs=len(configs))
-        write_heartbeat(heartbeat, status="running", current=None,
-                        last=cfg.tag, n_done=n_done, n_skipped=n_skipped,
-                        n_configs=len(configs))
-        if verbose:
-            print(f"[done] {cfg.family} {cfg.tag} "
-                  f"waits={data['waits_sum']:.4g} "
-                  f"({time.time() - t0:.1f}s)")
-        results.append((cfg, data))
+            if verbose:
+                print(f"[done] {cfg.family} {cfg.tag} "
+                      f"waits={data['waits_sum']:.4g} "
+                      f"({time.time() - t0:.1f}s)")
+            results.append((cfg, data))
+    finally:
+        sweep_span.end(n_done=n_done, n_skipped=n_skipped)
     write_heartbeat(heartbeat, status="complete", current=None,
                     last=None, n_done=n_done, n_skipped=n_skipped,
                     n_configs=len(configs))

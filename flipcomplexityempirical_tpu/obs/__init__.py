@@ -1,30 +1,43 @@
 """Chain telemetry subsystem: structured JSONL run events, per-chunk
-metrics, and the shared ``jax.profiler`` hook (ISSUE 1).
+metrics, span-based tracing, and the shared ``jax.profiler`` hook
+(ISSUEs 1, 3, 5).
 
 Zero-dependency by construction — stdlib only at import time, jax
-imported lazily inside ``profile_region`` — so the schema and recorder
-stay usable from tools and tests that never touch the device runtime.
+imported lazily inside ``profile_region`` / the TraceAnnotation bridge —
+so the schema, recorder, tracer, and metrics registry stay usable from
+tools and tests that never touch the device runtime.
 The default recorder is the no-op ``NULL``; enable telemetry by passing
 ``recorder=`` to a runner / ``run_sweep``, via ``--events PATH`` on
 bench.py and ``python -m flipcomplexityempirical_tpu.experiments``, or
 process-wide with ``set_default_recorder``.
+
+Tracing (``obs.trace``): ``span(rec, name, **args)`` context manager /
+``.begin()``/``.end()`` pairs emit ``span_begin``/``span_end`` events;
+``traced`` is the decorator form; ``tools/trace_export.py`` converts a
+stream to Chrome trace-event JSON for Perfetto. Metrics
+(``obs.metrics.MetricsRegistry``): counters/gauges/histograms whose
+p50/p95/p99 snapshots ride ``run_end`` events and driver heartbeats.
 """
 
 from .events import (EVENT_FIELDS, SCHEMA_VERSION, SWEEP_STATUSES,
-                     validate_event, validate_line)
+                     validate_event, validate_line, validate_spans)
+from .metrics import Histogram, MetricsRegistry
 from .recorder import (NULL, JitWatch, NullRecorder, Recorder, aot_cost,
                        default_recorder, device_memory_snapshot,
                        dict_nbytes, from_spec, jit_cache_size,
-                       profile_region, resolve_recorder,
+                       per_host_path, profile_region, resolve_recorder,
                        set_default_recorder)
+from .trace import Span, emit_span_at, span, traced
 
 __all__ = [
     "EVENT_FIELDS", "SCHEMA_VERSION", "SWEEP_STATUSES",
-    "validate_event", "validate_line",
+    "validate_event", "validate_line", "validate_spans",
     "NULL", "NullRecorder", "Recorder", "JitWatch", "ChainMonitor",
     "default_recorder", "set_default_recorder", "resolve_recorder",
-    "from_spec", "profile_region", "jit_cache_size", "dict_nbytes",
-    "aot_cost", "device_memory_snapshot",
+    "from_spec", "per_host_path", "profile_region", "jit_cache_size",
+    "dict_nbytes", "aot_cost", "device_memory_snapshot",
+    "Span", "span", "traced", "emit_span_at",
+    "Histogram", "MetricsRegistry",
 ]
 
 

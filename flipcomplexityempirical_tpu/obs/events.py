@@ -48,6 +48,20 @@ Core field semantics:
   ``pop_bound_saturation`` / ``throughput_regression``; ``detail`` is a
   kind-specific object. Each kind re-arms after recovery, so a stream
   records episodes, not one line per chunk.
+- ``span_begin`` / ``span_end``: the tracing subsystem (``obs.trace``).
+  ``span_id`` is unique within ``trace_id`` (one trace per recorder);
+  ``parent_id`` is the enclosing span's id or null at top level, and the
+  begin of a parent always precedes the begins of its children in the
+  stream. ``dur_s`` on the end is measured on the monotonic clock, NOT
+  derived from the ``ts`` stamps (the board path back-stamps deferred
+  chunk spans; see ``obs.trace.emit_span_at``). ``validate_spans``
+  below checks the pairing/nesting contract; ``tools/trace_export.py``
+  turns conforming streams into Chrome trace-event JSON.
+- ``metrics_snapshot``: an ``obs.metrics.MetricsRegistry`` snapshot —
+  ``counters``/``gauges`` are flat name->value objects, ``histograms``
+  maps name -> {count, sum, min, max, mean, p50, p95, p99}. Runners
+  emit exactly one per run (right before ``run_end``, which embeds the
+  same object under ``metrics=``).
 
 Adding a new event *type* (as ``diag``/``anomaly`` were added) does NOT
 bump SCHEMA_VERSION: readers fold by type and validation rejects only
@@ -106,6 +120,18 @@ EVENT_REGISTRY = {
         "fields": ("kind", "detail"),
         "doc": "monitor health-threshold episode",
     },
+    "span_begin": {
+        "fields": ("name", "span_id", "trace_id", "parent_id"),
+        "doc": "host wall-clock span opened (obs.trace)",
+    },
+    "span_end": {
+        "fields": ("name", "span_id", "trace_id", "dur_s"),
+        "doc": "host span closed; dur_s from the monotonic clock",
+    },
+    "metrics_snapshot": {
+        "fields": ("counters", "gauges", "histograms"),
+        "doc": "obs.metrics.MetricsRegistry snapshot",
+    },
 }
 
 # Derived view (event -> frozenset of core fields) kept for existing
@@ -137,6 +163,63 @@ def validate_event(obj) -> str | None:
         return f"sweep_config status {obj['status']!r} not in " \
                f"{SWEEP_STATUSES}"
     return None
+
+
+def validate_spans(events) -> list:
+    """Span pairing/nesting errors over a stream of parsed events (other
+    event types pass through untouched). Shared by ``obs_report --check``
+    and ``trace_export --validate``, which both load this module by file
+    path. The contract:
+
+    * every ``span_begin`` is closed by exactly one ``span_end`` with
+      the same ``span_id`` and ``name``;
+    * span ids are never reused within a stream;
+    * a non-null ``parent_id`` refers to a span that is open at the
+      child's begin (parents precede children, in stream order);
+    * a parent does not close while a child is still open.
+
+    Returns a list of human-readable error strings (empty == clean).
+    """
+    errors = []
+    open_spans: dict = {}
+    closed = set()
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            continue
+        kind = e.get("event")
+        if kind == "span_begin":
+            sid = e.get("span_id")
+            if sid in open_spans or sid in closed:
+                errors.append(f"event {i}: span_begin reuses span_id "
+                              f"{sid!r} ({e.get('name')!r})")
+                continue
+            pid = e.get("parent_id")
+            if pid is not None and pid not in open_spans:
+                errors.append(f"event {i}: span_begin {e.get('name')!r} "
+                              f"has parent {pid!r} that is not open")
+            open_spans[sid] = e
+        elif kind == "span_end":
+            sid = e.get("span_id")
+            begin = open_spans.pop(sid, None)
+            if begin is None:
+                errors.append(f"event {i}: span_end {e.get('name')!r} "
+                              f"for span_id {sid!r} with no open begin")
+                continue
+            if begin.get("name") != e.get("name"):
+                errors.append(f"event {i}: span_end name "
+                              f"{e.get('name')!r} != begin name "
+                              f"{begin.get('name')!r} (span_id {sid!r})")
+            closed.add(sid)
+            orphans = [b for b in open_spans.values()
+                       if b.get("parent_id") == sid]
+            for b in orphans:
+                errors.append(f"event {i}: span {sid!r} "
+                              f"({e.get('name')!r}) closed while child "
+                              f"{b.get('span_id')!r} ({b.get('name')!r}) "
+                              f"is still open")
+    for sid, b in open_spans.items():
+        errors.append(f"span {sid!r} ({b.get('name')!r}) never closed")
+    return errors
 
 
 def validate_line(line: str) -> str | None:

@@ -1,0 +1,159 @@
+"""Metrics registry: counters, gauges, and fixed-bucket histograms.
+
+The runtime-cheap half of the observability story (ISSUE 5): spans say
+*where* the wall-clock went, the registry says *how it was distributed*.
+One ``MetricsRegistry`` per run rides the runner's existing ``if rec:``
+blocks — a counter bump and a bucket increment per chunk, nothing more —
+and serializes into the ``run_end`` event (``metrics=``), one
+``metrics_snapshot`` event per run, and the driver heartbeat (via
+``Recorder.metrics_hook``), so a sweep watcher sees live p50/p95/p99
+chunk latency without parsing the whole stream.
+
+Histograms use FIXED bucket edges (default: a 1-2-5 log ladder spanning
+1e-9..1e12, wide enough for both seconds and flips/s) so per-chunk
+observation is O(log buckets) with bounded memory regardless of run
+length; percentiles are estimated by linear interpolation inside the
+target bucket, clamped to the observed min/max. Thread-safe (one lock
+per registry) because sharded drivers may observe from helper threads.
+
+Stdlib-only, like the rest of the obs core: the registry must be
+importable from tools and tests that never touch jax.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+
+def _default_edges():
+    """The 1-2-5 log ladder: 1e-9, 2e-9, 5e-9, ..., 5e11, 1e12."""
+    edges = []
+    for e in range(-9, 12):
+        for m in (1, 2, 5):
+            edges.append(m * (10.0 ** e))
+    edges.append(1e12)
+    return tuple(edges)
+
+
+DEFAULT_EDGES = _default_edges()
+
+
+class Histogram:
+    """Fixed-bucket histogram with exact count/sum/min/max and
+    interpolated percentiles. ``edges`` are the bucket boundaries;
+    bucket i holds values in [edges[i-1], edges[i]), with an underflow
+    and an overflow bucket at the ends."""
+
+    def __init__(self, edges=DEFAULT_EDGES):
+        self.edges = tuple(float(e) for e in edges)
+        if list(self.edges) != sorted(set(self.edges)):
+            raise ValueError("histogram edges must be strictly increasing")
+        self.counts = [0] * (len(self.edges) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, value):
+        v = float(value)
+        self.counts[bisect.bisect_right(self.edges, v)] += 1
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def percentile(self, q: float):
+        """Linear-interpolated q-quantile (q in [0, 1]); None when
+        empty. Exact at the bucket boundaries, clamped to [min, max]."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        cum = 0.0
+        for i, c in enumerate(self.counts):
+            if c and cum + c >= target:
+                lo = self.edges[i - 1] if i > 0 else self.min
+                hi = self.edges[i] if i < len(self.edges) else self.max
+                lo = max(lo, self.min)
+                hi = min(hi, self.max)
+                if hi <= lo:
+                    return lo
+                return lo + ((target - cum) / c) * (hi - lo)
+            cum += c
+        return self.max
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": (self.sum / self.count) if self.count else None,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+        }
+
+
+class MetricsRegistry:
+    """Named counters / gauges / histograms behind one lock.
+
+    The convenience methods (``inc`` / ``set`` / ``observe``) get-or-
+    create, so call sites stay one line. ``snapshot()`` returns a plain
+    JSON-ready dict — the exact object embedded in ``run_end`` events
+    and heartbeats.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._histograms: dict = {}
+
+    def inc(self, name: str, n=1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set(self, name: str, value):
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value, edges=DEFAULT_EDGES):
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(edges)
+            h.observe(value)
+
+    def histogram(self, name: str) -> Histogram | None:
+        with self._lock:
+            return self._histograms.get(name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.snapshot()
+                               for k, h in self._histograms.items()},
+            }
+
+    def emit_snapshot(self, rec, **fields):
+        """One ``metrics_snapshot`` event from the current state (the
+        runners emit exactly one, right before ``run_end``)."""
+        s = self.snapshot()
+        return rec.emit("metrics_snapshot", counters=s["counters"],
+                        gauges=s["gauges"], histograms=s["histograms"],
+                        **fields)
+
+    def notify(self, rec):
+        """Push the current snapshot into ``rec.metrics_hook`` when one
+        is installed (the driver's heartbeat refresher) — a no-op
+        otherwise, so per-chunk calls cost one getattr."""
+        hook = getattr(rec, "metrics_hook", None)
+        if hook is None:
+            return
+        try:
+            hook(self.snapshot())
+        except Exception:
+            pass

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..stats.diagnostics import ess as _ess
 from ..stats.diagnostics import gelman_rubin as _gelman_rubin
+from .trace import span as _span
 
 REJECT_KEYS = ("nonboundary", "pop", "disconnect", "metropolis")
 
@@ -155,9 +156,18 @@ class ChainMonitor:
                                        + (1 - self.ewma_alpha) * prev)
 
     def _anomaly(self, kind, **detail):
-        self._rec.emit("anomaly", kind=kind, detail=detail,
-                       observable=self.observable, runner=self.runner,
-                       path=self.path)
+        e = self._rec.emit("anomaly", kind=kind, detail=detail,
+                           observable=self.observable, runner=self.runner,
+                           path=self.path)
+        # mirror of diag_hook below: the driver installs anomaly_hook
+        # while a heartbeat is active so the heartbeat JSON carries a
+        # live per-kind anomaly tally (best-effort, never raises)
+        hook = getattr(self._rec, "anomaly_hook", None)
+        if hook is not None and e is not None:
+            try:
+                hook(e)
+            except Exception:
+                pass
 
     # ---- per-chunk entry point --------------------------------------
 
@@ -173,7 +183,17 @@ class ChainMonitor:
         freeze detection. ``reject``: the chunk event's breakdown
         ({nonboundary, pop, disconnect, metropolis, accepted,
         proposals}).
+
+        The fold runs inside a ``diag`` span: the host-side diagnostics
+        work (Welford merge, R-hat/ESS over the buffer) is real wall
+        time the timeline should attribute, distinct from kernel time.
         """
+        with _span(self._rec, "diag", observable=self.observable):
+            return self._observe_chunk(outs, wall_s, flips_per_s,
+                                       accept_rate, reject, done, ts)
+
+    def _observe_chunk(self, outs, wall_s, flips_per_s, accept_rate,
+                       reject, done, ts):
         self._chunks += 1
         if wall_s:
             self._wall += float(wall_s)

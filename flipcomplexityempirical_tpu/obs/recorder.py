@@ -18,6 +18,7 @@ stream — without touching the accelerator runtime.
 from __future__ import annotations
 
 import contextlib
+import gzip
 import json
 import os
 import sys
@@ -32,6 +33,12 @@ class NullRecorder:
     the un-instrumented hot loops stay byte-identical to before."""
 
     enabled = False
+    n_emitted = 0
+    # hook attrs mirror Recorder's so hasattr-free hook plumbing
+    # (monitor, MetricsRegistry.notify) treats both uniformly
+    diag_hook = None
+    anomaly_hook = None
+    metrics_hook = None
 
     def __bool__(self):
         return False
@@ -77,6 +84,16 @@ class Recorder:
 
     enabled = True
 
+    # Optional live-observer callbacks, installed by the driver while a
+    # heartbeat is active (see experiments/driver.py run_sweep):
+    # diag_hook(diag_event), anomaly_hook(anomaly_event) — called by
+    # ChainMonitor — and metrics_hook(snapshot) — called by the runners'
+    # MetricsRegistry.notify. All best-effort; None means "nobody
+    # listening".
+    diag_hook = None
+    anomaly_hook = None
+    metrics_hook = None
+
     def __init__(self, path=None, stream=None):
         if path is None and stream is None:
             raise ValueError("Recorder needs a path and/or a stream "
@@ -88,7 +105,15 @@ class Recorder:
             d = os.path.dirname(path)
             if d:
                 os.makedirs(d, exist_ok=True)
-            self._file = open(path, "a", encoding="utf-8")
+            if path.endswith(".gz"):
+                # transparent gzip sink: long sweeps' span streams are
+                # highly repetitive JSON. "at" appends a fresh gzip
+                # member, which every stdlib/CLI reader concatenates
+                # transparently; flush() below uses Z_SYNC_FLUSH so a
+                # tail of the file stays decodable after a crash.
+                self._file = gzip.open(path, "at", encoding="utf-8")
+            else:
+                self._file = open(path, "a", encoding="utf-8")
         else:
             self._file = None
         self._stream = stream
@@ -141,15 +166,45 @@ class Recorder:
         return False
 
 
-def from_spec(spec):
+def per_host_path(path, index=None):
+    """Multi-host sink naming: when this process is one of several jax
+    hosts, rewrite ``events.jsonl`` -> ``events.host<K>.jsonl`` (the
+    ``.gz`` suffix is preserved) so every host appends spans to its own
+    file — concurrent appends to one shared file would interleave mid-
+    line. ``tools/trace_export.py`` merges the per-host files back into
+    one timeline, mapping the host id from the filename onto the Chrome
+    trace ``pid``. Single-host (and jax-less) processes get ``path``
+    back unchanged; an explicit ``index`` forces the rewrite (tests,
+    non-jax launchers that know their own rank)."""
+    if index is not None:
+        idx = int(index)
+    else:
+        try:
+            import jax
+
+            if jax.process_count() <= 1:
+                return path
+            idx = jax.process_index()
+        except Exception:
+            return path
+    root, ext = os.path.splitext(path)
+    if ext == ".gz":
+        root, inner = os.path.splitext(root)
+        ext = inner + ext
+    return f"{root}.host{idx}{ext}"
+
+
+def from_spec(spec, per_host=False):
     """CLI convenience: ``None``/empty -> NULL, ``"-"`` -> stderr
     stream, anything else -> append-to-file Recorder (the ``--events``
-    flag of bench.py and experiments/__main__.py)."""
+    flag of bench.py and experiments/__main__.py). A ``.gz`` path gets a
+    gzip sink; ``per_host=True`` routes multi-host processes through
+    ``per_host_path`` (sharded runs — see distribute.sharded)."""
     if not spec:
         return NULL
     if spec == "-":
         return Recorder(stream=sys.stderr)
-    return Recorder(path=spec)
+    return Recorder(path=per_host_path(spec) if per_host else spec)
 
 
 _default = NULL
@@ -280,17 +335,29 @@ class JitWatch:
         grew = n is not None and (self.last is None or n > self.last)
         self.last = n
         if grew:
+            # span over the (host-side) cost introspection: the compile
+            # itself already happened inside the preceding chunk call,
+            # but the AOT lower+compile in cost() is real wall time and
+            # the span puts the cache miss on the Perfetto timeline with
+            # flops/bytes attached as args. Lazy import: trace imports
+            # recorder, not vice versa at module level.
+            from .trace import span as _span
+
             extra = {}
-            if cost is not None:
-                try:
-                    c = cost()
-                except Exception:
-                    c = None
-                if c:
-                    extra.update(c)
-            mem = device_memory_snapshot()
-            if mem:
-                extra["device_memory"] = mem
+            with _span(rec, f"compile:{self.name}", cache_size=n,
+                       **fields) as sp:
+                if cost is not None:
+                    try:
+                        c = cost()
+                    except Exception:
+                        c = None
+                    if c:
+                        extra.update(c)
+                mem = device_memory_snapshot()
+                if mem:
+                    extra["device_memory"] = mem
+                sp.end(**{k: v for k, v in extra.items()
+                          if k in ("flops", "bytes_accessed")})
             rec.emit("compile", fn=self.name, cache_size=n,
                      **fields, **extra)
         return grew

@@ -1,0 +1,292 @@
+"""Span-based tracing over the Recorder event stream.
+
+A *span* is a named wall-clock interval with identity and lineage:
+
+    ``trace_id``   one hex id per recorder (one timeline per stream)
+    ``span_id``    monotonically increasing int, unique within the trace
+    ``parent_id``  span_id of the innermost span open on this thread
+                   when the span began (None at top level)
+
+Spans are emitted as ordinary schema-versioned events (``span_begin`` /
+``span_end``) through the same Recorder the runners already use, so one
+``--events`` stream carries both the chunk telemetry and the timeline;
+``tools/trace_export.py`` converts it to Chrome trace-event JSON for
+Perfetto / chrome://tracing, and ``tools/obs_report.py --check``
+validates the nesting (every begin closed, no orphan parents).
+
+Durations come from ``time.perf_counter()`` (monotonic), never from the
+wall-clock ``ts`` stamps, so spans survive NTP steps. The subsystem is
+thread-safe: span ids are allocated from one atomic counter, the open-
+span stack is per-thread (``threading.local``), and each ``span_begin``
+carries a compact ``tid`` so the exporter can lay threads on separate
+tracks.
+
+Hot-path contract (mirrors the rest of obs — see PROFILE.md):
+
+* ``span(rec, ...)`` with a falsy recorder returns a shared no-op span —
+  zero allocation beyond the call, zero events, NullRecorder runs stay
+  byte-identical.
+* Span emission must add NO device syncs. Begin/end sites in the
+  runners live inside the existing ``if rec:`` blocks at existing sync
+  points and only attach values already copied there; the board path,
+  which never syncs mid-run, defers its chunk spans and back-stamps
+  them at flush time via :func:`emit_span_at`.
+* ``annotate=True`` additionally brackets the span in a
+  ``jax.profiler.TraceAnnotation`` so device profiles collected with
+  ``jax.profiler.trace`` line up with the host timeline. The import is
+  lazy and failure-tolerant; everything else here is stdlib-only.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+import uuid
+
+from .recorder import resolve_recorder
+
+__all__ = ["span", "traced", "emit_span_at", "Span"]
+
+_ANNOTATION_CLS = None
+_ANNOTATION_FAILED = False
+
+
+def _annotation(name):
+    """``jax.profiler.TraceAnnotation(name)`` or None; lazy + cached so
+    the bridge costs one sys.modules hit per span and nothing when jax
+    is absent (obs stays importable without it)."""
+    global _ANNOTATION_CLS, _ANNOTATION_FAILED
+    if _ANNOTATION_FAILED:
+        return None
+    if _ANNOTATION_CLS is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _ANNOTATION_CLS = TraceAnnotation
+        except Exception:
+            _ANNOTATION_FAILED = True
+            return None
+    try:
+        return _ANNOTATION_CLS(name)
+    except Exception:
+        return None
+
+
+class _TraceState:
+    """Per-recorder trace identity, attached lazily to the recorder
+    instance (the Recorder itself stays tracing-agnostic)."""
+
+    __slots__ = ("trace_id", "ids", "local", "_tid_lock", "_tids")
+
+    def __init__(self):
+        self.trace_id = uuid.uuid4().hex[:16]
+        self.ids = itertools.count(1)   # next() is atomic in CPython
+        self.local = threading.local()
+        self._tid_lock = threading.Lock()
+        self._tids: dict = {}
+
+    def stack(self):
+        st = getattr(self.local, "stack", None)
+        if st is None:
+            st = self.local.stack = []
+        return st
+
+    def tid(self):
+        ident = threading.get_ident()
+        with self._tid_lock:
+            t = self._tids.get(ident)
+            if t is None:
+                t = self._tids[ident] = len(self._tids)
+            return t
+
+
+def _state(rec) -> _TraceState:
+    st = getattr(rec, "_trace_state", None)
+    if st is None:
+        st = rec._trace_state = _TraceState()
+    return st
+
+
+class _NullSpan:
+    """Shared do-nothing span for falsy recorders."""
+
+    __slots__ = ()
+
+    def __bool__(self):
+        return False
+
+    def begin(self):
+        return self
+
+    def end(self, **end_args):
+        return None
+
+    def set_args(self, **args):
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One live span. Use as a context manager::
+
+        with obs.span(rec, "render", tag=cfg.tag):
+            ...
+
+    or explicitly when begin/end straddle block boundaries (the runner
+    chunk loops)::
+
+        sp = obs.span(rec, "chunk", kernel_path=path, steps=n).begin()
+        ...dispatch, sync...
+        sp.end(reject=reject)
+
+    ``end_args`` merge into the ``span_end`` event alongside ``dur_s``.
+    Single-use: begin once, end once; a second ``end`` is a no-op.
+    """
+
+    __slots__ = ("rec", "name", "args", "annotate", "span_id", "trace_id",
+                 "parent_id", "_t0", "_begun", "_ended", "_ann", "_st")
+
+    def __init__(self, rec, name, annotate=False, args=None):
+        self.rec = rec
+        self.name = name
+        self.args = args or {}
+        self.annotate = annotate
+        self.span_id = None
+        self.trace_id = None
+        self.parent_id = None
+        self._t0 = None
+        self._begun = False
+        self._ended = False
+        self._ann = None
+        self._st = None
+
+    def set_args(self, **args):
+        """Attach more args before ``begin`` (after it they'd be lost —
+        pass late values to ``end`` instead)."""
+        self.args.update(args)
+        return self
+
+    def begin(self):
+        if self._begun:
+            return self
+        self._begun = True
+        st = self._st = _state(self.rec)
+        stack = st.stack()
+        self.parent_id = stack[-1].span_id if stack else None
+        self.span_id = next(st.ids)
+        self.trace_id = st.trace_id
+        self.rec.emit("span_begin", name=self.name, span_id=self.span_id,
+                      trace_id=self.trace_id, parent_id=self.parent_id,
+                      tid=st.tid(), **self.args)
+        stack.append(self)
+        if self.annotate:
+            ann = _annotation(self.name)
+            if ann is not None:
+                try:
+                    ann.__enter__()
+                    self._ann = ann
+                except Exception:
+                    self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def end(self, **end_args):
+        if not self._begun or self._ended:
+            return None
+        self._ended = True
+        dur = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(None, None, None)
+            except Exception:
+                pass
+            self._ann = None
+        stack = self._st.stack()
+        if self in stack:        # tolerate out-of-order ends
+            stack.remove(self)
+        return self.rec.emit("span_end", name=self.name,
+                             span_id=self.span_id, trace_id=self.trace_id,
+                             dur_s=dur, **end_args)
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.end(error=exc_type.__name__)
+        else:
+            self.end()
+        return False
+
+
+def span(rec, name, annotate=False, **args):
+    """A span on ``rec``'s stream, or the shared no-op span when the
+    recorder is falsy (NullRecorder / None). ``args`` land on the
+    ``span_begin`` event."""
+    if not rec:
+        return NULL_SPAN
+    return Span(rec, name, annotate=annotate, args=args)
+
+
+def traced(name=None, **span_args):
+    """Decorator form: wrap every call of ``fn`` in a span against the
+    process-default recorder (resolved at call time, so recording can be
+    switched on after import). With the default NULL recorder the
+    wrapper is a plain passthrough call.
+
+        @obs.traced("partisan")
+        def _partisan_summary(...): ...
+
+    Bare ``@obs.traced`` uses the function's qualname as the span name.
+    """
+    def deco(fn, _label=None):
+        label = _label or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            rec = resolve_recorder(None)
+            if not rec:
+                return fn(*a, **kw)
+            with span(rec, label, **span_args):
+                return fn(*a, **kw)
+        return wrapper
+
+    if callable(name):          # bare @traced
+        return deco(name)
+    return lambda fn: deco(fn, name)
+
+
+def emit_span_at(rec, name, ts_begin, dur_s, parent_id=None,
+                 end_args=None, **args):
+    """Back-stamped span for work whose timing was measured earlier at a
+    point where emitting was not allowed — the board runner's chunk
+    loop, which never syncs mid-run and flushes deferred chunk telemetry
+    just before ``run_end``. Emits a matched begin/end pair with
+    explicit ``ts`` stamps (``ts_begin`` .. ``ts_begin + dur_s``);
+    ``parent_id`` defaults to the innermost span currently open on this
+    thread (the run span, still open at flush time); ``end_args`` merge
+    into the ``span_end`` event like ``Span.end(**end_args)`` would.
+    Returns the span_id, or None on a falsy recorder."""
+    if not rec:
+        return None
+    st = _state(rec)
+    if parent_id is None:
+        stack = st.stack()
+        parent_id = stack[-1].span_id if stack else None
+    sid = next(st.ids)
+    rec.emit("span_begin", ts=ts_begin, name=name, span_id=sid,
+             trace_id=st.trace_id, parent_id=parent_id, tid=st.tid(),
+             **args)
+    rec.emit("span_end", ts=ts_begin + float(dur_s), name=name,
+             span_id=sid, trace_id=st.trace_id, dur_s=float(dur_s),
+             **(end_args or {}))
+    return sid

@@ -73,6 +73,9 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
     device-side diagnostics, stats.ess_device) instead of copying each
     chunk to host."""
     rec = obs.resolve_recorder(recorder)
+    if rec:
+        fsp = obs.span(rec, "finalize", annotate=True,
+                       kernel_path="board").begin()
     state, out_last = kboard.record_final(bg, spec, params, state)
     if record_history and (n_steps - 1) % record_every == 0:
         out_last = maybe_host(out_last, history_device)
@@ -84,6 +87,8 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
     state = drain_waits(state, pending_waits)
     waits_total = _sum_pending(waits_total, pending_waits)
     history = assemble_history(hist_parts, record_history, history_device)
+    if rec:
+        fsp.end()
     return RunResult(state=state, history=history,
                      waits_total=waits_total, n_yields=n_steps)
 
@@ -128,6 +133,13 @@ def _emit_board_chunks(rec, chunk_meta, acc0, rej0, n_chains,
                  accept_rate=(acc - last_acc) / (n_chains * steps),
                  transfer_bytes=tb, hbm_history_bytes=hbm,
                  done=done, total=n_transitions, reject=reject)
+        # deferred chunk span, back-stamped over the dispatch interval
+        # [ts - wall, ts]. The run span is still open at flush time, so
+        # emit_span_at parents these under it — no live span objects
+        # were allowed mid-run (no mid-run syncs, no mid-run emits).
+        obs.emit_span_at(rec, "chunk", ts - wall, wall,
+                         kernel_path=path, steps=steps, done=done,
+                         end_args={"wall_s": wall, "reject": reject})
         last_acc = acc
     return (last_acc - acc_start) / max(n_chains * n_transitions, 1)
 
@@ -190,6 +202,10 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
         last_rej = np.asarray(rej0, np.int64).sum(axis=0)
         mon = obs.ChainMonitor(rec, total=n_transitions, path=path,
                                runner="board")
+        met = obs.MetricsRegistry()
+        run_span = obs.span(rec, "run:board", annotate=True,
+                            kernel_path=path, chains=n_chains,
+                            n_steps=n_transitions).begin()
         t_run0 = t_prev = time.perf_counter()
 
     done = 0
@@ -247,6 +263,13 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                               flips_per_s=n_chains * this
                               / max(wall, 1e-12),
                               reject=reject, done=done)
+            met.observe("chunk_wall_s", wall)
+            met.observe("flips_per_s", n_chains * this / max(wall, 1e-12))
+            met.inc("chunks")
+            met.inc("flips", n_chains * this)
+            met.inc("transfer_bytes", transfer_bytes)
+            met.set("done", done)
+            met.notify(rec)
 
     waits_total = _sum_pending(waits_total, pending_waits)
     history = assemble_history(hist_parts, record_history, history_device)
@@ -256,12 +279,18 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
         accept_rate = _emit_board_chunks(
             rec, chunk_meta, acc0, rej0, n_chains, n_transitions,
             transfer_total, hbm_bytes, path=path)
+        met.set("hbm_history_bytes", hbm_bytes)
+        snap = met.snapshot()
+        rec.emit("metrics_snapshot", counters=snap["counters"],
+                 gauges=snap["gauges"], histograms=snap["histograms"],
+                 runner="board", path=path)
         rec.emit("run_end", runner="board", path=path,
                  n_yields=n_transitions,
                  chains=n_chains, flips=flips, wall_s=wall,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=accept_rate, transfer_bytes=transfer_total,
-                 hbm_history_bytes=hbm_bytes)
+                 hbm_history_bytes=hbm_bytes, metrics=snap)
+        run_span.end(flips=flips, wall_s=wall)
         if not had_rej:
             state = state.replace(reject_count=None)
     return RunResult(state=state, history=history,

@@ -229,6 +229,10 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                     if states.reject_count is not None else None)
         mon = obs.ChainMonitor(rec, total=n_steps, path="general",
                                runner="general")
+        met = obs.MetricsRegistry()
+        run_span = obs.span(rec, "run:general", annotate=True,
+                            kernel_path="general", chains=n_chains,
+                            n_steps=n_steps).begin()
 
     if record_initial:
         states, out0 = _record_initial(dg, spec, params, states)
@@ -257,6 +261,13 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     t_prev = time.perf_counter() if rec else None
     while done < n_steps:
         this = min(chunk, n_steps - done)
+        if rec:
+            # span brackets dispatch..sync; ended below after the chunk
+            # event so compile/diag spans nest inside it. annotate=True
+            # mirrors it into jax.profiler.TraceAnnotation.
+            csp = obs.span(rec, "chunk", annotate=True,
+                           kernel_path="general", steps=this,
+                           done=done).begin()
         states, outs = _run_chunk(dg, spec, params, states, this,
                                   collect=record_history)
         if rec:
@@ -316,18 +327,32 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                               flips_per_s=flips_per_s,
                               accept_rate=accept_rate, reject=reject,
                               done=done)
+            csp.end(wall_s=wall, reject=reject)
+            met.observe("chunk_wall_s", wall)
+            met.observe("flips_per_s", flips_per_s)
+            met.inc("chunks")
+            met.inc("flips", n_chains * this)
+            met.inc("transfer_bytes", transfer_bytes)
+            met.set("done", done)
+            met.notify(rec)
 
     history = assemble_history(hist_parts, record_history, history_device)
     if rec:
         wall = time.perf_counter() - t_run0
         flips = n_chains * (n_steps - done0)
+        met.set("hbm_history_bytes", hbm_bytes)
+        snap = met.snapshot()
+        rec.emit("metrics_snapshot", counters=snap["counters"],
+                 gauges=snap["gauges"], histograms=snap["histograms"],
+                 runner="general", path="general")
         rec.emit("run_end", runner="general", path="general",
                  n_yields=n_steps,
                  chains=n_chains, flips=flips, wall_s=wall,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=(last_acc - acc_start) / max(flips, 1),
                  transfer_bytes=transfer_total,
-                 hbm_history_bytes=hbm_bytes)
+                 hbm_history_bytes=hbm_bytes, metrics=snap)
+        run_span.end(flips=flips, wall_s=wall)
     if rec and not had_rej:
         # the counters were telemetry-enabled here; hand back the
         # caller's treedef (checkpoints, downstream jits) unchanged

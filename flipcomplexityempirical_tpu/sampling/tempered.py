@@ -202,6 +202,10 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
         # health signal, not a cold-chain convergence certificate
         mon = obs.ChainMonitor(rec, total=transitions, path=path,
                                runner="tempered")
+        met = obs.MetricsRegistry()
+        run_span = obs.span(rec, "run:tempered", annotate=True,
+                            kernel_path=path, chains=c,
+                            n_steps=n_steps).begin()
     done = 0
     parity = start_parity
     if not is_board and record_initial:
@@ -216,6 +220,10 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
     while done < transitions:
         this = min(swap_every, transitions - done)
         beta_rows.append(np.asarray(params.beta, np.float32))
+        if rec:
+            csp = obs.span(rec, "chunk", annotate=True, kernel_path=path,
+                           steps=this, done=done,
+                           round=len(beta_rows) - 1).begin()
         if is_board:
             states, outs = kboard.run_board_chunk(
                 graph_handle, spec, params, states, this,
@@ -266,11 +274,22 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                               flips_per_s=flips_per_s,
                               accept_rate=accept_rate, reject=reject,
                               done=done)
+            csp.end(wall_s=wall, reject=reject)
+            met.observe("chunk_wall_s", wall)
+            met.observe("flips_per_s", flips_per_s)
+            met.inc("chunks")
+            met.inc("flips", c * this)
+            met.inc("transfer_bytes", transfer_bytes)
+            met.set("done", done)
+            met.notify(rec)
         if done < transitions or segment:
             # swaps sit BETWEEN rounds only: no trailing swap on a FULL
             # run, so the final recorded yield still belongs to
             # beta_hist's last row; a checkpoint segment DOES end with
             # its between-segment swap (the continuation's rounds follow)
+            if rec:
+                ssp = obs.span(rec, "swap_round", parity=parity,
+                               round=len(beta_rows) - 1).begin()
             key, sub = jax.random.split(key)
             rungs_now = _host_rungs(params.beta, n_rungs)
             params, acc = swap_within_batch(sub, states, params,
@@ -278,6 +297,8 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
             _accumulate_swaps(np.asarray(acc), rungs_now, n_rungs, parity,
                               attempts, accepts, n_ladders)
             parity ^= 1
+            if rec:
+                ssp.end()
 
     if rec and not had_rej:
         # drop the telemetry-enabled counters so the returned state (and
@@ -299,6 +320,10 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
     if rec:
         wall = time.perf_counter() - t_run0
         flips = c * transitions
+        snap = met.snapshot()
+        rec.emit("metrics_snapshot", counters=snap["counters"],
+                 gauges=snap["gauges"], histograms=snap["histograms"],
+                 runner="tempered", path=path)
         rec.emit("run_end", runner="tempered", path=path,
                  n_yields=n_steps,
                  chains=c, flips=flips, wall_s=wall,
@@ -307,7 +332,8 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                  transfer_bytes=transfer_total, hbm_history_bytes=0,
                  n_rounds=len(beta_rows),
                  swap_attempts=int(attempts.sum()),
-                 swap_accepts=int(accepts.sum()))
+                 swap_accepts=int(accepts.sum()), metrics=snap)
+        run_span.end(flips=flips, wall_s=wall)
 
     return TemperResult(
         state=states, history=history, waits_total=waits_total,

@@ -1,0 +1,9 @@
+"""G004 positive fixture: span/metrics emit sites off the registry."""
+
+
+def run(rec):
+    rec.emit("span_instant", name="chunk", span_id=7)  # unknown event type
+    rec.emit("span_begin", name="chunk")               # missing core fields
+    rec.emit("span_end", name="chunk", span_id=7,
+             trace_id="ab12")                          # missing dur_s
+    rec.emit("metrics_snapshot", counters={})          # missing core fields

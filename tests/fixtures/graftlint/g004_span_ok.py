@@ -1,0 +1,14 @@
+"""G004 negative fixture: schema-conforming span/metrics emit sites
+(the tracing layer's event types, as obs.trace.Span emits them)."""
+
+
+def run(rec):
+    rec.emit("span_begin", name="chunk", span_id=7, trace_id="ab12",
+             parent_id=3, tid=0, kernel_path="board")
+    rec.emit("span_end", name="chunk", span_id=7, trace_id="ab12",
+             dur_s=0.25, wall_s=0.25, reject={"proposals": 10})
+    rec.emit("metrics_snapshot", counters={"chunks": 4}, gauges={},
+             histograms={"chunk_wall_s": {"count": 4, "p50": 0.2}},
+             runner="board")
+    fields = {"name": "diag", "span_id": 9}
+    rec.emit("span_begin", **fields)    # splat: field coverage is dynamic

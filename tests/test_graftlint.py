@@ -66,6 +66,51 @@ def test_g004_catches_unknown_missing_and_dynamic():
     assert "string literal" in msgs
 
 
+def test_g004_covers_span_event_types():
+    # the registry extension for the tracing layer: span_begin/span_end/
+    # metrics_snapshot emit sites are checked like any other event
+    ok = _lint_fixture("g004_span_ok.py", "G004")
+    assert ok == [], [f.render() for f in ok]
+    msgs = "\n".join(f.message
+                     for f in _lint_fixture("g004_span_bad.py", "G004"))
+    assert "unknown event type 'span_instant'" in msgs
+    assert "missing core field" in msgs
+    assert "dur_s" in msgs
+
+
+def test_g005_covers_span_and_metrics_calls(tmp_path):
+    # an unguarded span .begin() (or metrics .notify) in a dispatching
+    # runner function is a G005 finding; the same code under `if rec:`
+    # is clean
+    body = ("def run(rec, state):\n"
+            "    sp = obs.span(rec, 'chunk')\n"
+            "    sp.begin()\n"
+            "    state = _run_chunk(state)\n"
+            "    sp.end()\n"
+            "    met.notify(rec)\n"
+            "    return state\n")
+    d = tmp_path / "sampling"
+    d.mkdir()
+    p = d / "mod.py"
+    p.write_text(body)
+    cfg = LintConfig(root=str(tmp_path), rules=frozenset({"G005"}))
+    findings = lint_file(str(p), cfg)
+    assert {f.message.split("(")[0] for f in findings} == \
+        {".span", ".begin", ".end", ".notify"}
+    guarded = ("def run(rec, state):\n"
+               "    if rec:\n"
+               "        sp = obs.span(rec, 'chunk')\n"
+               "        sp.begin()\n"
+               "    state = _run_chunk(state)\n"
+               "    if rec:\n"
+               "        sp.end()\n"
+               "        met.notify(rec)\n"
+               "    return state\n")
+    p2 = d / "mod2.py"
+    p2.write_text(guarded)
+    assert lint_file(str(p2), cfg) == []
+
+
 def test_g006_threshold_is_configurable():
     cfg = LintConfig(root=REPO, rules=frozenset({"G006"}),
                      max_test_steps=100000)

@@ -394,8 +394,10 @@ def test_chain_monitor_thinning_stays_bounded():
     assert mon._stride > 1
     assert mon._buf.shape[1] <= 64
     assert mon._n == 1000  # Welford still saw every sample
-    d = rec.events[-1]
-    assert d["event"] == "diag" and d["rhat"] is not None
+    # observe_chunk now runs under a "diag" span, so the tail of the
+    # stream is its span_end — pick the last diag event explicitly
+    d = [e for e in rec.events if e["event"] == "diag"][-1]
+    assert d["rhat"] is not None
     # white noise: ESS scaled by stride lands near the raw sample count
     assert d["ess"] > 64
 
@@ -617,3 +619,50 @@ def test_heartbeat_embeds_latest_diag(tmp_path, monkeypatch):
     assert snap["event"] == "diag" and snap["samples"] > 0
     assert seen[-1]["status"] == "running"
     assert seen[-1]["current"] == cfg.tag
+
+
+def test_heartbeat_carries_anomaly_and_metrics(tmp_path, monkeypatch):
+    """While a monitor anomaly is active, the heartbeat JSON carries
+    BOTH the per-kind anomaly tally and the latest metrics snapshot
+    (ISSUE 5 satellite): a sweep watcher sees 'sick + how slow' in one
+    read. The pop-saturation threshold is dropped below zero so the
+    first chunk's reject breakdown trips it deterministically."""
+    from flipcomplexityempirical_tpu.experiments import driver as drv
+    from flipcomplexityempirical_tpu.obs import monitor as mon_mod
+
+    orig_init = mon_mod.ChainMonitor.__init__
+
+    def tight_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        self.pop_sat_frac = -1.0  # any pop fraction (even 0.0) trips
+
+    monkeypatch.setattr(mon_mod.ChainMonitor, "__init__", tight_init)
+    seen = []
+    real = drv.write_heartbeat
+
+    def spy(hb_path, **payload):
+        seen.append(payload)
+        return real(hb_path, **payload)
+
+    monkeypatch.setattr(drv, "write_heartbeat", spy)
+    cfg = ex.ExperimentConfig(family="frank", alignment=0, base=0.3,
+                              pop_tol=0.5, total_steps=120, n_chains=2)
+    out = str(tmp_path / "plots")
+    os.makedirs(out)
+    hb = str(tmp_path / "hb.json")
+    with obs.Recorder(path=str(tmp_path / "sw.jsonl")) as rec:
+        ex.run_sweep([cfg], out, verbose=False, recorder=rec,
+                     heartbeat=hb)
+        assert rec.anomaly_hook is None and rec.metrics_hook is None
+    both = [p for p in seen if "anomalies" in p and "metrics" in p]
+    assert both, "no heartbeat refresh carried anomalies + metrics"
+    payload = both[-1]
+    tally = payload["anomalies"][cfg.tag]
+    assert tally.get("pop_bound_saturation", 0) >= 1
+    met = payload["metrics"][cfg.tag]
+    assert met["histograms"]["chunk_wall_s"]["count"] >= 1
+    assert met["counters"]["chunks"] >= 1
+    # the anomaly itself also landed in the event stream
+    events = read_events(str(tmp_path / "sw.jsonl"))
+    assert any(e["event"] == "anomaly"
+               and e["kind"] == "pop_bound_saturation" for e in events)

@@ -224,3 +224,29 @@ def test_bench_compare_disjoint_metrics_warns(tmp_path):
     r = _compare(a, b)
     assert r.returncode == 0
     assert "nothing to gate on" in r.stderr
+
+
+def _device_record(path, value, **extra):
+    doc = {"parsed": {"metric": "flips_per_sec_total", "value": value},
+           **extra}
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def test_bench_compare_refuses_cross_device_gate(tmp_path):
+    """A TPU record vs a CPU-fallback record: the -92% 'regression' is a
+    setup difference, so the tolerance gate is refused (exit 0) with an
+    explicit incomparable-devices note; the delta table still prints."""
+    a = _device_record(tmp_path / "a.json", 1000.0, device="tpu-v4")
+    b = _device_record(tmp_path / "b.json", 80.0, device="cpu",
+                       cpu_fallback=True)
+    r = _compare(a, b)
+    assert r.returncode == 0, r.stderr
+    assert "incomparable devices" in r.stderr
+    assert "flips_per_sec_total" in r.stdout  # table still rendered
+    # same tags on both sides: the gate applies again
+    a2 = _device_record(tmp_path / "a2.json", 1000.0, device="tpu-v4")
+    b2 = _device_record(tmp_path / "b2.json", 80.0, device="tpu-v4")
+    r = _compare(a2, b2)
+    assert r.returncode == 1
+    assert "incomparable" not in r.stderr

@@ -1,0 +1,501 @@
+"""Tracing + metrics subsystem (obs/trace.py, obs/metrics.py) and its
+tooling (tools/trace_export.py, obs_report Timing section, the
+obs-check CI gate): span pairing/nesting over real runner streams,
+Chrome trace-event export structure, histogram percentiles, gzip
+sinks, and the NullRecorder zero-span contract."""
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT = os.path.join(REPO, "tools", "obs_report.py")
+EXPORT = os.path.join(REPO, "tools", "trace_export.py")
+SMOKE = os.path.join(REPO, "tests", "fixtures", "obs",
+                     "events_smoke.jsonl")
+
+
+def read_events(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class _Cap:
+    """Truthy in-memory recorder capturing emitted events."""
+
+    diag_hook = anomaly_hook = metrics_hook = None
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, ts=None, **fields):
+        e = {"event": event, "ts": 0.0 if ts is None else ts, **fields}
+        self.events.append(e)
+        return e
+
+
+# ----------------------------------------------------------- span basics
+
+
+def test_span_context_manager_pairs_and_nests():
+    rec = _Cap()
+    with obs.span(rec, "outer", tag="t1"):
+        with obs.span(rec, "inner"):
+            pass
+    kinds = [(e["event"], e["name"]) for e in rec.events]
+    assert kinds == [("span_begin", "outer"), ("span_begin", "inner"),
+                     ("span_end", "inner"), ("span_end", "outer")]
+    outer_b, inner_b, inner_e, outer_e = rec.events
+    assert outer_b["parent_id"] is None
+    assert inner_b["parent_id"] == outer_b["span_id"]
+    assert inner_b["trace_id"] == outer_b["trace_id"]
+    assert outer_b["tag"] == "t1"
+    assert inner_e["dur_s"] >= 0.0 and outer_e["dur_s"] >= inner_e["dur_s"]
+    assert obs.validate_spans(rec.events) == []
+
+
+def test_span_explicit_begin_end_args():
+    rec = _Cap()
+    sp = obs.span(rec, "run:x", kernel_path="board").begin()
+    sp.end(flips=100, wall_s=0.5)
+    b, e = rec.events
+    assert b["kernel_path"] == "board"
+    assert e["flips"] == 100 and e["wall_s"] == 0.5
+    # single-use: a second end is a no-op, not a duplicate emission
+    sp.end()
+    assert len(rec.events) == 2
+
+
+def test_span_error_exit_tags_end():
+    rec = _Cap()
+    with pytest.raises(RuntimeError):
+        with obs.span(rec, "boom"):
+            raise RuntimeError("x")
+    assert rec.events[-1]["event"] == "span_end"
+    assert rec.events[-1]["error"] == "RuntimeError"
+    assert obs.validate_spans(rec.events) == []
+
+
+def test_null_recorder_emits_zero_spans():
+    """The hot-path contract: with no recorder, span() hands back a
+    falsy shared no-op and nothing is emitted anywhere."""
+    sp = obs.span(None, "anything")
+    assert not sp
+    assert sp is obs.span(obs.NULL, "other")  # shared singleton
+    with sp:
+        pass
+    sp.begin().end()
+    sp.set_args(x=1)
+
+
+def test_traced_decorator():
+    rec = _Cap()
+
+    @obs.traced("work", flavor="unit")
+    def f(x):
+        return x + 1
+
+    assert f(5) == 6  # default recorder is NULL: pure passthrough
+    assert rec.events == []
+    prev = obs.set_default_recorder(rec)
+    try:
+        assert f(1) == 2  # resolved at call time, not decoration time
+    finally:
+        obs.set_default_recorder(prev)
+    names = [(e["event"], e["name"]) for e in rec.events]
+    assert names == [("span_begin", "work"), ("span_end", "work")]
+    assert rec.events[0]["flavor"] == "unit"
+
+    @obs.traced
+    def bare():
+        return 7
+
+    assert bare() == 7  # bare form: qualname label, passthrough on NULL
+
+
+def test_emit_span_at_backstamps_and_parents():
+    rec = _Cap()
+    with obs.span(rec, "run"):
+        obs.emit_span_at(rec, "chunk", 100.0, 0.25, kernel_path="board",
+                         end_args={"reject": {"proposals": 10}})
+    run_b = rec.events[0]
+    chunk_b = next(e for e in rec.events
+                   if e["event"] == "span_begin" and e["name"] == "chunk")
+    chunk_e = next(e for e in rec.events
+                   if e["event"] == "span_end" and e["name"] == "chunk")
+    assert chunk_b["ts"] == 100.0 and chunk_e["ts"] == 100.25
+    assert chunk_e["dur_s"] == 0.25
+    assert chunk_b["parent_id"] == run_b["span_id"]  # stack top = run
+    assert chunk_b["kernel_path"] == "board"
+    assert chunk_e["reject"] == {"proposals": 10}
+    assert obs.validate_spans(rec.events) == []
+
+
+# ---------------------------------------------------- validate_spans gate
+
+
+def _sb(sid, name, parent=None):
+    return {"event": "span_begin", "span_id": sid, "name": name,
+            "parent_id": parent, "trace_id": "t", "ts": 0.0}
+
+
+def _se(sid, name):
+    return {"event": "span_end", "span_id": sid, "name": name,
+            "trace_id": "t", "ts": 1.0, "dur_s": 1.0}
+
+
+def test_validate_spans_failure_modes():
+    assert obs.validate_spans([_sb(1, "a"), _se(1, "a")]) == []
+    # never closed
+    assert any("never closed" in m
+               for m in obs.validate_spans([_sb(1, "a")]))
+    # end without begin
+    assert any("no open begin" in m
+               for m in obs.validate_spans([_se(9, "a")]))
+    # id reuse
+    errs = obs.validate_spans(
+        [_sb(1, "a"), _se(1, "a"), _sb(1, "b"), _se(1, "b")])
+    assert any("reuses" in m for m in errs)
+    # orphan parent
+    assert any("not open" in m
+               for m in obs.validate_spans(
+                   [_sb(2, "kid", parent=7), _se(2, "kid")]))
+    # name mismatch
+    assert any("!=" in m
+               for m in obs.validate_spans([_sb(1, "a"), _se(1, "b")]))
+    # parent closes while child open
+    errs = obs.validate_spans(
+        [_sb(1, "p"), _sb(2, "c", parent=1), _se(1, "p"), _se(2, "c")])
+    assert any("still open" in m for m in errs)
+
+
+# ------------------------------------------------------- metrics registry
+
+
+def test_histogram_percentiles():
+    h = obs.Histogram()
+    for v in [1.0] * 50 + [10.0] * 45 + [100.0] * 5:
+        h.observe(v)
+    s = h.snapshot()
+    assert s["count"] == 100 and s["min"] == 1.0 and s["max"] == 100.0
+    assert s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+    assert s["p50"] < 10.0 * 1.5   # the p50 lands in the low buckets
+    assert s["p99"] > 10.0         # the p99 sees the tail
+    assert abs(s["mean"] - (50 + 450 + 500) / 100) < 1e-9
+
+
+def test_histogram_clamps_and_empty():
+    h = obs.Histogram()
+    assert h.snapshot()["count"] == 0
+    assert h.percentile(0.5) is None
+    h.observe(5.0)
+    s = h.snapshot()
+    assert s["p50"] == 5.0 == s["p99"]  # clamped into [min, max]
+
+
+def test_metrics_registry_snapshot_and_emit():
+    met = obs.MetricsRegistry()
+    met.inc("chunks")
+    met.inc("flips", 100)
+    met.set("done", 50)
+    met.observe("chunk_wall_s", 0.1)
+    met.observe("chunk_wall_s", 0.3)
+    snap = met.snapshot()
+    assert snap["counters"] == {"chunks": 1, "flips": 100}
+    assert snap["gauges"] == {"done": 50}
+    assert snap["histograms"]["chunk_wall_s"]["count"] == 2
+    rec = _Cap()
+    met.emit_snapshot(rec, runner="general")
+    e = rec.events[-1]
+    assert e["event"] == "metrics_snapshot" and e["runner"] == "general"
+    assert e["histograms"]["chunk_wall_s"]["count"] == 2
+    # notify drives the metrics_hook (heartbeat wiring), tolerantly
+    seen = []
+    rec.metrics_hook = lambda s: seen.append(s)
+    met.notify(rec)
+    assert seen and seen[0]["counters"]["chunks"] == 1
+    rec.metrics_hook = lambda s: 1 / 0
+    met.notify(rec)  # hook failure must not propagate
+
+
+# -------------------------------------------- real runner span streams
+
+
+def _grid_setup(n=8):
+    g = fce.graphs.square_grid(n, n)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    return g, plan, spec
+
+
+def test_run_chains_span_stream(tmp_path):
+    """Acceptance: a real general-path run emits a span stream with
+    matched begin/end, correct parent nesting, chunk spans tagged with
+    kernel_path, and a metrics_snapshot embedded in run_end."""
+    g, plan, spec = _grid_setup()
+    dg, st, params = fce.init_batch(g, plan, n_chains=4, seed=0,
+                                    spec=spec, base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "run.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.run_chains(dg, spec, params, st, n_steps=101, chunk=25,
+                       recorder=rec)
+    events = read_events(path)
+    assert obs.validate_spans(events) == []
+    begins = [e for e in events if e["event"] == "span_begin"]
+    ends = [e for e in events if e["event"] == "span_end"]
+    assert len(begins) == len(ends) > 0
+    run_b = next(b for b in begins if b["name"] == "run:general")
+    assert run_b["kernel_path"] == "general" and run_b["chains"] == 4
+    chunk_bs = [b for b in begins if b["name"] == "chunk"]
+    assert len(chunk_bs) == 4  # one per executed chunk
+    for b in chunk_bs:
+        assert b["kernel_path"] == "general"
+        assert b["parent_id"] == run_b["span_id"]
+    run_e = next(e for e in ends if e["name"] == "run:general")
+    assert run_e["flips"] > 0 and run_e["wall_s"] > 0
+    chunk_es = [e for e in ends if e["name"] == "chunk"]
+    assert all("reject" in e and e["wall_s"] > 0 for e in chunk_es)
+    snaps = [e for e in events if e["event"] == "metrics_snapshot"]
+    assert len(snaps) == 1
+    hists = snaps[0]["histograms"]
+    assert hists["chunk_wall_s"]["count"] == 4
+    assert hists["flips_per_s"]["p50"] is not None
+    end = next(e for e in events if e["event"] == "run_end")
+    assert end["metrics"]["counters"]["chunks"] == 4
+
+
+def test_run_board_span_stream_backstamped(tmp_path):
+    """Board fast path: chunk spans are deferred (emitted at the run-end
+    flush, back-stamped over the dispatch interval) yet still pair, nest
+    under the run span, and carry the kernel path tag."""
+    g, plan, spec = _grid_setup()
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=4, seed=0, spec=spec, base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "board.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.sampling.run_board(bg, spec, params, st, n_steps=101,
+                               chunk=25, recorder=rec)
+    events = read_events(path)
+    assert obs.validate_spans(events) == []
+    begins = [e for e in events if e["event"] == "span_begin"]
+    run_b = next(b for b in begins if b["name"] == "run:board")
+    chunk_bs = [b for b in begins if b["name"] == "chunk"]
+    assert len(chunk_bs) == 4
+    for b in chunk_bs:
+        assert b["parent_id"] == run_b["span_id"]
+        assert b["kernel_path"] == run_b["kernel_path"]
+    # back-stamped: chunk begins carry timestamps before their emission
+    # point (the run_end flush), i.e. before the run span's end ts
+    run_e_ts = next(e["ts"] for e in events
+                    if e["event"] == "span_end"
+                    and e["name"] == "run:board")
+    assert all(b["ts"] <= run_e_ts for b in chunk_bs)
+    assert any(b["name"] == "finalize" for b in begins)
+
+
+def test_run_tempered_span_stream(tmp_path):
+    g, plan, spec = _grid_setup(6)
+    handle, st, params = fce.sampling.init_tempered(
+        g, plan, betas=(1.0, 0.5), n_ladders=2, seed=0, spec=spec,
+        base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "t.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.sampling.run_tempered(handle, spec, params, st, n_steps=41,
+                                  betas=(1.0, 0.5), n_ladders=2,
+                                  swap_every=10, recorder=rec)
+    events = read_events(path)
+    assert obs.validate_spans(events) == []
+    begins = [e for e in events if e["event"] == "span_begin"]
+    run_b = next(b for b in begins if b["name"] == "run:tempered")
+    assert [b["round"] for b in begins if b["name"] == "chunk"] \
+        == [0, 1, 2, 3]
+    swaps = [b for b in begins if b["name"] == "swap_round"]
+    assert len(swaps) == 3  # no swap follows the final round
+    assert all(b["parent_id"] == run_b["span_id"] for b in swaps)
+
+
+def test_null_recorder_run_emits_nothing(tmp_path):
+    """recorder=None through a full run: zero events anywhere (the
+    existing parity test proves the walk is identical; this one proves
+    the tracing layer adds no stream side channel)."""
+    g, plan, spec = _grid_setup(6)
+    dg, st, params = fce.init_batch(g, plan, n_chains=2, seed=0,
+                                    spec=spec, base=1.3, pop_tol=0.4)
+    prev = obs.set_default_recorder(obs.NULL)
+    try:
+        fce.run_chains(dg, spec, params, st, n_steps=26, chunk=25,
+                       recorder=None)
+    finally:
+        obs.set_default_recorder(prev)
+    assert obs.NULL.n_emitted == 0
+
+
+# --------------------------------------------------- gzip + per-host I/O
+
+
+def test_recorder_gzip_sink_roundtrip(tmp_path):
+    path = str(tmp_path / "ev.jsonl.gz")
+    with obs.Recorder(path=path) as rec:
+        with obs.span(rec, "outer"):
+            rec.emit("error", message="inside")
+    events = read_events(path)
+    assert [e["event"] for e in events] == ["span_begin", "error",
+                                            "span_end"]
+    assert obs.validate_spans(events) == []
+    # both tools read the gzip sink directly
+    r = subprocess.run([sys.executable, REPORT, "--check", path],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run([sys.executable, EXPORT, "--validate", path],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_per_host_path_rewriting():
+    assert obs.per_host_path("ev.jsonl", index=3) == "ev.host3.jsonl"
+    assert obs.per_host_path("ev.jsonl.gz", index=1) == \
+        "ev.host1.jsonl.gz"
+    assert obs.per_host_path("/a/b/events", index=0) == \
+        "/a/b/events.host0"
+
+
+# ------------------------------------------------------ tools: export
+
+
+def test_trace_export_smoke_fixture(tmp_path):
+    """Acceptance: the fixture stream converts to structurally valid
+    Chrome trace-event JSON — matched X slices, children contained in
+    their parents, chunk slices tagged with kernel_path."""
+    out = str(tmp_path / "t.trace.json")
+    r = subprocess.run([sys.executable, EXPORT, SMOKE, "-o", out],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 10  # one slice per span pair in the fixture
+    for e in xs:
+        assert e["dur"] >= 0 and e["ts"] >= 0
+        assert {"name", "pid", "tid", "args"} <= set(e)
+    by_name = {e["name"]: e for e in xs if e["name"] != "chunk"}
+    sweep = by_name["sweep"]
+    run = by_name["run:board"]
+    s0, s1 = sweep["ts"], sweep["ts"] + sweep["dur"]
+    assert s0 <= run["ts"] and run["ts"] + run["dur"] <= s1
+    for c in (e for e in xs if e["name"] == "chunk"):
+        assert c["args"]["kernel_path"] == "lowered"
+        assert run["ts"] <= c["ts"]
+        assert c["ts"] + c["dur"] <= run["ts"] + run["dur"] + 1e-6
+    # markers and counters came through
+    assert any(e["ph"] == "i" and "anomaly" in e["name"] for e in evs)
+    assert any(e["ph"] == "C" for e in evs)
+
+
+def test_trace_export_real_run_roundtrip(tmp_path):
+    """sec11-style acceptance path: record a real run with --events,
+    export, and get a valid nested trace."""
+    g, plan, spec = _grid_setup(6)
+    dg, st, params = fce.init_batch(g, plan, n_chains=2, seed=0,
+                                    spec=spec, base=1.3, pop_tol=0.4)
+    path = str(tmp_path / "real.jsonl")
+    with obs.Recorder(path=path) as rec:
+        fce.run_chains(dg, spec, params, st, n_steps=51, chunk=25,
+                       recorder=rec)
+    r = subprocess.run([sys.executable, EXPORT, "--validate", path],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out = str(tmp_path / "real.trace.json")
+    r = subprocess.run([sys.executable, EXPORT, path, "-o", out],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} >= {"run:general", "chunk"}
+
+
+def test_trace_export_validate_rejects_broken_spans(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 1, "ts": 1.0, "event": "span_begin",
+                            "name": "a", "span_id": 1, "trace_id": "t",
+                            "parent_id": None}) + "\n")
+    r = subprocess.run([sys.executable, EXPORT, "--validate", path],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "never closed" in r.stderr
+
+
+def test_trace_export_merges_hosts(tmp_path):
+    """Per-host files land under distinct pids parsed from the host<K>
+    filename convention."""
+    for k in (0, 1):
+        p = str(tmp_path / f"ev.host{k}.jsonl")
+        with obs.Recorder(path=p) as rec:
+            with obs.span(rec, "run:board", kernel_path="board"):
+                pass
+    out = str(tmp_path / "merged.trace.json")
+    r = subprocess.run(
+        [sys.executable, EXPORT, str(tmp_path / "ev.host0.jsonl"),
+         str(tmp_path / "ev.host1.jsonl"), "-o", out],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert pids == {0, 1}
+    names = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {n["pid"] for n in names} == {0, 1}
+
+
+# ------------------------------------------------- tools: report + gate
+
+
+def test_obs_report_timing_section(tmp_path):
+    """Acceptance: the report over a span-bearing stream prints the
+    Timing section with per-phase totals and p50/p95/p99 chunk
+    latency."""
+    r = subprocess.run([sys.executable, REPORT, SMOKE],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "## Timing" in r.stdout
+    assert "Per-phase breakdown" in r.stdout
+    assert "Slowest spans" in r.stdout
+    assert "Histogram percentiles" in r.stdout
+    assert "chunk_wall_s" in r.stdout
+    assert "| run | runner | metric | count | p50 | p95 | p99 |" \
+        in r.stdout
+
+
+def test_obs_report_check_gates_span_nesting(tmp_path):
+    path = str(tmp_path / "orphan.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 1, "ts": 1.0, "event": "span_begin",
+                            "name": "kid", "span_id": 2, "trace_id": "t",
+                            "parent_id": 99}) + "\n")
+        f.write(json.dumps({"v": 1, "ts": 2.0, "event": "span_end",
+                            "name": "kid", "span_id": 2, "trace_id": "t",
+                            "dur_s": 1.0}) + "\n")
+    r = subprocess.run([sys.executable, REPORT, "--check", path],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "span" in r.stderr and "not open" in r.stderr
+
+
+def test_ci_obs_gate_passes():
+    """make obs-check: graftlint + schema/span gate + export validation
+    over the committed fixture stream, as one script."""
+    r = subprocess.run(["bash", os.path.join(REPO, "tools", "ci_obs.sh")],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "obs-check: OK" in r.stdout

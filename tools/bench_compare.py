@@ -11,7 +11,14 @@ the same configuration matches across records). Prints a delta table
 and exits nonzero when any metric present in BOTH records regressed by
 more than ``--tolerance`` (a fraction: 0.05 = 5%), so a bench wrapper
 can gate on throughput drift between rounds the way obs_report.py
---check gates on stream shape. Stdlib-only.
+--check gates on stream shape.
+
+Gating only applies when the two records measured the same hardware:
+when their device tags differ (``device`` fields anywhere in the
+walked blocks, or a truthy ``cpu_fallback`` marker), the delta table
+still prints but the tolerance gate is refused — an "incomparable
+devices" note and exit 0, because a TPU-vs-CPU-fallback "regression"
+is a config problem, not a perf one. Stdlib-only.
 """
 
 from __future__ import annotations
@@ -63,6 +70,40 @@ def extract_metrics(doc, out: dict | None = None) -> dict:
     return out
 
 
+def device_tags(doc, out: set | None = None) -> set:
+    """The set of device identities a bench record claims to have
+    measured: every string ``device`` value plus a ``cpu_fallback``
+    marker when any truthy ``cpu_fallback`` field appears. Walks the
+    same blocks (parsed/results/metrics + embedded tail JSON) as
+    extract_metrics, so anything that contributed a metric also
+    contributes its device tag."""
+    if out is None:
+        out = set()
+    if isinstance(doc, dict):
+        dev = doc.get("device")
+        if isinstance(dev, str) and dev:
+            out.add(dev)
+        if doc.get("cpu_fallback"):
+            out.add("cpu_fallback")
+        for key in ("parsed", "results", "metrics"):
+            if key in doc:
+                device_tags(doc[key], out)
+        tail = doc.get("tail")
+        if isinstance(tail, str):
+            for line in tail.splitlines():
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    device_tags(json.loads(line), out)
+                except ValueError:
+                    pass
+    elif isinstance(doc, list):
+        for item in doc:
+            device_tags(item, out)
+    return out
+
+
 def compare(a: dict, b: dict, tolerance: float, out=sys.stdout):
     """Print the delta table; return the list of regressed metric names.
     Higher is better (every extracted metric is a throughput)."""
@@ -103,14 +144,27 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     with open(args.old, encoding="utf-8") as f:
-        a = extract_metrics(json.load(f))
+        doc_a = json.load(f)
     with open(args.new, encoding="utf-8") as f:
-        b = extract_metrics(json.load(f))
+        doc_b = json.load(f)
+    a, b = extract_metrics(doc_a), extract_metrics(doc_b)
 
     common = set(a) & set(b)
     if not common:
         print("bench_compare: no metric appears in both records — "
               "nothing to gate on", file=sys.stderr)
+        return 0
+
+    tags_a, tags_b = device_tags(doc_a), device_tags(doc_b)
+    if tags_a != tags_b:
+        # different hardware (or one fell back to CPU): the deltas are
+        # still worth eyeballing, but gating on them would turn a setup
+        # difference into a fake perf regression
+        compare(a, b, args.tolerance)
+        print("bench_compare: incomparable devices "
+              f"(A={sorted(tags_a) or ['?']}, "
+              f"B={sorted(tags_b) or ['?']}) — refusing --tolerance "
+              "gate", file=sys.stderr)
         return 0
 
     regressed = compare(a, b, args.tolerance)

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Observability CI gate (`make obs-check`): the three static checks that
+# everything emitting telemetry must pass, run against the committed
+# fixture stream so the gate itself needs no jax and no device.
+#
+#   1. graftlint over the package + tools (G004 emit conformance, G005
+#      NullRecorder purity, ...; must be clean against the committed
+#      empty baseline)
+#   2. obs_report --check: schema + span pairing/nesting gate
+#   3. trace_export --validate: the same stream must convert to a
+#      Chrome trace (Perfetto) without violations
+#
+#   tools/ci_obs.sh [EVENTS.jsonl]     # default: the smoke fixture
+#
+# Exercised by tests/test_tools.py, so tier-1 fails when any gate rots.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+STREAM="${1:-tests/fixtures/obs/events_smoke.jsonl}"
+PY="${PYTHON:-python}"
+
+"$PY" -m tools.graftlint flipcomplexityempirical_tpu tools
+"$PY" tools/obs_report.py --check "$STREAM"
+"$PY" tools/trace_export.py --validate "$STREAM"
+echo "obs-check: OK ($STREAM)"

@@ -10,11 +10,14 @@ guard.
 
 Statically: in ``sampling/`` modules, inside any function that performs
 a device dispatch (calls one of DISPATCH_NAMES), every obs call —
-``.emit`` / ``.observe_chunk`` / ``.poll`` — must be lexically nested
-under an ``if`` whose test mentions a recorder-ish name (``rec``,
-``recorder``, or anything assigned from ``resolve_recorder``).
-Functions that never dispatch (deferred emitters like
-``_emit_board_chunks``, which run after the run-end sync) are exempt.
+``.emit`` / ``.observe_chunk`` / ``.poll``, plus the tracing layer's
+``.span`` / ``.begin`` / ``.end`` / ``.emit_span_at`` and the metrics
+registry's ``.notify`` — must be lexically nested under an ``if`` whose
+test mentions a recorder-ish name (``rec``, ``recorder``, or anything
+assigned from ``resolve_recorder``). Span objects are cheap but their
+begin/end EMIT, so they fall under the same guard. Functions that never
+dispatch (deferred emitters like ``_emit_board_chunks``, which run
+after the run-end sync) are exempt.
 """
 
 from __future__ import annotations
@@ -30,7 +33,9 @@ DISPATCH_NAMES = frozenset({
     "_run_chunk", "run_board_chunk", "run_board_chunk_pallas",
     "_record_initial", "record_final", "exchange_step",
 })
-OBS_METHODS = frozenset({"emit", "observe_chunk", "poll"})
+OBS_METHODS = frozenset({"emit", "observe_chunk", "poll",
+                         "span", "begin", "end", "emit_span_at",
+                         "notify"})
 _RECORDERISH = frozenset({"rec", "recorder"})
 
 

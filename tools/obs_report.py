@@ -14,24 +14,31 @@ profiler. Runs whose stream ends without a run_end (crash / still in
 flight) get partial totals synthesized from their chunk events, marked
 with a trailing ``*``. A Health section renders the in-flight monitor's
 output: anomaly events, the kernel reject-reason breakdown per path,
-and each run's R-hat trajectory from its ``diag`` stream. A trailing
-sweep section summarizes driver progress events.
+and each run's R-hat trajectory from its ``diag`` stream. A Timing
+section renders the tracing subsystem's output (obs.trace spans +
+obs.metrics snapshots): per-phase wall-clock breakdown, the slowest
+individual spans, and each run's p50/p95/p99 chunk-latency and flips/s
+histograms. A trailing sweep section summarizes driver progress events.
 
 ``--check`` validates every line against the event schema
-(obs.events.EVENT_FIELDS envelope + per-type core fields) and exits
-nonzero listing each malformed/unknown event — the CI gate on anything
-that emits telemetry. It also prints the grandfathered-finding count
-from the committed ``graftlint_baseline.json`` so static-analysis debt
-is visible in the same report (target: 0). ``--strict`` additionally exits nonzero (after
+(obs.events.EVENT_FIELDS envelope + per-type core fields) AND the span
+pairing/nesting contract (obs.events.validate_spans: every begin
+closed, no orphan parents, no id reuse), and exits nonzero listing each
+violation — the CI gate on anything that emits telemetry. It also
+prints the grandfathered-finding count from the committed
+``graftlint_baseline.json`` so static-analysis debt is visible in the
+same report (target: 0). ``--strict`` additionally exits nonzero (after
 printing the report) when the stream carries any ``anomaly`` events —
 the CI gate on chain HEALTH rather than stream shape. Stdlib-only: the
 schema module is loaded by file path, so neither gate needs jax (or any
-package import) at all.
+package import) at all. ``.jsonl.gz`` streams (obs.Recorder gzip sinks)
+are read transparently.
 """
 
 from __future__ import annotations
 
 import argparse
+import gzip
 import importlib.util
 import json
 import os
@@ -69,11 +76,21 @@ def graftlint_baseline_count(path: str = _GRAFTLINT_BASELINE):
     return len(findings) if isinstance(findings, list) else None
 
 
+def _open_text(path: str):
+    """Open an event stream for reading, gunzipping transparently when
+    the path carries the Recorder's ``.gz`` sink suffix."""
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt", encoding="utf-8")
+    return open(path, encoding="utf-8")
+
+
 def check(path: str, schema) -> int:
-    """Validate every line; print one diagnostic per bad line; return
-    the number of bad lines (the exit code driver)."""
+    """Validate every line against the schema, then the parsed stream
+    against the span pairing/nesting contract; print one diagnostic per
+    violation; return the violation count (the exit code driver)."""
     bad = n = 0
-    with open(path, encoding="utf-8") as f:
+    parsed = []
+    with _open_text(path) as f:
         for lineno, line in enumerate(f, 1):
             if not line.strip():
                 continue
@@ -82,23 +99,33 @@ def check(path: str, schema) -> int:
             if err is not None:
                 bad += 1
                 print(f"{path}:{lineno}: {err}", file=sys.stderr)
+            else:
+                parsed.append(json.loads(line))
+    span_errors = schema.validate_spans(parsed)
+    for err in span_errors:
+        print(f"{path}: span contract: {err}", file=sys.stderr)
+    n_spans = sum(1 for e in parsed if e["event"] == "span_begin")
     if bad:
         print(f"{path}: {bad}/{n} events failed schema "
               f"v{schema.SCHEMA_VERSION}", file=sys.stderr)
-    else:
-        print(f"{path}: ok ({n} events, schema v{schema.SCHEMA_VERSION})")
+    if span_errors:
+        print(f"{path}: {len(span_errors)} span nesting violation(s)",
+              file=sys.stderr)
+    if not bad and not span_errors:
+        print(f"{path}: ok ({n} events, {n_spans} spans, "
+              f"schema v{schema.SCHEMA_VERSION})")
     grandfathered = graftlint_baseline_count()
     if grandfathered is not None:
         print(f"graftlint baseline: {grandfathered} grandfathered "
               "finding(s)")
-    return bad
+    return bad + len(span_errors)
 
 
 def load_events(path: str, schema):
     """Parse the stream, tolerating (and counting) malformed lines —
     a report over a crashed run's partial stream must still render."""
     events, bad = [], 0
-    with open(path, encoding="utf-8") as f:
+    with _open_text(path) as f:
         for line in f:
             if not line.strip():
                 continue
@@ -125,7 +152,7 @@ def fold_runs(events) -> list[dict]:
         if kind == "run_start":
             open_run = {"start": e, "chunks": [], "compiles": 0,
                         "transfers": 0, "diags": [], "anomalies": [],
-                        "end": None}
+                        "metrics": None, "end": None}
             runs.append(open_run)
         elif open_run is not None:
             if kind == "chunk":
@@ -138,6 +165,8 @@ def fold_runs(events) -> list[dict]:
                 open_run["diags"].append(e)
             elif kind == "anomaly":
                 open_run["anomalies"].append(e)
+            elif kind == "metrics_snapshot":
+                open_run["metrics"] = e
             elif kind == "run_end":
                 open_run["end"] = e
                 open_run = None
@@ -323,6 +352,91 @@ def report_health(events, runs, out):
                   file=out)
 
 
+_SPAN_ENVELOPE = {"v", "ts", "event", "name", "span_id", "trace_id",
+                  "parent_id", "tid", "dur_s"}
+
+
+def _pair_spans(events):
+    """Match span_begin/span_end by span_id, stream order. Returns
+    (begin, end) pairs; unclosed spans (crash / in flight) are dropped
+    — ``--check`` is where they get reported, not the timing tables."""
+    pairs, open_spans = [], {}
+    for e in events:
+        kind = e["event"]
+        if kind == "span_begin":
+            open_spans[e.get("span_id")] = e
+        elif kind == "span_end":
+            b = open_spans.pop(e.get("span_id"), None)
+            if b is not None:
+                pairs.append((b, e))
+    return pairs
+
+
+def report_timing(events, runs, out):
+    """The tracing subsystem's section: per-phase wall-clock breakdown
+    (spans grouped by name), the slowest individual spans with their
+    tags, and each run's chunk-latency / flips-per-second histogram
+    percentiles from its metrics snapshot. Rendered only when the
+    stream carries spans or metrics at all (older streams stay
+    byte-identical)."""
+    pairs = _pair_spans(events)
+    metric_runs = []
+    for i, r in enumerate(runs):
+        hists = None
+        end = r["end"]
+        if end is not None and isinstance(end.get("metrics"), dict):
+            hists = end["metrics"].get("histograms")
+        if not hists and r["metrics"] is not None:
+            hists = r["metrics"].get("histograms")
+        if hists:
+            metric_runs.append((i, r, hists))
+    if not pairs and not metric_runs:
+        return
+
+    print("\n## Timing", file=out)
+    if pairs:
+        per: dict = {}
+        for b, e in pairs:
+            agg = per.setdefault(b.get("name", "?"), [0, 0.0, 0.0])
+            dur = e.get("dur_s") or 0.0
+            agg[0] += 1
+            agg[1] += dur
+            agg[2] = max(agg[2], dur)
+        print("### Per-phase breakdown", file=out)
+        print("| span | count | total_s | mean_s | max_s |", file=out)
+        print("|---|---|---|---|---|", file=out)
+        for name, (count, total, mx) in sorted(
+                per.items(), key=lambda kv: -kv[1][1]):
+            print(f"| {name} | {count} | {total:.3f} "
+                  f"| {total / count:.4f} | {mx:.3f} |", file=out)
+
+        t0 = events[0]["ts"]
+        top = sorted(pairs, key=lambda p: -(p[1].get("dur_s") or 0.0))[:8]
+        print("\n### Slowest spans", file=out)
+        print("| span | dur_s | t+s | args |", file=out)
+        print("|---|---|---|---|", file=out)
+        for b, e in top:
+            args = ", ".join(f"{k}={v}" for k, v in sorted(b.items())
+                             if k not in _SPAN_ENVELOPE)
+            print(f"| {b.get('name', '?')} "
+                  f"| {e.get('dur_s', 0.0):.3f} | {b['ts'] - t0:.1f} "
+                  f"| {args or '-'} |", file=out)
+
+    if metric_runs:
+        print("\n### Histogram percentiles", file=out)
+        print("| run | runner | metric | count | p50 | p95 | p99 |",
+              file=out)
+        print("|---|---|---|---|---|---|---|", file=out)
+        for i, r, hists in metric_runs:
+            for mname in sorted(hists):
+                h = hists[mname]
+                cells = " | ".join(
+                    "-" if h.get(q) is None else format(h[q], ".4g")
+                    for q in ("p50", "p95", "p99"))
+                print(f"| {i} | {r['start']['runner']} | {mname} "
+                      f"| {h.get('count', 0)} | {cells} |", file=out)
+
+
 def report_sweep(events, out):
     sweep = [e for e in events if e["event"] == "sweep_config"]
     errors = [e for e in events if e["event"] == "error"]
@@ -385,6 +499,7 @@ def main(argv=None):
     if runs:
         report_runs(runs, out)
     report_health(events, runs, out)
+    report_timing(events, runs, out)
     report_sweep(events, out)
     if args.strict:
         n_anom = sum(1 for e in events if e["event"] == "anomaly")
@@ -396,4 +511,9 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # report | head is a normal way to skim a long stream summary
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Export an obs telemetry stream's spans to Chrome trace-event JSON.
+
+    python tools/trace_export.py EVENTS.jsonl                # -> EVENTS.trace.json
+    python tools/trace_export.py -o run.trace.json E1 E2 ...  # merge hosts
+    python tools/trace_export.py --validate EVENTS.jsonl      # gate only
+
+The output opens directly in Perfetto (ui.perfetto.dev) or
+chrome://tracing: one timeline row per (host, thread), sweep → config →
+run → chunk nesting visible as stacked slices, compile/anomaly/error
+markers as instants, and a flips/s counter track per run. Span pairs
+become "X" (complete) events — begin timestamp plus duration, immune to
+the B/E ordering pitfalls — with each child's interval clamped into its
+parent's so clock jitter between a span's wall-clock stamp and its
+monotonic duration never renders an impossible overhang.
+
+Multiple input files merge into one trace: each file becomes a Chrome
+``pid``, parsed from the ``events.host<K>.jsonl`` per-host naming that
+``distribute.sharded.host_recorder`` writes (falling back to the file's
+position on the command line), so a multi-host run's per-host streams
+land side by side under named process groups. ``.jsonl.gz`` sinks are
+read transparently.
+
+``--validate`` runs the same schema gate as ``obs_report.py --check``
+plus the span pairing/nesting contract (every begin closed, no orphan
+parents, no id reuse) and exits nonzero listing each violation, without
+writing anything — the CI hook for "this stream will render".
+Stdlib-only: the schema module is loaded by file path, so neither mode
+imports jax (or any package) at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import importlib.util
+import json
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_EVENTS_PY = os.path.join(_HERE, os.pardir, "flipcomplexityempirical_tpu",
+                          "obs", "events.py")
+
+_SPAN_ENVELOPE = {"v", "ts", "event", "name", "span_id", "trace_id",
+                  "parent_id", "tid", "dur_s"}
+
+# markers worth a vertical line on the timeline even though they are
+# not spans; value is the Perfetto slice scope ("p"rocess / "t"hread)
+_INSTANTS = {"anomaly": "p", "error": "p", "compile": "t"}
+
+_HOST_RE = re.compile(r"\.host(\d+)\.")
+
+
+def _load_schema():
+    """Load obs.events directly by path: stdlib-only, no package import
+    (the package __init__ pulls jax, which an export never needs)."""
+    spec = importlib.util.spec_from_file_location("_obs_events", _EVENTS_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt", encoding="utf-8")
+    return open(path, encoding="utf-8")
+
+
+def load_events(path: str, schema):
+    """Parse one stream, keeping only schema-valid lines (a crashed
+    run's partial stream must still export)."""
+    events, bad = [], 0
+    with _open_text(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            if schema.validate_line(line) is None:
+                events.append(json.loads(line))
+            else:
+                bad += 1
+    return events, bad
+
+
+def validate(path: str, schema) -> int:
+    """Schema gate + span contract for one stream; prints one line per
+    violation; returns the violation count."""
+    bad = n = 0
+    parsed = []
+    with _open_text(path) as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            n += 1
+            err = schema.validate_line(line)
+            if err is not None:
+                bad += 1
+                print(f"{path}:{lineno}: {err}", file=sys.stderr)
+            else:
+                parsed.append(json.loads(line))
+    span_errors = schema.validate_spans(parsed)
+    for err in span_errors:
+        print(f"{path}: span contract: {err}", file=sys.stderr)
+    n_spans = sum(1 for e in parsed if e["event"] == "span_begin")
+    if not bad and not span_errors:
+        print(f"{path}: ok ({n} events, {n_spans} spans, "
+              f"schema v{schema.SCHEMA_VERSION})")
+    return bad + len(span_errors)
+
+
+def host_pid(path: str, index: int) -> int:
+    """Chrome pid for one input file: the host id from the
+    ``events.host<K>.jsonl`` per-host naming when present, else the
+    file's position on the command line."""
+    m = _HOST_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else index
+
+
+def _span_args(begin: dict, end: dict) -> dict:
+    """Merge begin tags with end results (wall_s/flips/reject/...) into
+    the slice's args dict — what Perfetto shows on click."""
+    args = {k: v for k, v in begin.items() if k not in _SPAN_ENVELOPE}
+    args.update({k: v for k, v in end.items() if k not in _SPAN_ENVELOPE})
+    return args
+
+
+def file_trace_events(events, pid: int) -> list[dict]:
+    """Convert one stream's events to Chrome trace events under ``pid``.
+
+    Span pairs become "X" slices. The begin wall-clock ``ts`` and the
+    monotonic ``dur_s`` come from different clocks, so a child stamped
+    late can overhang its parent by a few µs; each child's interval is
+    clamped into its (transitively clamped) parent's so the nesting the
+    validator proved always renders as nesting. Unclosed spans (crash)
+    are dropped — ``--validate`` reports them. Deferred spans
+    (``emit_span_at``: the board runner's back-stamped chunks) arrive
+    begin-then-end adjacent and need no special casing."""
+    out = []
+    open_spans: dict = {}   # span_id -> begin event
+    pairs = []              # (begin, end), stream order of the begins
+    for e in events:
+        kind = e["event"]
+        if kind == "span_begin":
+            open_spans[e["span_id"]] = e
+        elif kind == "span_end":
+            b = open_spans.pop(e.get("span_id"), None)
+            if b is not None:
+                pairs.append((b, e))
+        elif kind == "chunk":
+            # chunk events double as samples on a per-path flips/s
+            # counter track (the per-chunk throughput spread, on the
+            # timeline instead of in a table)
+            rate = e.get("flips_per_s")
+            if isinstance(rate, (int, float)):
+                out.append({
+                    "name": f"flips/s [{e.get('path', '?')}]",
+                    "ph": "C",
+                    "ts": e["ts"] * 1e6,
+                    "pid": pid,
+                    "args": {"flips_per_s": rate},
+                })
+        elif kind in _INSTANTS:
+            label = {"anomaly": e.get("kind"),
+                     "error": e.get("message"),
+                     "compile": e.get("fn")}.get(kind) or kind
+            out.append({
+                "name": f"{kind}: {label}",
+                "ph": "i",
+                "ts": e["ts"] * 1e6,
+                "pid": pid,
+                "tid": e.get("tid", 0),
+                "s": _INSTANTS[kind],
+                "args": {k: v for k, v in e.items()
+                         if k not in ("v", "ts", "event")},
+            })
+    # clamp top-down: a parent's begin precedes its children's begins in
+    # the stream, so sorting pairs by begin order lets each child clamp
+    # against its parent's already-clamped interval
+    pairs.sort(key=lambda p: p[0]["ts"])
+    bounds: dict = {}       # span_id -> clamped (t0, t1)
+    for b, e in pairs:
+        t0 = b["ts"]
+        t1 = t0 + max(e.get("dur_s") or 0.0, 0.0)
+        pb = bounds.get(b.get("parent_id"))
+        if pb is not None:
+            t0 = min(max(t0, pb[0]), pb[1])
+            t1 = min(max(t1, t0), pb[1])
+        bounds[b["span_id"]] = (t0, t1)
+        out.append({
+            "name": b.get("name", "?"),
+            "ph": "X",
+            "ts": t0 * 1e6,
+            "dur": (t1 - t0) * 1e6,
+            "pid": pid,
+            "tid": b.get("tid", 0),
+            "args": _span_args(b, e),
+        })
+    return out
+
+
+def export(paths: list[str], schema) -> dict:
+    """Merge one or more streams into a single Chrome trace document."""
+    trace = []
+    t_min = None
+    per_file = []
+    for i, path in enumerate(paths):
+        events, bad = load_events(path, schema)
+        if bad:
+            print(f"{path}: skipped {bad} malformed line(s)",
+                  file=sys.stderr)
+        pid = host_pid(path, i)
+        per_file.append((path, pid, events))
+        for e in events:
+            if t_min is None or e["ts"] < t_min:
+                t_min = e["ts"]
+    for path, pid, events in per_file:
+        trace.append({"name": "process_name", "ph": "M", "pid": pid,
+                      "args": {"name": f"host{pid} "
+                                       f"({os.path.basename(path)})"}})
+        trace.extend(file_trace_events(events, pid))
+    # rebase to t=0 so Perfetto's time axis starts at the run, not the
+    # unix epoch
+    if t_min is not None:
+        for ev in trace:
+            if "ts" in ev:
+                ev["ts"] -= t_min * 1e6
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def default_output(path: str) -> str:
+    base = path
+    for suffix in (".gz", ".jsonl", ".json"):
+        if base.endswith(suffix):
+            base = base[:-len(suffix)]
+    return base + ".trace.json"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Export obs spans to Chrome trace-event JSON "
+                    "(Perfetto / chrome://tracing)")
+    ap.add_argument("paths", nargs="+",
+                    help="JSONL event stream(s); multiple files (e.g. "
+                         "per-host events.host<K>.jsonl) merge into one "
+                         "trace, one pid per file")
+    ap.add_argument("-o", "--output",
+                    help="output path (default: first input with a "
+                         ".trace.json suffix)")
+    ap.add_argument("--validate", action="store_true",
+                    help="validate only (schema + span nesting), write "
+                         "nothing, exit nonzero on any violation")
+    args = ap.parse_args(argv)
+    schema = _load_schema()
+
+    if args.validate:
+        return 1 if sum(validate(p, schema) for p in args.paths) else 0
+
+    doc = export(args.paths, schema)
+    out_path = args.output or default_output(args.paths[0])
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    n_slices = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    print(f"{out_path}: {len(doc['traceEvents'])} trace events "
+          f"({n_slices} spans) from {len(args.paths)} stream(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
