@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test obs-check lint
+.PHONY: test obs-check mesh-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -13,6 +13,12 @@ test:
 # Chrome-trace export validation over the committed fixture stream
 obs-check:
 	PYTHON=$(PYTHON) tools/ci_obs.sh
+
+# multi-chip gates: per-host fixture streams merge through trace_export,
+# plus a live 2-device forced-host bench --mesh smoke (fast-path body,
+# per-chip flips/s, valid event stream)
+mesh-check:
+	PYTHON=$(PYTHON) tools/mesh_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

@@ -76,6 +76,18 @@ def main():
                          "k-stripes initial plan; the metric name and "
                          "vs_baseline keep their per-chip flip meaning")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="multi-chip mode: build an N-device chains mesh "
+                         "(distribute.make_mesh), run the 1/2/4/.../N "
+                         "scaling ladder through the sharded board train "
+                         "step (replica exchange over ICI each --chunk "
+                         "steps), and emit a MULTICHIP record with "
+                         "aggregate AND per-chip flips/s plus the scaling "
+                         "table. --chains means chains PER CHIP here "
+                         "(weak scaling). On the CPU backend the N "
+                         "devices are forced host devices "
+                         "(--xla_force_host_platform_device_count), so "
+                         "the mesh path runs without silicon")
     ap.add_argument("--general", action="store_true",
                     help="force the general (gather) path even when the "
                          "board fast path supports the workload")
@@ -184,6 +196,33 @@ def main():
                 # (134k flips/s vs 115k at 512 on this box)
                 args.chains = 256
 
+    if args.mesh is not None:
+        if args.mesh < 1:
+            ap.error("--mesh needs N >= 1")
+        for flag, name in ((args.pallas, "--pallas"), (args.ess, "--ess"),
+                           (args.general, "--general")):
+            if flag:
+                print(f"bench: {name} is incompatible with --mesh (the "
+                      "sharded benchmark routes through the board fast "
+                      "path's train step)", file=sys.stderr)
+                sys.exit(2)
+        if args.cpu:
+            # the forced-host device count must be pinned BEFORE jax
+            # imports (backend init reads XLA_FLAGS once); keep a larger
+            # pre-set count, grow a smaller one
+            import os
+            import re
+            flags = os.environ.get("XLA_FLAGS", "")
+            m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                          flags)
+            if m is None or int(m.group(1)) < args.mesh:
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", "",
+                    flags)
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count"
+                    f"={args.mesh}").strip()
+
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -191,7 +230,19 @@ def main():
     from flipcomplexityempirical_tpu import obs
     from flipcomplexityempirical_tpu.kernel import board as kboard
 
-    rec = obs.from_spec(args.events)
+    if args.mesh is not None and len(jax.devices()) < args.mesh:
+        print(f"bench: --mesh {args.mesh} needs {args.mesh} devices, "
+              f"backend exposes {len(jax.devices())}", file=sys.stderr)
+        sys.exit(2)
+
+    if args.mesh is not None:
+        # per-host event sink: a multi-host mesh writes
+        # events.host<K>.jsonl per host (trace_export merges them);
+        # single-host runs get the plain path
+        from flipcomplexityempirical_tpu.distribute import host_recorder
+        rec = host_recorder(args.events)
+    else:
+        rec = obs.from_spec(args.events)
 
     if args.graph != "square" and args.k != 2:
         print("bench: --graph sec11/frank runs the reference 2-district "
@@ -243,6 +294,16 @@ def main():
               "sec11/frank run the lowered stencil body only",
               file=sys.stderr)
         sys.exit(2)
+    if args.mesh is not None:
+        if not use_board:
+            print("bench: --mesh requires a board-path workload "
+                  "(kernel.board.supports rejects this graph/spec)",
+                  file=sys.stderr)
+            sys.exit(2)
+        _mesh_bench(args, cpu_fallback, g, plan, spec, rec)
+        rec.close()
+        return
+
     if args.chains is None:
         # on the real chip the k=2 board path's measured throughput peak
         # is C=8192 (20.45M flips/s vs 18.47M at 4096; full chain-count
@@ -485,6 +546,146 @@ def main():
         headline["cpu_fallback"] = True
     print(json.dumps(headline))
     rec.close()
+
+
+def _mesh_bench(args, cpu_fallback, g, plan, spec, rec):
+    """The --mesh N flow: the 1/2/4/.../N scaling ladder through the
+    sharded board train step (distribute.run_sharded), MULTICHIP record
+    on stdout.
+
+    Weak scaling: ``--chains`` chains PER CHIP at every rung, so the
+    per-chip workload — and thus per-chip flips/s, the regression metric
+    tools/bench_compare.py gates across differing device counts — stays
+    constant up the ladder. Replica exchange is ON with a uniform beta
+    ladder: every swap round runs the full all_gather + replicated
+    selection over ICI (the scaling cost being measured) while the
+    exchanged betas are identical, keeping the chain dynamics comparable
+    to the single-chip headline. The timed passes run un-instrumented
+    (NullRecorder); with --events a separate recorded pass at the full
+    mesh follows the timing, on the per-host sink."""
+    import jax
+    import jax.numpy as jnp
+    import flipcomplexityempirical_tpu as fce
+    from flipcomplexityempirical_tpu import distribute
+    from flipcomplexityempirical_tpu.kernel import board as kboard
+
+    if args.chains is None:
+        # per-chip defaults: the single-chip peak on the real chip, the
+        # frozen host sweet spot on CPU (module docstring)
+        args.chains = 256 if args.cpu else (8192 if args.k == 2 else 4096)
+    bits = None if args.body is None else (args.body == "bits")
+    rounds = (args.steps - 1) // args.chunk
+    warm_rounds = max((args.warmup - 1) // args.chunk, 1)
+    repeats = max(args.repeats if args.repeats else 2, 1)
+
+    ladder = [d for d in (1, 2, 4, 8, 16, 32, 64) if d < args.mesh]
+    ladder.append(args.mesh)
+    scaling = []
+    body = None
+    for n_dev in ladder:
+        mesh = distribute.make_mesh(n_dev)
+        chains = args.chains * n_dev
+        bg, states, params = fce.sampling.init_board(
+            g, plan, n_chains=chains, seed=0, spec=spec,
+            base=args.base, pop_tol=args.pop_tol)
+        states = distribute.shard_chain_batch(mesh, states)
+        params = distribute.shard_chain_batch(mesh, params)
+        step = distribute.make_board_train_step(
+            bg, spec, mesh, inner_steps=args.chunk, exchange=True,
+            bits=bits)
+        body = step.kernel_path
+        key = jax.random.PRNGKey(0)
+        key, kw = jax.random.split(key)
+        # compile + mix in; same inner_steps so the timed rounds reuse
+        # the compiled step
+        params, states, _ = distribute.run_sharded(
+            step, params, states, rounds=warm_rounds,
+            inner_steps=args.chunk, key=kw)
+        states = states.replace(
+            accept_count=jnp.zeros_like(states.accept_count),
+            tries_sum=jnp.zeros_like(states.tries_sum),
+            exhausted_count=jnp.zeros_like(states.exhausted_count))
+        best = None
+        for _ in range(repeats):
+            key, kt = jax.random.split(key)
+            params, states, info = distribute.run_sharded(
+                step, params, states, rounds=rounds,
+                inner_steps=args.chunk, key=kt)
+            if best is None or info["wall_s"] < best["wall_s"]:
+                best = info
+        scaling.append({
+            "devices": n_dev,
+            "chains": chains,
+            "seconds": round(best["wall_s"], 3),
+            "flips_per_s": round(best["flips_per_s"], 1),
+            "flips_per_s_per_chip": round(best["flips_per_s_per_chip"],
+                                          1),
+        })
+        if n_dev == args.mesh and rec:
+            # instrumented pass AFTER the timing: per-round chunk +
+            # swap_round spans on the per-host event stream
+            key, kr = jax.random.split(key)
+            distribute.run_sharded(step, params, states, rounds=rounds,
+                                   inner_steps=args.chunk, key=kr,
+                                   recorder=rec)
+
+    full = scaling[-1]
+    dev0 = "cpu-fallback" if cpu_fallback else str(jax.devices()[0])
+    meta = {
+        "device": f"{dev0} x{args.mesh}",
+        "devices": args.mesh,
+        "path": "board",
+        "kernel_path": body,
+        "graph": args.graph,
+        "chains": full["chains"],
+        "chains_per_chip": args.chains,
+        "steps": args.steps,
+        "chunk": args.chunk,
+        "grid": args.grid,
+        "k": args.k,
+        "seconds": full["seconds"],
+        "repeats": repeats,
+        "repeat_policy": "best",
+        "scaling": scaling,
+    }
+    print(json.dumps(meta), file=sys.stderr)
+
+    if args.graph != "square":
+        metric = f"flips_per_sec_multichip_{args.graph}"
+    else:
+        metric = f"flips_per_sec_multichip_{args.grid}x{args.grid}"
+        if args.k != 2:
+            metric += f"_pair_k{args.k}"
+    per_chip = full["flips_per_s_per_chip"]
+    headline = {
+        "metric": metric,
+        "value": full["flips_per_s"],
+        "unit": "flips/s",
+        # per-chip throughput against the per-chip baseline target — the
+        # ratio that stays meaningful when the device count changes
+        # between rounds; null on the fallback stand-in as usual
+        "vs_baseline": (None if cpu_fallback
+                        else round(per_chip / 1.25e6, 4)),
+        "device": meta["device"],
+        "devices": args.mesh,
+        "path": "board",
+        "kernel_path": body,
+        "body": body,
+        "flips_per_s_per_chip": per_chip,
+        "chains": full["chains"],
+        "chains_per_chip": args.chains,
+        "scaling": scaling,
+        "scaling_efficiency": round(
+            full["flips_per_s"]
+            / (args.mesh * scaling[0]["flips_per_s"]), 4),
+        "repeats": repeats,
+        "repeat_policy": "best",
+    }
+    if args.graph != "square":
+        headline["graph"] = args.graph
+    if cpu_fallback:
+        headline["cpu_fallback"] = True
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -2,10 +2,12 @@ from .mesh import (
     CHAINS_AXIS, make_mesh, chain_sharding, replicated, shard_chain_batch,
     initialize_distributed,
 )
-from .sharded import make_train_step, make_board_train_step
+from .sharded import (
+    host_recorder, make_board_train_step, make_train_step, run_sharded,
+)
 
 __all__ = [
     "CHAINS_AXIS", "make_mesh", "chain_sharding", "replicated",
     "shard_chain_batch", "initialize_distributed", "make_train_step",
-    "make_board_train_step",
+    "make_board_train_step", "run_sharded", "host_recorder",
 ]

@@ -37,12 +37,30 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def shard_chain_batch(mesh: Mesh, tree, axis: str = CHAINS_AXIS):
     """Place every leaf with a leading chains axis on the mesh (leading-axis
-    sharding); scalars/replicated leaves are broadcast."""
+    sharding); scalars/replicated leaves are broadcast.
+
+    The chain count (inferred as the largest leading dimension in the
+    tree) must divide by the mesh size: silently replicating a
+    chain-axis leaf that misses the divisibility check would hand every
+    device the FULL batch — a correctness trap at C not divisible by
+    the device count, caught here instead of as an 8x slowdown.
+    Intentionally replicated leaves (label_values, anneal constants)
+    have smaller leading dims and broadcast as before."""
+    n_dev = mesh.devices.size
+    leaves = [x for x in jax.tree.leaves(tree)
+              if getattr(x, "ndim", 0) >= 1]
+    n_chains = max((x.shape[0] for x in leaves), default=0)
+    if n_chains and n_chains % n_dev:
+        raise ValueError(
+            f"shard_chain_batch: chain axis of size {n_chains} does not "
+            f"divide across {n_dev} device(s); pad or resize the batch "
+            f"(chains % devices == 0) — silent replication would give "
+            "every device the full batch")
     cs = chain_sharding(mesh, axis)
     rep = replicated(mesh)
 
     def place(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % mesh.devices.size == 0:
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n_chains:
             return jax.device_put(x, cs)
         return jax.device_put(x, rep)
 

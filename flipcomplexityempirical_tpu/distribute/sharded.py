@@ -9,25 +9,48 @@ ladder whose rungs START one per device. Swaps pair adjacent TEMPERATURES
 (rank-based — see _swap_round), exchanged via one `lax.all_gather` of the
 per-chain beta/energy scalars over ICI plus replicated selection. Telemetry
 (aggregate accepts) reduces with `lax.psum`.
+
+The board step dispatches exactly as ``sampling/board_runner`` does
+(lowered -> bitboard -> int8 board; ``kernel_path`` is tagged on the step
+and every event), so multi-chip runs keep the single-chip fast-path wins.
+``run_sharded`` is the instrumented multi-round driver behind
+``bench.py --mesh``: per-round chunk/swap_round spans and deferred chunk
+events on a per-host recorder (``host_recorder``), with aggregate AND
+per-chip flips/s in the run_end event and the returned info.
 """
 
 from __future__ import annotations
 
-import functools
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
+try:  # jax >= 0.6: public API, replication checking spelled check_vma
+    from jax import shard_map as _shard_map_fn
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_fn
+    _CHECK_KW = "check_rep"
+
+from .. import obs
 from ..graphs.lattice import DeviceGraph
+from ..kernel import bitboard
 from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
 from ..sampling.tempering import chain_rungs
-from ..state.chain_state import ChainState
 from .mesh import CHAINS_AXIS
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """Version-portable shard_map with replication checking off (the
+    swap round's data-dependent gathers defeat the static rep checker on
+    both spellings of the flag)."""
+    return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **{_CHECK_KW: False})
 
 
 def _params_spec(sharded: bool):
@@ -50,7 +73,12 @@ def _swap_round(key, params, cut_count, parity, n_dev):
     (3, L) f32 block of (beta, cut, log_base) scalars over ICI and
     computes the WHOLE round's outcome redundantly from the shared
     replicated key, then keeps its own row. Swap decisions are identical
-    on every device by construction."""
+    on every device by construction.
+
+    Returns ``(params with exchanged betas, this shard's per-chain
+    swap-accept mask)`` — the mask's sum matches the in-batch oracle's
+    convention of counting both partners of an accepted pair.
+    """
     idx = jax.lax.axis_index(CHAINS_AXIS)
     stacked = jax.lax.all_gather(
         jnp.stack([params.beta, cut_count.astype(jnp.float32),
@@ -84,25 +112,82 @@ def _swap_round(key, params, cut_count, parity, n_dev):
     new_bl = jnp.where(accept, beta_p, bl)
     my_beta = new_bl.T[idx]
     my_accept = accept.T[idx]
-    return params.replace(beta=my_beta), my_accept.sum()
+    return params.replace(beta=my_beta), my_accept
 
 
-def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
-                    exchange: bool = True):
-    """Build a jitted sharded train step:
-    (key, params, states) -> (params, states, info).
+class _ShardedStep:
+    """A sharded train step: ``(key, params, states) -> (params, states,
+    info)`` with ``info = {"accepts", "swaps"}`` psum'd over the mesh.
 
-    ``key`` is a replicated PRNG key for the swap rounds (chain-local
-    randomness lives inside ChainState.key). Swap decisions are computed
-    identically on both partners from the shared key.
+    The shard_map in_specs for ``states`` are built lazily from the
+    ACTUAL state tree on first call and cached per treedef: ChainState/
+    BoardState carry trailing Optional leaves (``cut_times_se``/``sw``
+    on the lowered stencil body, ``reject_count`` under a recorder) that
+    change the pytree treedef, so a fixed placeholder struct would
+    reject exactly the fast-path states this step exists to serve —
+    the pre-rework sharded path only ever reached the int8/general
+    bodies for that reason. Every leaf of both state types carries a
+    leading chains axis, so the spec tree is uniformly P(chains).
+
+    ``kernel_path`` is the body the local advance dispatches to
+    ('lowered' | 'bitboard' | 'board' | 'general'), tagged per shard on
+    events by ``run_sharded``. ``_cache_size`` sums the underlying jit
+    caches so ``obs.JitWatch`` sees compile events across treedef
+    specializations too.
     """
+
+    def __init__(self, mesh, body, kernel_path: str, n_devices: int,
+                 exchange: bool):
+        self.mesh = mesh
+        self.kernel_path = kernel_path
+        self.n_devices = n_devices
+        self.exchange = exchange
+        self._body = body
+        self._built: dict = {}
+
+    def _build(self, states):
+        pspec = _params_spec(sharded=True)
+        state_spec = jax.tree.map(lambda _: P(CHAINS_AXIS), states)
+        return jax.jit(_shard_map(
+            self._body, self.mesh,
+            in_specs=(P(), pspec, state_spec),
+            out_specs=(pspec, state_spec, P())))
+
+    def __call__(self, key, params, states):
+        treedef = jax.tree.structure(states)
+        fn = self._built.get(treedef)
+        if fn is None:
+            fn = self._built[treedef] = self._build(states)
+        return fn(key, params, states)
+
+    def _cache_size(self):
+        return sum(int(f._cache_size()) for f in self._built.values())
+
+
+def _check_exchange(exchange: bool, spec: Spec):
     if exchange and spec.anneal != "none":
         # annealed chains ignore params.beta (kernel effective_beta), so a
         # beta-exchanging ladder would swap values with no dynamical effect
         raise ValueError("replica exchange is incompatible with "
                          "Spec.anneal != 'none': swaps exchange StepParams."
                          "beta, which the annealed kernel ignores")
-    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+
+
+def _mesh_size(mesh) -> int:
+    return int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+
+
+def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
+                    exchange: bool = True) -> _ShardedStep:
+    """Build a jitted sharded train step on the GENERAL (gather) kernel:
+    (key, params, states) -> (params, states, info).
+
+    ``key`` is a replicated PRNG key for the swap rounds (chain-local
+    randomness lives inside ChainState.key). Swap decisions are computed
+    identically on both partners from the shared key.
+    """
+    _check_exchange(exchange, spec)
+    n_dev = _mesh_size(mesh)
     paxes = StepParams.vmap_axes()
 
     def local_advance(params, states):
@@ -117,72 +202,206 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
         states, _ = jax.lax.scan(body, states, None, length=inner_steps)
         return states
 
-    pspec = _params_spec(sharded=True)
-    state_spec = jax.tree.map(lambda _: P(CHAINS_AXIS), states_struct())
-
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(), pspec, state_spec),
-        out_specs=(pspec, state_spec, P()),
-        check_vma=False)
     def train_step(key, params, states):
         states = local_advance(params, states)
         swaps = jnp.int32(0)
         if exchange and n_dev > 1:
-            params, s0 = _swap_round(key, params, states.cut_count, 0,
+            params, a0 = _swap_round(key, params, states.cut_count, 0,
                                      n_dev)
             # graftlint: disable=G002(_swap_round folds in the parity)
-            params, s1 = _swap_round(key, params, states.cut_count, 1,
+            params, a1 = _swap_round(key, params, states.cut_count, 1,
                                      n_dev)
-            swaps = s0 + s1
+            swaps = a0.sum() + a1.sum()
         info = {
             "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),
             "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
         }
         return params, states, info
 
-    return jax.jit(train_step)
+    return _ShardedStep(mesh, train_step, "general", n_dev, exchange)
 
 
 def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
-                          inner_steps: int, exchange: bool = True):
+                          inner_steps: int, exchange: bool = True,
+                          bits: bool | None = None) -> _ShardedStep:
     """The board fast path's sharded train step: advance every chain
     ``inner_steps`` yields locally with the stencil kernel (zero
     communication), then the same even-odd beta-exchange ladder along the
     device axis as ``make_train_step``. This is the multi-chip form of the
-    headline benchmark workload."""
-    if exchange and spec.anneal != "none":
-        raise ValueError("replica exchange is incompatible with "
-                         "Spec.anneal != 'none'")
-    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
-    pspec = _params_spec(sharded=True)
-    state_spec = jax.tree.map(lambda _: P(CHAINS_AXIS),
-                              board_states_struct())
+    headline benchmark workload.
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(), pspec, state_spec),
-        out_specs=(pspec, state_spec, P()),
-        check_vma=False)
+    The local advance is ``kernel.board.run_board_chunk``, so the body
+    dispatch is board_runner's: surgical/interface stencils run the
+    lowered body, plain grids the bit-board body where supported, int8
+    otherwise. ``bits`` forces the rook-body choice exactly like the
+    runner's flag (None = auto); the selected body is exposed as
+    ``step.kernel_path``. Invalid forcings fail here, at build time,
+    with ``run_board_chunk``'s messages — not at first dispatch.
+    """
+    _check_exchange(exchange, spec)
+    n_dev = _mesh_size(mesh)
+    lowered = bg.surgical or spec.record_interface
+    if lowered and bits:
+        raise ValueError("bits=True: the lowered stencil body has no "
+                         "bit-board backend")
+    if bits and not lowered:
+        bits_ok = (bitboard.supported_pair(bg, spec)
+                   if spec.proposal == "pair"
+                   else bitboard.supported(bg, spec))
+        if not bits_ok:
+            raise ValueError("bits=True: workload not supported by the "
+                             "bit-board body (see bitboard.supported / "
+                             "supported_pair)")
+    kernel_path = kboard.body_for(bg, spec, bits)
+
     def train_step(key, params, states):
         states, _ = kboard.run_board_chunk(bg, spec, params, states,
-                                           inner_steps, collect=False)
+                                           inner_steps, collect=False,
+                                           bits=bits)
         swaps = jnp.int32(0)
         if exchange and n_dev > 1:
             # the board loop carries cut_count incrementally, so it is the
             # current energy right after a chunk
             cuts = states.cut_count
-            params, s0 = _swap_round(key, params, cuts, 0, n_dev)
+            params, a0 = _swap_round(key, params, cuts, 0, n_dev)
             # graftlint: disable=G002(_swap_round folds in the parity)
-            params, s1 = _swap_round(key, params, cuts, 1, n_dev)
-            swaps = s0 + s1
+            params, a1 = _swap_round(key, params, cuts, 1, n_dev)
+            swaps = a0.sum() + a1.sum()
         info = {
             "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),
             "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
         }
         return params, states, info
 
-    return jax.jit(train_step)
+    return _ShardedStep(mesh, train_step, kernel_path, n_dev, exchange)
+
+
+def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
+                inner_steps: int, key=None, recorder=None):
+    """Drive a sharded train step for ``rounds`` rounds of
+    ``inner_steps`` local transitions + one replica-exchange step each.
+    Returns ``(params, states, info)`` with a HOST info dict: totals,
+    aggregate ``flips_per_s`` AND ``flips_per_s_per_chip`` (the
+    cross-device-count regression metric), swap/accept counts, and the
+    winning ``kernel_path``.
+
+    Telemetry contract (mirrors the board runner's): with a falsy
+    recorder the loop enqueues rounds back-to-back with NO host syncs
+    until the final info readback; with a recorder it emits run_start /
+    per-round chunk events / metrics_snapshot / run_end, wraps each
+    round in a live ``chunk`` span with a ``swap_round`` marker span
+    nested inside, and defers every device readback (accepts, swaps) to
+    the run-end sync — per-round walls are dispatch intervals, the
+    run_end wall is authoritative. Pass ``host_recorder(path)`` so
+    multi-host meshes write ``events.host<K>.jsonl`` streams that
+    ``tools/trace_export.py`` merges onto per-host pids.
+    """
+    rec = obs.resolve_recorder(recorder)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    n_chains = int(states.accept_count.shape[0])
+    n_dev = step.n_devices
+    total = rounds * inner_steps
+    if rec:
+        rec.emit("run_start", runner="sharded", path=step.kernel_path,
+                 chains=n_chains, n_steps=total, chunk=inner_steps,
+                 devices=n_dev, exchange=step.exchange)
+        watch = obs.JitWatch(step, f"sharded.{step.kernel_path}")
+        met = obs.MetricsRegistry()
+        run_span = obs.span(rec, "run:sharded", annotate=True,
+                            kernel_path=step.kernel_path, chains=n_chains,
+                            n_steps=total, devices=n_dev).begin()
+        acc0 = states.accept_count
+        chunk_meta: list = []
+    t_run0 = t_prev = time.perf_counter()
+
+    swaps_dev = jnp.int32(0)
+    info_dev = {}
+    for r in range(rounds):
+        key, kr = jax.random.split(key)
+        if rec:
+            csp = obs.span(rec, "chunk", kernel_path=step.kernel_path,
+                           steps=inner_steps, round=r).begin()
+        params, states, info_dev = step(kr, params, states)
+        # device-side accumulation: no host sync until the run-end readback
+        swaps_dev = swaps_dev + info_dev["swaps"]
+        if rec:
+            watch.poll(rec, round=r)
+            if step.exchange and n_dev > 1:
+                # zero-duration marker: the exchange executes fused inside
+                # the step's dispatch, so the span records placement
+                # (inside this round's chunk span), not a host-measurable
+                # duration
+                obs.emit_span_at(rec, "swap_round", time.time(), 0.0,
+                                 round=r, parities=[0, 1])
+            now = time.perf_counter()
+            wall = now - t_prev
+            t_prev = now
+            csp.end(wall_s=wall)
+            # readbacks deferred: stash the device refs, flush after the
+            # run-end sync (the pipelined dispatch stays pipelined)
+            chunk_meta.append((wall, states.accept_count,
+                               info_dev["swaps"], time.time()))
+            met.observe("chunk_wall_s", wall)
+            met.observe("flips_per_s",
+                        n_chains * inner_steps / max(wall, 1e-12))
+            met.inc("chunks")
+            met.inc("flips", n_chains * inner_steps)
+            met.set("done", (r + 1) * inner_steps)
+            met.notify(rec)
+
+    jax.block_until_ready(states.accept_count)
+    wall_total = time.perf_counter() - t_run0
+    flips = n_chains * total
+    fps = flips / max(wall_total, 1e-12)
+    accepts = int(np.asarray(info_dev["accepts"])) if info_dev else 0
+    swaps = int(np.asarray(swaps_dev))
+    info = {
+        "accepts": accepts,
+        "swaps": swaps,
+        "rounds": rounds,
+        "inner_steps": inner_steps,
+        "chains": n_chains,
+        "devices": n_dev,
+        "kernel_path": step.kernel_path,
+        "flips": flips,
+        "wall_s": wall_total,
+        "flips_per_s": fps,
+        "flips_per_s_per_chip": fps / max(n_dev, 1),
+    }
+    if rec:
+        last_acc = int(np.asarray(acc0, np.int64).sum())
+        acc_start = last_acc
+        done = 0
+        for wall, acc_ref, swaps_ref, ts in chunk_meta:
+            acc = int(np.asarray(acc_ref, np.int64).sum())
+            done += inner_steps
+            rec.emit("chunk", ts=ts, runner="sharded",
+                     path=step.kernel_path, steps=inner_steps,
+                     chains=n_chains, flips=n_chains * inner_steps,
+                     wall_s=wall,
+                     flips_per_s=n_chains * inner_steps / max(wall, 1e-12),
+                     accept_rate=(acc - last_acc)
+                     / (n_chains * inner_steps),
+                     transfer_bytes=0, hbm_history_bytes=0,
+                     done=done, total=total, devices=n_dev,
+                     swaps=int(np.asarray(swaps_ref)))
+            last_acc = acc
+        info["accept_rate"] = ((last_acc - acc_start)
+                               / max(n_chains * total, 1))
+        met.set("flips_per_s_per_chip", info["flips_per_s_per_chip"])
+        snap = met.snapshot()
+        rec.emit("metrics_snapshot", counters=snap["counters"],
+                 gauges=snap["gauges"], histograms=snap["histograms"],
+                 runner="sharded", path=step.kernel_path)
+        rec.emit("run_end", runner="sharded", path=step.kernel_path,
+                 n_yields=total, chains=n_chains, flips=flips,
+                 wall_s=wall_total, flips_per_s=fps,
+                 flips_per_s_per_chip=info["flips_per_s_per_chip"],
+                 devices=n_dev, swaps=swaps,
+                 accept_rate=info["accept_rate"], metrics=snap)
+        run_span.end(flips=flips, wall_s=wall_total)
+    return params, states, info
 
 
 def host_recorder(spec):
@@ -194,25 +413,4 @@ def host_recorder(spec):
     per host id parsed from the filename; ``tools/obs_report.py``
     accepts any one of them. Single-host processes get a plain
     single-file recorder — same spec, same call site either way."""
-    from ..obs import from_spec
-
-    return from_spec(spec, per_host=True)
-
-
-def states_struct():
-    """A ChainState of leaf placeholders for building PartitionSpec trees."""
-    return ChainState(
-        key=0, assignment=0, cut=0, cut_deg=0, dist_pop=0, cut_count=0,
-        b_count=0, cur_wait=0, cur_flip_node=0, t_yield=0, part_sum=0,
-        last_flipped=0, num_flips=0, cut_times=0, waits_sum=0,
-        move_clock=0, accept_count=0, tries_sum=0, exhausted_count=0)
-
-
-def board_states_struct():
-    """BoardState leaf placeholders for building PartitionSpec trees."""
-    return kboard.BoardState(
-        key=0, board=0, dist_pop=0, cut_count=0, cur_wait=0, wait_pending=0,
-        cur_flip=0, cur_sign=0, t_yield=0, move_clock=0, part_sum=0,
-        last_flipped=0,
-        num_flips=0, cut_times_e=0, cut_times_s=0, waits_sum=0,
-        accept_count=0, tries_sum=0, exhausted_count=0)
+    return obs.from_spec(spec, per_host=True)

@@ -5,11 +5,19 @@
 # interpreter startup and pins JAX_PLATFORMS, so plain env vars are not
 # enough — the jax config must be updated before backend initialization.
 import os
+import re
 
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+_m = re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
+if _m is None:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+elif int(_m.group(1)) < 8:
+    # an inherited flag with a smaller count would quietly drop the
+    # sharding suites to fewer devices than they assert on
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "--xla_force_host_platform_device_count=8", _flags)
 
 import jax  # noqa: E402
 
@@ -75,6 +83,18 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """8-device chains mesh on the forced-host CPU backend. Skips (rather
+    than fails) when the backend didn't come up with 8 devices — e.g. a
+    run on real silicon with fewer chips — so the multi-chip suites stay
+    tier-1 on any box via the XLA_FLAGS forcing above."""
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+        pytest.skip("needs 8 forced-host CPU devices")
+    from flipcomplexityempirical_tpu import distribute
+    return distribute.make_mesh(8)
 
 
 def assert_grid_districts_connected(boards, k):

@@ -47,3 +47,38 @@ def test_bench_cpu_record_schema_and_explicit_chains():
               if ln.startswith("{")]
     assert detail, "per-run detail JSON expected on stderr"
     assert detail[-1]["chains"] == 8, "explicit --chains must win"
+
+
+@pytest.mark.slow
+def test_bench_mesh_record_schema():
+    """--mesh N: the MULTICHIP record contract. Two forced-host CPU
+    devices, a 2-rung scaling ladder, a fast-path body (bitboard on the
+    plain 32-grid — NOT the int8/general fallback), per-chip flips/s for
+    cross-device-count gating, and still exactly one stdout JSON line."""
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--mesh", "2", "--cpu", "--grid", "32",
+         "--chains", "4", "--steps", "41", "--warmup", "21",
+         "--chunk", "20"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "flips_per_sec_multichip_32x32"
+    assert rec["devices"] == 2
+    assert rec["device"].endswith(" x2")
+    assert rec["body"] in ("bitboard", "lowered"), \
+        "plain grid must win a fast-path body, not int8/general"
+    assert rec["kernel_path"] == rec["body"]
+    assert rec["value"] > 0
+    assert rec["flips_per_s_per_chip"] > 0
+    # --chains is PER CHIP in mesh mode (weak scaling)
+    assert rec["chains_per_chip"] == 4
+    assert rec["chains"] == 8
+    ladder = rec["scaling"]
+    assert [row["devices"] for row in ladder] == [1, 2]
+    for row in ladder:
+        assert row["flips_per_s_per_chip"] > 0
+        assert row["flips_per_s"] == pytest.approx(
+            row["flips_per_s_per_chip"] * row["devices"], rel=1e-3)
+    assert rec["repeat_policy"] == "best"

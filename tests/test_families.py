@@ -113,11 +113,13 @@ def test_temper_family_end_to_end(tmp_path):
 
 def test_driver_dispatches_board_fast_path(monkeypatch):
     """_run_jax must route through init_board exactly when
-    board.supports holds (kpair's plain grid yes, frank no). Both init
-    spies abort after recording, so this is a pure ROUTING test — no
-    chain runs, no artifacts render (the families' end-to-end behavior
-    is covered by the other tests in this file, which is what kept this
-    one pinned at the fast-tier budget when it ran two full configs)."""
+    board.supports holds — since the stencil-lowering rework that
+    includes frank's surgical seam grid (lowered body), not just kpair's
+    plain grid. Both init spies abort after recording, so this is a pure
+    ROUTING test — no chain runs, no artifacts render (the families'
+    end-to-end behavior is covered by the other tests in this file,
+    which is what kept this one pinned at the fast-tier budget when it
+    ran two full configs)."""
     class _Routed(Exception):
         pass
 
@@ -144,8 +146,8 @@ def test_driver_dispatches_board_fast_path(monkeypatch):
 
     cfg2 = ex.ExperimentConfig(family="frank", alignment=0, base=0.3,
                                pop_tol=0.5, total_steps=120, n_chains=2)
-    assert route_of(cfg2) == "general", \
-        "frank config must use the general path"
+    assert route_of(cfg2) == "board", \
+        "frank's surgical seam grid must lower onto the board fast path"
 
 
 def test_temper_family_checkpoint_resume_bit_identical(tmp_path):

@@ -6,15 +6,20 @@ Key property: sharding the chains axis over 1 vs 8 devices is
 bit-identical — per-chain PRNG keys make the batch embarrassingly parallel.
 """
 
+import json
+import types
+
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 import pytest
 
 import flipcomplexityempirical_tpu as fce
 
 from conftest import assert_grid_districts_connected
-from flipcomplexityempirical_tpu import distribute
+from flipcomplexityempirical_tpu import distribute, obs
+from flipcomplexityempirical_tpu.distribute import sharded as dsh
 from flipcomplexityempirical_tpu.sampling import tempering
 
 
@@ -241,3 +246,200 @@ def test_board_train_step_cross_device_exchange():
     s2 = jax.tree.map(np.asarray, st2)
     assert int(np.asarray(s2.t_yield).sum()) == 16 * 20
     assert np.allclose(np.sort(np.asarray(params2.beta)), np.sort(betas))
+
+
+# ---------------------------------------------------------------------------
+# divisibility contract (shard_chain_batch)
+# ---------------------------------------------------------------------------
+
+def test_shard_chain_batch_rejects_indivisible_chains(mesh8):
+    """A chain axis that does not divide by the mesh size must raise, not
+    silently replicate: replication hands every device the FULL batch (8x
+    the work, identical results per device)."""
+    g, dg, states, params, spec = setup_batch(chains=12)
+    with pytest.raises(ValueError, match="does not divide"):
+        distribute.shard_chain_batch(mesh8, states)
+    with pytest.raises(ValueError, match="does not divide"):
+        distribute.shard_chain_batch(mesh8, params)
+
+
+def test_shard_chain_batch_replicates_small_leaves(mesh8):
+    """Leaves whose leading dim is smaller than the chain count (e.g. the
+    (k,) label_values) replicate even when their own dim divides the mesh
+    — only the chain axis shards."""
+    g, dg, states, params, spec = setup_batch(chains=16)
+    assert params.label_values.shape == (2,)
+    placed = distribute.shard_chain_batch(mesh8, params)
+    assert placed.label_values.sharding.is_fully_replicated
+    assert not placed.beta.sharding.is_fully_replicated
+
+
+# ---------------------------------------------------------------------------
+# replica-exchange parity vs the in-batch oracle
+# ---------------------------------------------------------------------------
+
+def _swap_harness(mesh, parity, n_dev):
+    pspec = dsh._params_spec(sharded=True)
+
+    def body(key, params, cuts):
+        return dsh._swap_round(key, params, cuts, parity, n_dev)
+
+    return jax.jit(dsh._shard_map(
+        body, mesh,
+        in_specs=(P(), pspec, P(distribute.CHAINS_AXIS)),
+        out_specs=(pspec, P(distribute.CHAINS_AXIS))))
+
+
+@pytest.mark.parametrize("parity", [0, 1])
+def test_cross_device_swap_round_matches_in_batch_oracle(mesh8, parity):
+    """The device-axis swap round and the single-device in-batch oracle
+    (tempering.swap_within_batch) produce the IDENTICAL (beta, chain)
+    pairing on the same energies.
+
+    Construction forces every valid pair to accept regardless of the two
+    implementations' differing uniform draws: with log_base > 0 and cuts
+    strictly increasing with beta, log_a = (b1-b2)(e1-e2) > 0 on every
+    valid pair, so the decision is deterministic. Layout mapping: sharded
+    global chain g = d*L + i (device d, local slot i) forms slot i's
+    ladder along the device axis; the oracle's ladder-major index for the
+    same chain is i*n_dev + d.
+    """
+    n_dev, n_local = 8, 2
+    n_chains = n_dev * n_local
+    g, dg, states, params, spec = setup_batch(chains=n_chains)
+
+    # slot i's ladder along the device axis, distinct betas per slot
+    ladders = np.stack([np.linspace(0.2, 2.0, n_dev),
+                        np.linspace(0.3, 2.4, n_dev)]).astype(np.float32)
+    beta_sh = np.empty(n_chains, np.float32)
+    cut_sh = np.empty(n_chains, np.int32)
+    for d in range(n_dev):
+        for i in range(n_local):
+            beta_sh[d * n_local + i] = ladders[i, d]
+            cut_sh[d * n_local + i] = int(round(ladders[i, d] * 10))
+
+    params_sh = params.replace(
+        beta=jnp.asarray(beta_sh),
+        log_base=jnp.ones(n_chains, jnp.float32))
+    params_sh = distribute.shard_chain_batch(mesh8, params_sh)
+    cuts_dev = distribute.shard_chain_batch(mesh8, jnp.asarray(cut_sh))
+
+    key = jax.random.PRNGKey(42)
+    p2, accept = _swap_harness(mesh8, parity, n_dev)(
+        key, params_sh, cuts_dev)
+    beta_out_sh = np.asarray(jax.device_get(p2.beta))
+    accept_sh = np.asarray(jax.device_get(accept))
+
+    # oracle layout: chain (d, i) at index i*n_dev + d
+    to_oracle = np.array([i * n_dev + d
+                          for d in range(n_dev) for i in range(n_local)])
+    beta_or = np.empty(n_chains, np.float32)
+    cut_or = np.empty(n_chains, np.int32)
+    beta_or[to_oracle] = beta_sh
+    cut_or[to_oracle] = cut_sh
+    params_or = params.replace(
+        beta=jnp.asarray(beta_or),
+        log_base=jnp.ones(n_chains, jnp.float32))
+    oracle_states = types.SimpleNamespace(cut_count=jnp.asarray(cut_or))
+    p2_or, acc_or = tempering.swap_within_batch(
+        jax.random.PRNGKey(7), oracle_states, params_or,
+        n_rungs=n_dev, parity=parity, spec=spec)
+    beta_out_or = np.asarray(p2_or.beta)
+    accept_or = np.asarray(acc_or)
+
+    assert accept_sh.sum() > 0, "forced-accept construction swapped nothing"
+    np.testing.assert_array_equal(accept_sh, accept_or[to_oracle])
+    np.testing.assert_array_equal(beta_out_sh, beta_out_or[to_oracle])
+
+
+# ---------------------------------------------------------------------------
+# fast-path dispatch inside the sharded step
+# ---------------------------------------------------------------------------
+
+def test_sharded_board_step_dispatches_bitboard(mesh8):
+    """A plain 32-aligned grid must reach the BIT-BOARD body through the
+    sharded step, not fall back to int8/general (the pre-rework gap)."""
+    g = fce.graphs.square_grid(4, 32)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch", geom_waits=False,
+                    parity_metrics=False)
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=16, seed=0, spec=spec, base=1.3, pop_tol=0.3)
+    st = distribute.shard_chain_batch(mesh8, st)
+    params = distribute.shard_chain_batch(mesh8, params)
+    step = distribute.make_board_train_step(bg, spec, mesh8, inner_steps=4)
+    assert step.kernel_path == "bitboard"
+    _, st2, info = step(jax.random.PRNGKey(0), params, st)
+    assert int(np.asarray(jax.device_get(st2.t_yield)).sum()) == 16 * 4
+    assert int(info["accepts"]) > 0
+
+
+def test_sharded_board_step_dispatches_lowered(mesh8):
+    """The queen-adjacency (surgical) grid takes the LOWERED stencil body
+    through the sharded step — the treedef with cut_times_se/sw leaves
+    that a fixed placeholder in_specs struct used to reject."""
+    g = fce.graphs.square_grid(8, 8, queen=True)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=8, seed=0, spec=spec, base=1.3, pop_tol=0.4)
+    st = distribute.shard_chain_batch(mesh8, st)
+    params = distribute.shard_chain_batch(mesh8, params)
+    step = distribute.make_board_train_step(bg, spec, mesh8, inner_steps=3)
+    assert step.kernel_path == "lowered"
+    with pytest.raises(ValueError, match="no bit-board backend"):
+        distribute.make_board_train_step(bg, spec, mesh8, inner_steps=3,
+                                         bits=True)
+    _, st2, info = step(jax.random.PRNGKey(0), params, st)
+    assert int(np.asarray(jax.device_get(st2.t_yield)).sum()) == 8 * 3
+    assert int(info["accepts"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# run_sharded: instrumented multi-round driver
+# ---------------------------------------------------------------------------
+
+def test_run_sharded_event_stream(mesh8, tmp_path):
+    g = fce.graphs.square_grid(4, 32)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch", geom_waits=False,
+                    parity_metrics=False)
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=16, seed=0, spec=spec, base=1.3, pop_tol=0.3)
+    betas = np.repeat(np.linspace(0.25, 2.0, 8), 2).astype(np.float32)
+    params = params.replace(beta=jnp.asarray(betas))
+    st = distribute.shard_chain_batch(mesh8, st)
+    params = distribute.shard_chain_batch(mesh8, params)
+    step = distribute.make_board_train_step(bg, spec, mesh8, inner_steps=5)
+    path = str(tmp_path / "events.jsonl")
+    with obs.Recorder(path=path) as rec:
+        params, st, info = distribute.run_sharded(
+            step, params, st, rounds=3, inner_steps=5,
+            key=jax.random.PRNGKey(1), recorder=rec)
+
+    assert info["devices"] == 8
+    assert info["kernel_path"] == "bitboard"
+    assert info["flips"] == 16 * 15
+    assert info["flips_per_s"] > 0
+    assert info["flips_per_s_per_chip"] == pytest.approx(
+        info["flips_per_s"] / 8)
+
+    with open(path, encoding="utf-8") as f:
+        lines = [ln for ln in f if ln.strip()]
+    events = []
+    for ln in lines:
+        assert obs.validate_line(ln) is None, ln
+        events.append(json.loads(ln))
+    assert obs.validate_spans(events) == []
+    names = [e["event"] for e in events]
+    assert names.count("run_start") == names.count("run_end") == 1
+    chunks = [e for e in events if e["event"] == "chunk"]
+    assert len(chunks) == 3
+    assert all(c["path"] == "bitboard" and c["devices"] == 8
+               for c in chunks)
+    span_names = [e["name"] for e in events if e["event"] == "span_begin"]
+    assert span_names.count("swap_round") == 3
+    assert span_names.count("chunk") == 3
+    run_end = [e for e in events if e["event"] == "run_end"][0]
+    assert run_end["flips_per_s_per_chip"] == pytest.approx(
+        run_end["flips_per_s"] / 8)

@@ -250,3 +250,67 @@ def test_bench_compare_refuses_cross_device_gate(tmp_path):
     r = _compare(a2, b2)
     assert r.returncode == 1
     assert "incomparable" not in r.stderr
+
+
+def _mesh_record(path, per_chip, devices, *, cpu_fallback=False,
+                 silicon="TFRT_CPU_0", rung1=52_000.0):
+    """A bench --mesh headline: aggregate + per-chip throughput, a
+    device tag carrying the device count, and a scaling ladder (whose
+    single-device rung is steady by default — only the full-mesh
+    per-chip figure varies across records)."""
+    doc = {"metric": "flips_per_sec_multichip_32x32",
+           "value": per_chip * devices, "unit": "flips/s",
+           "device": f"{silicon} x{devices}", "devices": devices,
+           "flips_per_s_per_chip": per_chip,
+           "cpu_fallback": cpu_fallback,
+           "scaling": [
+               {"devices": 1, "flips_per_s": rung1,
+                "flips_per_s_per_chip": rung1},
+               {"devices": devices, "flips_per_s": per_chip * devices,
+                "flips_per_s_per_chip": per_chip},
+           ]}
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def test_bench_compare_gates_per_chip_across_device_counts(tmp_path):
+    """Mesh records of the SAME silicon at different device counts are
+    comparable per chip: aggregate flips/s legitimately scales with the
+    count (no flag), but a per-chip drop past tolerance gates."""
+    a = _mesh_record(tmp_path / "a.json", 50_000.0, 2)
+    b = _mesh_record(tmp_path / "b.json", 30_000.0, 8)  # -40% per chip
+    r = _compare(a, b)
+    assert r.returncode == 1, r.stderr
+    assert "silicon matches" in r.stderr
+    assert "per-chip" in r.stderr
+    # aggregate moved +140% and the matching devices=1 rung is steady:
+    # neither may be what flags
+    assert "flips_per_sec_multichip_32x32.per_chip" in r.stderr
+    assert "mesh[devices=1]" not in r.stderr
+
+    # a healthy per-chip figure passes despite the differing counts
+    b2 = _mesh_record(tmp_path / "b2.json", 49_500.0, 8)
+    r = _compare(a, b2)
+    assert r.returncode == 0, r.stderr
+    assert "silicon matches" in r.stderr
+    assert "REGRESSED" not in r.stdout
+
+
+def test_bench_compare_mesh_scaling_rows_extracted(tmp_path):
+    """The scaling ladder contributes per-rung metrics: rungs present in
+    both records (devices=1 here) land in the delta table by name."""
+    a = _mesh_record(tmp_path / "a.json", 50_000.0, 2)
+    b = _mesh_record(tmp_path / "b.json", 48_000.0, 8)
+    r = _compare(a, b)
+    assert "mesh[devices=1].flips_per_s_per_chip" in r.stdout
+    assert "mesh[devices=2].flips_per_s" in r.stdout  # only-in-A row
+
+
+def test_bench_compare_mesh_still_refuses_fallback_mismatch(tmp_path):
+    """Same silicon string but only one side fell back to CPU: that is a
+    setup difference, not a per-chip regression — refusal stands."""
+    a = _mesh_record(tmp_path / "a.json", 50_000.0, 2)
+    b = _mesh_record(tmp_path / "b.json", 30_000.0, 8, cpu_fallback=True)
+    r = _compare(a, b)
+    assert r.returncode == 0, r.stderr
+    assert "incomparable devices" in r.stderr

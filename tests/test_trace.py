@@ -499,3 +499,18 @@ def test_ci_obs_gate_passes():
                        capture_output=True, text=True, cwd=REPO)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "obs-check: OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_mesh_check_gate_passes():
+    """make mesh-check: graftlint + the committed two-host fixture
+    streams merging through trace_export + a live 2-device forced-host
+    bench --mesh smoke, as one script. Slow tier: the smoke pays a
+    fresh JAX import + compile in a subprocess."""
+    r = subprocess.run(["bash",
+                        os.path.join(REPO, "tools", "mesh_check.sh")],
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "mesh-check: OK" in r.stdout
+    assert "bench record OK" in r.stdout

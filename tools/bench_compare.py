@@ -18,13 +18,23 @@ when their device tags differ (``device`` fields anywhere in the
 walked blocks, or a truthy ``cpu_fallback`` marker), the delta table
 still prints but the tolerance gate is refused — an "incomparable
 devices" note and exit 0, because a TPU-vs-CPU-fallback "regression"
-is a config problem, not a perf one. Stdlib-only.
+is a config problem, not a perf one.
+
+One exception: multi-chip records tag the device as ``"<dev0> xN"``
+(bench.py --mesh), so a 4-chip and an 8-chip run of the same silicon
+carry different tags but ARE comparable per chip. When the tags
+normalize to the same silicon (``_base_silicon``) and both records
+expose per-chip metrics (``flips_per_s_per_chip`` headline fields or
+``scaling`` rows), the gate still runs — restricted to the per-chip
+metric names, since aggregate flips/s legitimately moves with the
+device count. Stdlib-only.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
 
@@ -45,12 +55,28 @@ def extract_metrics(doc, out: dict | None = None) -> dict:
     if isinstance(doc, dict):
         if "metric" in doc and isinstance(doc.get("value"), (int, float)):
             out[str(doc["metric"])] = float(doc["value"])
+            if isinstance(doc.get("flips_per_s_per_chip"), (int, float)):
+                # multi-chip headline: the per-chip figure is the one
+                # that gates across differing device counts
+                out[str(doc["metric"]) + ".per_chip"] = \
+                    float(doc["flips_per_s_per_chip"])
         elif ("seconds" in doc and "chains" in doc and "steps" in doc
               and doc.get("seconds")):
             # a bench config line: derive the throughput it measured
             flips = doc["chains"] * max(doc["steps"] - 1, 1)
             out[_config_name(doc) + ".flips_per_s"] = \
                 flips / float(doc["seconds"])
+        scaling = doc.get("scaling")
+        if isinstance(scaling, list):
+            # bench --mesh ladder rows: one metric per rung, named by
+            # device count so the same rung matches across records
+            for row in scaling:
+                if not (isinstance(row, dict) and "devices" in row):
+                    continue
+                for field in ("flips_per_s", "flips_per_s_per_chip"):
+                    if isinstance(row.get(field), (int, float)):
+                        out[f"mesh[devices={row['devices']}].{field}"] = \
+                            float(row[field])
         for key in ("parsed", "results", "metrics"):
             if key in doc:
                 extract_metrics(doc[key], out)
@@ -104,9 +130,25 @@ def device_tags(doc, out: set | None = None) -> set:
     return out
 
 
-def compare(a: dict, b: dict, tolerance: float, out=sys.stdout):
+def _base_silicon(tag: str) -> str:
+    """Collapse a device tag to the silicon it names: lowercase, strip
+    parenthesized detail, a trailing ``xN`` device count (bench --mesh
+    tags), and a trailing per-device ordinal. ``"TFRT_CPU_0 x8"`` and
+    ``"TFRT_CPU_0 x2"`` both normalize to ``"tfrt_cpu"``."""
+    t = tag.lower()
+    t = re.sub(r"\s*\(.*?\)", "", t)
+    t = re.sub(r"\s+x\d+$", "", t)
+    t = re.sub(r"[:_]\d+$", "", t)
+    return t.strip()
+
+
+def compare(a: dict, b: dict, tolerance: float, out=sys.stdout,
+            gate_names=None):
     """Print the delta table; return the list of regressed metric names.
-    Higher is better (every extracted metric is a throughput)."""
+    Higher is better (every extracted metric is a throughput). When
+    ``gate_names`` is given, only those metrics can flag REGRESSED —
+    the rest still print for eyeballing (per-chip gating across
+    differing device counts)."""
     names = sorted(set(a) | set(b))
     regressed = []
     print("| metric | A | B | delta |", file=out)
@@ -120,7 +162,8 @@ def compare(a: dict, b: dict, tolerance: float, out=sys.stdout):
             continue
         delta = (vb - va) / va if va else 0.0
         flag = ""
-        if delta < -tolerance:
+        if delta < -tolerance and (gate_names is None
+                                   or name in gate_names):
             flag = " REGRESSED"
             regressed.append(name)
         print(f"| {name} | {_num(va)} | {_num(vb)} "
@@ -157,6 +200,27 @@ def main(argv=None) -> int:
 
     tags_a, tags_b = device_tags(doc_a), device_tags(doc_b)
     if tags_a != tags_b:
+        sil_a = {_base_silicon(t) for t in tags_a if t != "cpu_fallback"}
+        sil_b = {_base_silicon(t) for t in tags_b if t != "cpu_fallback"}
+        fb_a = "cpu_fallback" in tags_a
+        fb_b = "cpu_fallback" in tags_b
+        per_chip = {n for n in common if "per_chip" in n}
+        if fb_a == fb_b and sil_a and sil_a == sil_b and per_chip:
+            # same silicon, different device counts (mesh tags like
+            # "TFRT_CPU_0 x2" vs "x8"): aggregate flips/s legitimately
+            # moves with the count, but per-chip throughput must hold —
+            # gate on the per-chip metrics only
+            regressed = compare(a, b, args.tolerance,
+                                gate_names=per_chip)
+            print("bench_compare: device counts differ but silicon "
+                  f"matches ({sorted(sil_a)[0]}) — gating per-chip "
+                  f"metrics only ({len(per_chip)})", file=sys.stderr)
+            if regressed:
+                print(f"bench_compare: {len(regressed)} per-chip "
+                      f"metric(s) regressed past {args.tolerance:.0%}: "
+                      + ", ".join(regressed), file=sys.stderr)
+                return 1
+            return 0
         # different hardware (or one fell back to CPU): the deltas are
         # still worth eyeballing, but gating on them would turn a setup
         # difference into a fake perf regression

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Multi-chip CI gate (`make mesh-check`): the shard_map scale-out path
+# end to end, without TPU hardware.
+#
+#   1. graftlint over the package + tools (the shard_map bodies in
+#      distribute/ ride the same emit/sync/RNG contracts as everything
+#      else)
+#   2. the committed two-host fixture streams each validate standalone
+#      AND merge into one Chrome trace with one pid per host — the
+#      contract per_host_path/trace_export promise multi-host runs
+#   3. a live 2-device forced-host mesh smoke through `bench.py --mesh`:
+#      the MULTICHIP record must select a fast-path body (bitboard or
+#      lowered, not int8/general), carry per-chip flips/s, and emit an
+#      event stream that survives trace_export --validate
+#
+#   tools/mesh_check.sh
+#
+# Exercised by tests/test_tools.py, so tier-1 fails when any gate rots.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+FIX0=tests/fixtures/obs/events_mesh.host0.jsonl
+FIX1=tests/fixtures/obs/events_mesh.host1.jsonl
+
+"$PY" -m tools.graftlint flipcomplexityempirical_tpu tools
+
+"$PY" tools/trace_export.py --validate "$FIX0" "$FIX1"
+tmp="$(mktemp -d)"
+trap 'rm -rf "$tmp"' EXIT
+"$PY" tools/trace_export.py "$FIX0" "$FIX1" -o "$tmp/mesh_trace.json"
+
+"$PY" bench.py --mesh 2 --cpu --grid 32 --chains 4 --steps 41 \
+    --warmup 21 --chunk 20 --events "$tmp/mesh_events.jsonl" \
+    > "$tmp/mesh_record.json" 2> "$tmp/mesh_detail.json"
+"$PY" tools/trace_export.py --validate "$tmp/mesh_events.jsonl"
+"$PY" - "$tmp/mesh_record.json" <<'PYEOF'
+import json
+import sys
+
+with open(sys.argv[1], encoding="utf-8") as f:
+    rec = json.load(f)
+assert rec["devices"] == 2, rec
+assert rec["body"] in ("bitboard", "lowered"), \
+    f"mesh smoke fell off the fast path: {rec['body']}"
+assert rec["flips_per_s_per_chip"] > 0, rec
+assert [r["devices"] for r in rec["scaling"]] == [1, 2], rec
+print("mesh-check: bench record OK "
+      f"(body={rec['body']}, "
+      f"per-chip {rec['flips_per_s_per_chip']:,.0f} flips/s)")
+PYEOF
+echo "mesh-check: OK"
