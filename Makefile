@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test obs-check mesh-check lint
+.PHONY: test obs-check mesh-check chaos-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -19,6 +19,12 @@ obs-check:
 # per-chip flips/s, valid event stream)
 mesh-check:
 	PYTHON=$(PYTHON) tools/mesh_check.sh
+
+# fault-tolerance gates: a seeded chaos sweep (injected checkpoint +
+# segment faults) must recover byte-identically to a fault-free run,
+# and a poison config must quarantine with a nonzero exit
+chaos-check:
+	PYTHON=$(PYTHON) JAX_PLATFORMS=cpu tools/chaos_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

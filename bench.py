@@ -362,6 +362,8 @@ def main():
 
     # compile + mix in (reach steady-state boundary sizes); same chunk as
     # the timed run so the timed region reuses the compiled kernel
+    from flipcomplexityempirical_tpu.resilience import degrade as rdegrade
+    degrade_mark = rdegrade.snapshot()
     res = run(states, args.warmup, variants[0])
     states = res.state
     # zero telemetry so rates below cover only the timed steps
@@ -544,6 +546,12 @@ def main():
         # explicit stand-in: measured on host CPU because the accelerator
         # probe failed; vs_baseline still divides by the PER-CHIP target
         headline["cpu_fallback"] = True
+    degradations = rdegrade.since(degrade_mark)
+    if degradations:
+        # the winning body was reached by falling off the intended
+        # dispatch path — bench_compare refuses to gate such a record
+        headline["degraded"] = True
+        headline["degradations"] = degradations
     print(json.dumps(headline))
     rec.close()
 
@@ -568,7 +576,9 @@ def _mesh_bench(args, cpu_fallback, g, plan, spec, rec):
     import flipcomplexityempirical_tpu as fce
     from flipcomplexityempirical_tpu import distribute
     from flipcomplexityempirical_tpu.kernel import board as kboard
+    from flipcomplexityempirical_tpu.resilience import degrade as rdegrade
 
+    degrade_mark = rdegrade.snapshot()
     if args.chains is None:
         # per-chip defaults: the single-chip peak on the real chip, the
         # frozen host sweet spot on CPU (module docstring)
@@ -685,6 +695,10 @@ def _mesh_bench(args, cpu_fallback, g, plan, spec, rec):
         headline["graph"] = args.graph
     if cpu_fallback:
         headline["cpu_fallback"] = True
+    degradations = rdegrade.since(degrade_mark)
+    if degradations:
+        headline["degraded"] = True
+        headline["degradations"] = degradations
     print(json.dumps(headline))
 
 

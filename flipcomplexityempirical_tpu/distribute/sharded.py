@@ -37,6 +37,8 @@ except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
 
 from .. import obs
 from ..graphs.lattice import DeviceGraph
+from ..resilience import degrade as rdegrade
+from ..resilience import faults as rfaults
 from ..kernel import bitboard
 from ..kernel import board as kboard
 from ..kernel import step as kstep
@@ -144,6 +146,19 @@ class _ShardedStep:
         self.exchange = exchange
         self._body = body
         self._built: dict = {}
+        # bitboard steps get a zero-arg rebuild hook -> (body, path) so
+        # run_sharded can drop to the int8 board body on a kernel error
+        # (BoardState is shared between the two: the bit-pack happens
+        # inside run_board_chunk, so the carried states need no rewrite)
+        self.fallback = None
+
+    def degrade(self):
+        """Swap in the fallback body and clear the built cache so the
+        next call recompiles on the safer path."""
+        body, path = self.fallback()
+        self._body, self.kernel_path = body, path
+        self._built.clear()
+        self.fallback = None
 
     def _build(self, states):
         pspec = _params_spec(sharded=True)
@@ -254,26 +269,34 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
                              "supported_pair)")
     kernel_path = kboard.body_for(bg, spec, bits)
 
-    def train_step(key, params, states):
-        states, _ = kboard.run_board_chunk(bg, spec, params, states,
-                                           inner_steps, collect=False,
-                                           bits=bits)
-        swaps = jnp.int32(0)
-        if exchange and n_dev > 1:
-            # the board loop carries cut_count incrementally, so it is the
-            # current energy right after a chunk
-            cuts = states.cut_count
-            params, a0 = _swap_round(key, params, cuts, 0, n_dev)
-            # graftlint: disable=G002(_swap_round folds in the parity)
-            params, a1 = _swap_round(key, params, cuts, 1, n_dev)
-            swaps = a0.sum() + a1.sum()
-        info = {
-            "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),
-            "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
-        }
-        return params, states, info
+    def make_body(body_bits):
+        def train_step(key, params, states):
+            states, _ = kboard.run_board_chunk(bg, spec, params, states,
+                                               inner_steps, collect=False,
+                                               bits=body_bits)
+            swaps = jnp.int32(0)
+            if exchange and n_dev > 1:
+                # the board loop carries cut_count incrementally, so it is
+                # the current energy right after a chunk
+                cuts = states.cut_count
+                params, a0 = _swap_round(key, params, cuts, 0, n_dev)
+                # graftlint: disable=G002(_swap_round folds in the parity)
+                params, a1 = _swap_round(key, params, cuts, 1, n_dev)
+                swaps = a0.sum() + a1.sum()
+            info = {
+                "accepts": jax.lax.psum(states.accept_count.sum(),
+                                        CHAINS_AXIS),
+                "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
+            }
+            return params, states, info
+        return train_step
 
-    return _ShardedStep(mesh, train_step, kernel_path, n_dev, exchange)
+    step = _ShardedStep(mesh, make_body(bits), kernel_path, n_dev,
+                        exchange)
+    if kernel_path == "bitboard":
+        step.fallback = lambda: (make_body(False),
+                                 kboard.body_for(bg, spec, False))
+    return step
 
 
 def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
@@ -322,7 +345,21 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
         if rec:
             csp = obs.span(rec, "chunk", kernel_path=step.kernel_path,
                            steps=inner_steps, round=r).begin()
-        params, states, info_dev = step(kr, params, states)
+        try:
+            rfaults.fault_point("compile", path=step.kernel_path, round=r)
+            params, states, info_dev = step(kr, params, states)
+        except Exception as e:
+            if not rdegrade.is_kernel_error(e) or step.fallback is None:
+                raise
+            prev_path = step.kernel_path
+            step.degrade()
+            rdegrade.record_degradation(rec, prev_path, step.kernel_path,
+                                        reason=rdegrade.describe_error(e),
+                                        round=r)
+            # same key on purpose: the failed dispatch never consumed it,
+            # and the fallback body must replay the identical round
+            params, states, info_dev = step(
+                kr, params, states)  # graftlint: disable=G002(retry replays the unconsumed key)
         # device-side accumulation: no host sync until the run-end readback
         swaps_dev = swaps_dev + info_dev["swaps"]
         if rec:

@@ -4,13 +4,17 @@ from .config import (
 )
 from .driver import (
     run_config, run_sweep, is_done, build_graph_and_plan,
-    save_checkpoint, load_checkpoint,
+    save_checkpoint, load_checkpoint, install_live_hooks,
 )
 from .artifacts import ARTIFACT_KINDS
+from ..resilience.supervisor import (RetryPolicy, SweepReport,
+                                     run_supervised_sweep)
 
 __all__ = [
     "ExperimentConfig", "sec11_sweep", "frank_sweep", "MU",
     "SEC11_BASES", "SEC11_POPS", "FRANK_BASES", "FRANK_POPS",
     "run_config", "run_sweep", "is_done", "build_graph_and_plan",
-    "save_checkpoint", "load_checkpoint", "ARTIFACT_KINDS",
+    "save_checkpoint", "load_checkpoint", "install_live_hooks",
+    "ARTIFACT_KINDS", "RetryPolicy", "SweepReport",
+    "run_supervised_sweep",
 ]

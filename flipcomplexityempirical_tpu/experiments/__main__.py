@@ -4,12 +4,24 @@
 
 Runs the reference sweep grids with skip-if-done resume, emitting the
 13-artifact set per config with reference-compatible filenames.
+
+Sweeps run SUPERVISED by default (resilience.supervisor): each config is
+isolated, transient failures retry with seeded exponential backoff and
+resume from the last checkpoint, deterministic failures quarantine the
+config after a repeat, and the process exits nonzero when anything was
+quarantined or exhausted its retries. ``--no-supervise`` restores the
+bare fail-fast loop. ``--faults`` (or the GRAFT_FAULTS env var) installs
+a deterministic fault-injection plan for chaos testing — see
+resilience/faults.py for the grammar.
 """
 
 import argparse
 import os
+import sys
 
 from ..obs import from_spec
+from ..resilience import faults as rfaults
+from ..resilience.supervisor import RetryPolicy, run_supervised_sweep
 from .config import SWEEPS
 from .driver import run_sweep
 
@@ -56,6 +68,24 @@ def main():
                          "sweep re-runs and resumed runs skip the "
                          "~30-60s/config compile (cache keys cover "
                          "graph shape, spec, and chain count)")
+    ap.add_argument("--faults", metavar="SPEC", default=None,
+                    help="fault-injection plan, e.g. "
+                         "'checkpoint.write:once,segment.step:p=0.1,"
+                         "seed=7' (overrides the GRAFT_FAULTS env var); "
+                         "see resilience/faults.py for the grammar")
+    ap.add_argument("--retries", type=int, default=3,
+                    help="max retries per config before it is marked "
+                         "failed (supervised sweeps)")
+    ap.add_argument("--quarantine-after", type=int, default=2,
+                    help="deterministic failures of one config before it "
+                         "is quarantined instead of retried")
+    ap.add_argument("--deadline", type=float, default=None, metavar="S",
+                    help="cooperative per-config wall budget in seconds; "
+                         "checked between segments, classified as a "
+                         "resource failure")
+    ap.add_argument("--no-supervise", action="store_true",
+                    help="bare fail-fast sweep loop (no retries, no "
+                         "quarantine, first error aborts the process)")
     args = ap.parse_args()
     if args.cpu:
         import jax
@@ -76,9 +106,23 @@ def main():
     if args.only:
         configs = [c for c in configs if c.tag in set(args.only)]
     heartbeat = args.heartbeat or os.path.join(args.out, "heartbeat.json")
+    if args.faults is not None:
+        rfaults.install_from_spec(args.faults)
+    else:
+        rfaults.install_from_env()
     with from_spec(args.events) as rec:
-        run_sweep(configs, args.out, checkpoint_dir=args.checkpoint_dir,
-                  recorder=rec, heartbeat=heartbeat)
+        if args.no_supervise:
+            run_sweep(configs, args.out,
+                      checkpoint_dir=args.checkpoint_dir,
+                      recorder=rec, heartbeat=heartbeat)
+            return
+        policy = RetryPolicy(max_retries=args.retries,
+                             quarantine_after=args.quarantine_after,
+                             deadline_s=args.deadline, seed=args.seed)
+        report = run_supervised_sweep(
+            configs, args.out, checkpoint_dir=args.checkpoint_dir,
+            recorder=rec, heartbeat=heartbeat, policy=policy)
+    sys.exit(report.exit_code)
 
 
 if __name__ == "__main__":

@@ -16,8 +16,10 @@ everything).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import sys
 import time
 from types import SimpleNamespace
 from typing import Optional
@@ -26,6 +28,10 @@ import jax
 import numpy as np
 
 from .. import compat, obs
+from ..resilience import degrade as rdegrade
+from ..resilience import faults as rfaults
+from ..resilience.errors import CheckpointIdentityError, KernelPathError
+from ..resilience.supervisor import check_deadline
 from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
                       square_grid, triangular_lattice, hex_lattice,
                       stripes_plan, from_geojson, synthetic_precincts,
@@ -136,7 +142,7 @@ def run_config(cfg: ExperimentConfig, outdir: str,
     with obs.span(rec, "render", tag=cfg.tag, phase="start"):
         render_start(g, cfg.family, outdir, cfg.tag, signed,
                      cfg.plot_node_size, pos=pos)
-    t0 = time.time()
+    t0 = time.monotonic()
     if cfg.backend == "python":
         if cfg.family not in ("sec11", "frank"):
             raise ValueError("backend='python' (the compat oracle) only "
@@ -149,7 +155,7 @@ def run_config(cfg: ExperimentConfig, outdir: str,
                            recorder=recorder)
     else:
         data = _run_jax(cfg, g, plan, checkpoint_dir, recorder=recorder)
-    data["seconds"] = time.time() - t0
+    data["seconds"] = time.monotonic() - t0
     if cfg.n_districts == 2:
         with obs.span(rec, "partisan", tag=cfg.tag):
             data["partisan"] = _partisan_summary(cfg, g, data)
@@ -202,7 +208,7 @@ def run_config(cfg: ExperimentConfig, outdir: str,
 
 def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
              _stop_after_segments: Optional[int] = None,
-             recorder=None) -> dict:
+             recorder=None, _force_general: bool = False) -> dict:
     """Batched run, in checkpoint segments when cfg.checkpoint_every > 0.
 
     A crash between segments loses at most ``checkpoint_every`` steps: the
@@ -219,13 +225,19 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     masked-plane stencil body: sec11's corner surgery, the Frankengraph
     seam, queen grids, triangular lattices (a grid plus one diagonal
     plane). Truly irregular graphs (hex — radius-3 patches — and dual
-    graphs) fall back to the general gather kernel."""
+    graphs) fall back to the general gather kernel.
+
+    ``_force_general`` is the kernel-degradation rerun (resilience
+    ladder): when every board-family body has failed, the config reruns
+    here on the general gather kernel; a board-path checkpoint is then
+    incompatible (different state pytree) and is deliberately ignored —
+    an honest fresh start beats resuming corrupt state."""
     from ..sampling.board_runner import run_board_segment
 
     rec = obs.resolve_recorder(recorder)
     spec = spec_for(cfg)
     labels = _labels_for(cfg)
-    use_board = kboard.supports(g, spec)
+    use_board = kboard.supports(g, spec) and not _force_general
     if use_board:
         handle, states, params = init_board(
             g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
@@ -239,7 +251,8 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     n_parts = 0
     hist_parts: dict = {}
     waits_total = np.zeros(cfg.n_chains, np.float64)
-    resumed = _load_resume(checkpoint_dir, cfg, states)
+    resumed = _load_resume(checkpoint_dir, cfg, states, recorder=recorder,
+                           ignore_mismatch=_force_general)
     if resumed is not None:
         done, n_parts, states, hist_parts, waits_total, _ = resumed
 
@@ -254,11 +267,25 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     total = cfg.total_steps - (1 if use_board else 0)
     segments = 0
     while done < total:
+        check_deadline()
+        rfaults.fault_point("segment.step", tag=cfg.tag, done=done)
         n = min(every, total - done)
         if use_board:
-            res = run_board_segment(handle, spec, params, states, n,
-                                    record_every=cfg.record_every,
-                                    recorder=recorder)
+            try:
+                res = run_board_segment(handle, spec, params, states, n,
+                                        record_every=cfg.record_every,
+                                        recorder=recorder)
+            except KernelPathError as e:
+                # the board family is out of bodies for this workload:
+                # rerun the whole config on the general gather kernel.
+                # Board and general states are different pytrees, so any
+                # board checkpoint is ignored (fresh general start).
+                rdegrade.record_degradation(rec, e.path, "general",
+                                            reason=str(e.cause),
+                                            tag=cfg.tag)
+                return _run_jax(cfg, g, plan, checkpoint_dir,
+                                _stop_after_segments, recorder=recorder,
+                                _force_general=True)
         else:
             res = run_chains(handle, spec, params, states,
                              n_steps=n, record_initial=(done == 0),
@@ -444,7 +471,7 @@ def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
     accepts = np.zeros(n_rungs - 1, np.int64)
     parity = 0
     swap_key = jax.random.PRNGKey(cfg.seed)
-    resumed = _load_resume(checkpoint_dir, cfg, states)
+    resumed = _load_resume(checkpoint_dir, cfg, states, recorder=recorder)
     if resumed is not None:
         done, n_parts, states, hist_parts, waits_total, ex = resumed
         params = params.replace(beta=jax.numpy.asarray(ex["beta"]))
@@ -458,6 +485,8 @@ def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
     segments = 0
     res = None
     while done < total:
+        check_deadline()
+        rfaults.fault_point("segment.step", tag=cfg.tag, done=done)
         n = min(every, total - done)
         last = done + n >= total
         res = run_tempered(
@@ -532,16 +561,24 @@ class _SegmentStop(RuntimeError):
         self.done = done
 
 
-def _state_from_arrays(template, loaded: dict):
+def _state_from_arrays(template, loaded: dict, tag: str = "",
+                       identity: str = ""):
     """Rebuild a device chain state from checkpoint arrays, using the
     freshly-initialized state as the shape/dtype template. Fields that
     are None on the template (absent from the checkpoint) stay None;
     a template field MISSING from the checkpoint means the checkpoint
     was written by a different kernel path (e.g. a pre-lowering general
-    run of a now-lowered graph) — raise KeyError so _load_resume
-    restarts loudly instead of resuming corrupt state."""
+    run of a now-lowered graph) — raise CheckpointIdentityError naming
+    both field sets and the remedy instead of resuming corrupt state."""
     import jax.numpy as jnp
 
+    found = [k[len("state_"):] for k in loaded
+             if k.startswith("state_")]
+    expected = [f for f in template.__dataclass_fields__
+                if getattr(template, f) is not None]
+    if set(expected) - set(found):
+        raise CheckpointIdentityError(tag, expected, found,
+                                      identity=identity)
     fields = {}
     for f in template.__dataclass_fields__:
         if getattr(template, f) is None and f"state_{f}" not in loaded:
@@ -552,25 +589,33 @@ def _state_from_arrays(template, loaded: dict):
     return type(template)(**fields)
 
 
-def _load_resume(checkpoint_dir, cfg: ExperimentConfig, states_template):
+def _load_resume(checkpoint_dir, cfg: ExperimentConfig, states_template,
+                 recorder=None, ignore_mismatch: bool = False):
     """The shared resume unpack for every segmented runner: None for a
     fresh start, else (done, n_parts, states, hist_parts, waits_total,
     extras) — ``extras`` being the runner-specific extra_* continuation
-    arrays (the temper family's ladder state)."""
+    arrays (the temper family's ladder state).
+
+    A state-field mismatch (checkpoint written under a different kernel
+    path/Spec) raises ``CheckpointIdentityError`` — the supervisor
+    classifies it deterministic, so it surfaces instead of being
+    silently retried. ``ignore_mismatch=True`` (the kernel-degradation
+    rerun) downgrades it to a loud fresh start."""
     if not checkpoint_dir:
         return None
-    loaded = load_checkpoint(checkpoint_dir, cfg)
+    loaded = load_checkpoint(checkpoint_dir, cfg, recorder=recorder)
     if loaded is None:
         return None
     try:
-        states = _state_from_arrays(states_template, loaded)
-    except KeyError as e:
-        # state-field mismatch: the checkpoint predates a kernel-path
-        # change (e.g. written by the general runner before this graph
-        # lowered onto the board path). Restart loudly from scratch.
-        print(f"[ckpt] ignoring {cfg.tag}: state field {e} missing "
-              "(written by a different kernel path); restarting")
-        return None
+        states = _state_from_arrays(states_template, loaded, tag=cfg.tag,
+                                    identity=_ckpt_identity(cfg))
+    except CheckpointIdentityError as e:
+        if ignore_mismatch:
+            # the checkpoint belongs to the kernel path we just
+            # abandoned (degradation rerun): restart fresh, loudly
+            print(f"[ckpt] {e}; restarting fresh on the degraded path")
+            return None
+        raise
     return (int(loaded["meta_done"]),
             int(loaded["meta_n_parts"]),
             states,
@@ -699,6 +744,87 @@ def _ckpt_identity(cfg: ExperimentConfig) -> str:
             f"se={cfg.swap_every}")
 
 
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for blk in iter(lambda: f.read(1 << 20), b""):
+            h.update(blk)
+    return h.hexdigest()
+
+
+def _fsync_dir(d: str):
+    """Durably commit a rename: fsync the containing directory (a no-op
+    where the platform/filesystem refuses directory fds)."""
+    try:
+        fd = os.open(d or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        return
+    finally:
+        os.close(fd)
+
+
+def _write_npz(path: str, arrays: dict) -> str:
+    """write-to-temp + fsync + atomic rename; returns the SHA-256 of
+    the bytes written (hashed on the temp file, so any later divergence
+    of the renamed file — a torn write, bit rot — is detectable)."""
+    tmp = path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    digest = _sha256_file(tmp)
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+    return digest
+
+
+def _read_npz(path: str) -> Optional[dict]:
+    """dict of arrays, or None when the file is unreadable (truncated,
+    bit-rotted, not an npz) — integrity handling must never crash."""
+    try:
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    except Exception:
+        return None
+
+
+def _manifest_path(ckpt_dir: str, cfg: ExperimentConfig) -> str:
+    return os.path.join(ckpt_dir, cfg.tag + ".manifest.json")
+
+
+def _load_manifest(ckpt_dir: str, cfg: ExperimentConfig):
+    mpath = _manifest_path(ckpt_dir, cfg)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath, encoding="utf-8") as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(man, dict) or man.get("version") != 1:
+        return None
+    man.setdefault("gen", -1)
+    man.setdefault("current", None)
+    man.setdefault("previous", None)
+    man.setdefault("parts", {})
+    return man
+
+
+def _write_manifest(ckpt_dir: str, cfg: ExperimentConfig, man: dict):
+    mpath = _manifest_path(ckpt_dir, cfg)
+    tmp = mpath + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(man, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, mpath)
+    _fsync_dir(ckpt_dir)
+
+
 def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,
                     done: int = 0, waits_total=None, new_hist=None,
                     part_idx: int = 0, extra: Optional[dict] = None) -> int:
@@ -709,14 +835,27 @@ def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,
     history goes to its own ``<tag>.h<k>.npz`` part file so a save costs
     O(segment), not O(run-so-far). The main file is written atomically
     AFTER its part, so meta_n_parts never points at a missing file.
-    Returns the next part index."""
+    Returns the next part index.
+
+    Integrity (ISSUE 7): every file goes through write-to-temp + fsync
+    + atomic rename and its SHA-256 lands in ``<tag>.manifest.json``;
+    the manifest keeps the last TWO generations — before each save the
+    old main rotates to ``<tag>.prev.npz`` — so ``load_checkpoint`` can
+    fall back one generation when the newest fails verification. The
+    current generation stays at exactly ``<tag>.npz`` (pre-manifest
+    readers and tooling keep working)."""
     os.makedirs(ckpt_dir, exist_ok=True)
+    rfaults.fault_point("checkpoint.write", tag=cfg.tag, done=done)
+    man = _load_manifest(ckpt_dir, cfg)
+    if man is None:
+        man = {"version": 1, "tag": cfg.tag, "gen": -1,
+               "current": None, "previous": None, "parts": {}}
     if new_hist:
-        ppath = os.path.join(ckpt_dir, f"{cfg.tag}.h{part_idx:04d}.npz")
-        np.savez_compressed(ppath + ".tmp.npz",
-                            **{k: np.asarray(v)
-                               for k, v in new_hist.items()})
-        os.replace(ppath + ".tmp.npz", ppath)
+        pname = f"{cfg.tag}.h{part_idx:04d}.npz"
+        ppath = os.path.join(ckpt_dir, pname)
+        man["parts"][pname] = _write_npz(
+            ppath, {k: np.asarray(v) for k, v in new_hist.items()})
+        rfaults.corrupt_file("checkpoint.write", ppath)
         part_idx += 1
     # None fields (e.g. the diagonal cut_times planes on non-lowered
     # board states) are omitted; _state_from_arrays restores them as None
@@ -731,59 +870,261 @@ def save_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, host_state,
     for k, v in (extra or {}).items():
         arrays[f"extra_{k}"] = np.asarray(v)
     path = os.path.join(ckpt_dir, cfg.tag + ".npz")
-    np.savez_compressed(path + ".tmp.npz", **arrays)
-    os.replace(path + ".tmp.npz", path)
+    prev_path = os.path.join(ckpt_dir, cfg.tag + ".prev.npz")
+    cur = man["current"]
+    if cur is not None:
+        cur_path = os.path.join(ckpt_dir, cur["file"])
+        if os.path.exists(cur_path):
+            if os.path.abspath(cur_path) == os.path.abspath(path):
+                os.replace(cur_path, prev_path)
+                cur = dict(cur, file=os.path.basename(prev_path))
+            # else: current already sits at the .prev slot (a
+            # post-fallback resume) and simply stays the fallback
+            man["previous"] = cur
+    digest = _write_npz(path, arrays)
+    rfaults.corrupt_file("checkpoint.write", path)
+    man["gen"] += 1
+    man["current"] = {"gen": man["gen"], "file": cfg.tag + ".npz",
+                      "sha256": digest, "done": int(done),
+                      "n_parts": int(part_idx)}
+    _write_manifest(ckpt_dir, cfg, man)
     return part_idx
 
 
-def load_checkpoint(ckpt_dir: str, cfg: ExperimentConfig):
-    """Load and validate a checkpoint; None (fresh start) when absent,
-    written by an incompatible config, or in an unrecognized format —
-    the recovery path must never crash on stale files."""
-    path = os.path.join(ckpt_dir, cfg.tag + ".npz")
-    if not os.path.exists(path):
-        return None
-    d = dict(np.load(path))
+def _meta_checks(cfg: ExperimentConfig, d: dict, path: str) -> bool:
+    """Format/identity/progress validation of a loaded main file — the
+    'is this checkpoint for THIS run' gate (distinct from integrity:
+    a mismatch means fresh start, never generation fallback)."""
     if "meta_done" not in d or "meta_identity" not in d:
         print(f"[ckpt] ignoring {path}: unrecognized format")
-        return None
+        return False
     if str(d["meta_identity"]) != _ckpt_identity(cfg):
         print(f"[ckpt] ignoring {path}: config mismatch "
               f"({d['meta_identity']} != {_ckpt_identity(cfg)})")
-        return None
+        return False
     if int(d["meta_done"]) > cfg.total_steps:
         print(f"[ckpt] ignoring {path}: more steps than requested")
-        return None
+        return False
+    return True
+
+
+def _generation_payload(ckpt_dir, cfg, man, entry):
+    """Verify + load one manifest generation. Returns
+    ``(d, None, None)`` on success (parts concatenated into hist_*),
+    else ``(None, reason, bad_path)`` naming the file that failed."""
+    epath = os.path.join(ckpt_dir, entry["file"])
+    if not os.path.exists(epath):
+        return None, "missing main file", epath
+    if _sha256_file(epath) != entry["sha256"]:
+        return None, "main file checksum mismatch", epath
+    d = _read_npz(epath)
+    if d is None:
+        return None, "unreadable main file", epath
     hist: dict = {}
-    for k in range(int(d["meta_n_parts"])):
-        ppath = os.path.join(ckpt_dir, f"{cfg.tag}.h{k:04d}.npz")
+    for k in range(int(entry["n_parts"])):
+        pname = f"{cfg.tag}.h{k:04d}.npz"
+        ppath = os.path.join(ckpt_dir, pname)
         if not os.path.exists(ppath):
-            print(f"[ckpt] ignoring {path}: missing part {ppath}")
-            return None
-        for name, arr in np.load(ppath).items():
+            return None, f"missing part {pname}", ppath
+        want = man["parts"].get(pname)
+        if want is not None and _sha256_file(ppath) != want:
+            return None, f"part {pname} checksum mismatch", ppath
+        pd = _read_npz(ppath)
+        if pd is None:
+            return None, f"unreadable part {pname}", ppath
+        for name, arr in pd.items():
             hist.setdefault(name, []).append(arr)
     for name, parts in hist.items():
         d[f"hist_{name}"] = np.concatenate(parts, axis=1)
-    return d
+    return d, None, None
 
 
-def write_heartbeat(path: Optional[str], **payload):
+def _quarantine_generation(ckpt_dir, cfg, man, entry, reason, bad_path,
+                           rec):
+    """A generation failed verification: move its main file (and the
+    specific bad file) into ``.corrupt/``, emit ``checkpoint_corrupt``,
+    and promote the previous generation to current. Shared history
+    parts the fallback generation still references are left in place
+    (if the bad file IS shared, the fallback fails its own check next
+    and resume degrades to a fresh start — never a crash)."""
+    cdir = os.path.join(ckpt_dir, ".corrupt")
+    os.makedirs(cdir, exist_ok=True)
+    prev = man.get("previous")
+    prev_parts = int(prev["n_parts"]) if prev else 0
+    moved = []
+    epath = os.path.join(ckpt_dir, entry["file"])
+    for k in range(prev_parts, int(entry["n_parts"])):
+        pname = f"{cfg.tag}.h{k:04d}.npz"
+        ppath = os.path.join(ckpt_dir, pname)
+        if os.path.exists(ppath):
+            moved.append(ppath)
+        man["parts"].pop(pname, None)
+    if bad_path and bad_path not in moved and os.path.exists(bad_path) \
+            and bad_path != epath:
+        moved.append(bad_path)
+    if os.path.exists(epath):
+        moved.append(epath)
+    for src in moved:
+        dst = os.path.join(
+            cdir, f"g{int(entry['gen']):04d}.{os.path.basename(src)}")
+        os.replace(src, dst)
+    print(f"[ckpt] {cfg.tag}: generation {entry['gen']} corrupt "
+          f"({reason}); quarantined {len(moved)} file(s) to {cdir}, "
+          f"falling back to generation "
+          f"{prev['gen'] if prev else 'none (fresh start)'}")
+    if rec:
+        rec.emit("checkpoint_corrupt", tag=cfg.tag, path=epath,
+                 reason=reason, generation=int(entry["gen"]),
+                 quarantined=[os.path.basename(p) for p in moved])
+    man["current"] = prev
+    man["previous"] = None
+    _write_manifest(ckpt_dir, cfg, man)
+
+
+def load_checkpoint(ckpt_dir: str, cfg: ExperimentConfig, recorder=None):
+    """Load and validate a checkpoint; None (fresh start) when absent,
+    written by an incompatible config, or in an unrecognized format —
+    the recovery path must never crash on stale files.
+
+    With a manifest present every generation is SHA-256-verified before
+    use; a corrupt/truncated generation is quarantined to ``.corrupt/``
+    (``checkpoint_corrupt`` event) and the previous generation is tried
+    instead — a torn checkpoint write now costs one generation of
+    progress, not the whole run. Pre-manifest checkpoints load through
+    the legacy unverified path."""
+    rec = obs.resolve_recorder(recorder)
+    path = os.path.join(ckpt_dir, cfg.tag + ".npz")
+    rfaults.fault_point("checkpoint.load", tag=cfg.tag)
+    rfaults.corrupt_file("checkpoint.load", path)
+    man = _load_manifest(ckpt_dir, cfg)
+    if man is None:
+        # legacy (pre-manifest / hand-dropped) checkpoint: single
+        # generation, no integrity data
+        if not os.path.exists(path):
+            return None
+        d = _read_npz(path)
+        if d is None:
+            print(f"[ckpt] ignoring {path}: unreadable "
+                  "(no manifest, no fallback generation)")
+            return None
+        if not _meta_checks(cfg, d, path):
+            return None
+        hist: dict = {}
+        for k in range(int(d["meta_n_parts"])):
+            ppath = os.path.join(ckpt_dir, f"{cfg.tag}.h{k:04d}.npz")
+            if not os.path.exists(ppath):
+                print(f"[ckpt] ignoring {path}: missing part {ppath}")
+                return None
+            pd = _read_npz(ppath)
+            if pd is None:
+                print(f"[ckpt] ignoring {path}: unreadable part {ppath}")
+                return None
+            for name, arr in pd.items():
+                hist.setdefault(name, []).append(arr)
+        for name, parts in hist.items():
+            d[f"hist_{name}"] = np.concatenate(parts, axis=1)
+        return d
+    while man["current"] is not None:
+        entry = man["current"]
+        d, reason, bad_path = _generation_payload(ckpt_dir, cfg, man,
+                                                  entry)
+        if d is None:
+            _quarantine_generation(ckpt_dir, cfg, man, entry, reason,
+                                   bad_path, rec)
+            continue
+        epath = os.path.join(ckpt_dir, entry["file"])
+        if not _meta_checks(cfg, d, epath):
+            return None
+        return d
+    return None
+
+
+def write_heartbeat(path: Optional[str], recorder=None, **payload):
     """Atomically (tmp+rename) refresh the sweep's heartbeat file: one
     small JSON object a watcher (or a resuming operator) can poll to see
     where a multi-hour sweep is WITHOUT parsing the event stream — the
     reference's only liveness signal was artifacts appearing on disk
     (SURVEY.md §5). Always carries ``ts``; a stale ts is the hang
-    detector."""
+    detector (obs_report --strict --heartbeat flags mtimes older than
+    2x the expected interval).
+
+    Failures are NON-fatal (ISSUE 7 satellite): a full disk or missing
+    dir logs a ``heartbeat_error`` event (when a recorder is live) and
+    the run continues — liveness telemetry must never abort a segment."""
     if not path:
         return
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    payload["ts"] = time.time()
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=1)
-    os.replace(tmp, path)
+    try:
+        rfaults.fault_point("heartbeat.write", path=path)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        payload["ts"] = time.time()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+    except (OSError, rfaults.InjectedFault) as e:
+        msg = f"{type(e).__name__}: {e}"
+        print(f"[heartbeat] write failed ({msg}); continuing",
+              file=sys.stderr)
+        rec = obs.resolve_recorder(recorder)
+        if rec:
+            rec.emit("heartbeat_error", message=msg, path=path)
+
+
+def install_live_hooks(rec, heartbeat, cfg, progress: dict):
+    """Wire the recorder's live-observer hooks for one in-flight config:
+    ChainMonitor calls ``rec.diag_hook`` / ``rec.anomaly_hook``, the
+    runners' MetricsRegistry.notify calls ``rec.metrics_hook``; each
+    refresh re-writes the heartbeat with whatever has been seen so far
+    (keys ``diag`` / ``anomalies`` — a per-kind episode tally — /
+    ``metrics``). Returns ``(hb_state, uninstall)``. ``hb_state`` is
+    kept live even without a heartbeat path: the supervisor's error
+    classifier reads ``hb_state["anomalies"]`` to tell a config that
+    failed while frozen/collapsed (deterministic) from a machinery
+    hiccup (transient). Shared by run_sweep and
+    resilience.supervisor.run_supervised_sweep."""
+    hb_state = {"diag": None, "metrics": None, "anomalies": {}}
+
+    def _uninstall():
+        if rec:
+            rec.diag_hook = None
+            rec.anomaly_hook = None
+            rec.metrics_hook = None
+
+    if not rec:
+        return hb_state, _uninstall
+
+    def _hb_refresh(_tag=cfg.tag, _state=hb_state):
+        if not heartbeat:
+            return
+        extra = {}
+        if _state["diag"] is not None:
+            extra["diag"] = {_tag: _state["diag"]}
+        if _state["metrics"] is not None:
+            extra["metrics"] = {_tag: _state["metrics"]}
+        if _state["anomalies"]:
+            extra["anomalies"] = {_tag: dict(_state["anomalies"])}
+        write_heartbeat(heartbeat, recorder=rec, status="running",
+                        current=_tag, last=None, **progress, **extra)
+
+    def _on_diag(diag, _state=hb_state, _hb=_hb_refresh):
+        _state["diag"] = diag
+        _hb()
+
+    def _on_anomaly(anom, _state=hb_state, _hb=_hb_refresh):
+        kind = anom.get("kind", "unknown")
+        _state["anomalies"][kind] = _state["anomalies"].get(kind, 0) + 1
+        _hb()
+
+    def _on_metrics(snap, _state=hb_state, _hb=_hb_refresh):
+        _state["metrics"] = snap
+        _hb()
+
+    rec.diag_hook = _on_diag
+    rec.anomaly_hook = _on_anomaly
+    rec.metrics_hook = _on_metrics
+    return hb_state, _uninstall
 
 
 def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
@@ -823,61 +1164,27 @@ def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
                          status="skip",
                          artifacts=len(artifact_kinds(cfg.family)),
                          index=i, n_configs=len(configs))
-                write_heartbeat(heartbeat, status="running", current=None,
+                write_heartbeat(heartbeat, recorder=rec,
+                                status="running", current=None,
                                 last=cfg.tag, n_done=n_done,
                                 n_skipped=n_skipped,
                                 n_configs=len(configs))
                 continue
-            t0 = time.time()
+            t0 = time.monotonic()
             rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
                      status="start",
                      artifacts=count_artifacts(cfg, outdir),
                      index=i, n_configs=len(configs))
-            write_heartbeat(heartbeat, status="running", current=cfg.tag,
+            write_heartbeat(heartbeat, recorder=rec, status="running",
+                            current=cfg.tag,
                             last=None, n_done=n_done, n_skipped=n_skipped,
                             n_configs=len(configs))
             cfg_span = obs.span(rec, "config", tag=cfg.tag,
                                 family=cfg.family).begin()
-            if rec and heartbeat:
-                # live heartbeat enrichment for the config in flight:
-                # ChainMonitor calls rec.diag_hook with each diag event
-                # and rec.anomaly_hook with each anomaly episode; the
-                # runners' MetricsRegistry.notify calls rec.metrics_hook
-                # once per chunk. Each refresh carries whatever has been
-                # seen so far.
-                hb_state = {"diag": None, "metrics": None, "anomalies": {}}
-
-                def _hb_refresh(_tag=cfg.tag, _state=hb_state):
-                    extra = {}
-                    if _state["diag"] is not None:
-                        extra["diag"] = {_tag: _state["diag"]}
-                    if _state["metrics"] is not None:
-                        extra["metrics"] = {_tag: _state["metrics"]}
-                    if _state["anomalies"]:
-                        extra["anomalies"] = {_tag:
-                                              dict(_state["anomalies"])}
-                    write_heartbeat(heartbeat, status="running",
-                                    current=_tag, last=None,
-                                    n_done=n_done, n_skipped=n_skipped,
-                                    n_configs=len(configs), **extra)
-
-                def _on_diag(diag, _state=hb_state, _hb=_hb_refresh):
-                    _state["diag"] = diag
-                    _hb()
-
-                def _on_anomaly(anom, _state=hb_state, _hb=_hb_refresh):
-                    kind = anom.get("kind", "unknown")
-                    _state["anomalies"][kind] = \
-                        _state["anomalies"].get(kind, 0) + 1
-                    _hb()
-
-                def _on_metrics(snap, _state=hb_state, _hb=_hb_refresh):
-                    _state["metrics"] = snap
-                    _hb()
-
-                rec.diag_hook = _on_diag
-                rec.anomaly_hook = _on_anomaly
-                rec.metrics_hook = _on_metrics
+            _, uninstall = install_live_hooks(
+                rec, heartbeat, cfg,
+                dict(n_done=n_done, n_skipped=n_skipped,
+                     n_configs=len(configs)))
             try:
                 data = run_config(cfg, outdir, checkpoint_dir,
                                   recorder=rec)
@@ -885,35 +1192,32 @@ def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
                 rec.emit("error", message=f"{type(e).__name__}: {e}",
                          tag=cfg.tag, family=cfg.family)
                 cfg_span.end(error=type(e).__name__)
-                write_heartbeat(heartbeat, status="error",
+                write_heartbeat(heartbeat, recorder=rec, status="error",
                                 current=cfg.tag, last=None, n_done=n_done,
                                 n_skipped=n_skipped,
                                 n_configs=len(configs),
                                 error=f"{type(e).__name__}: {e}")
                 raise
             finally:
-                if rec and heartbeat:
-                    rec.diag_hook = None
-                    rec.anomaly_hook = None
-                    rec.metrics_hook = None
+                uninstall()
             n_done += 1
-            cfg_span.end(seconds=time.time() - t0)
+            cfg_span.end(seconds=time.monotonic() - t0)
             rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
                      status="done",
                      artifacts=count_artifacts(cfg, outdir),
-                     seconds=time.time() - t0, index=i,
+                     seconds=time.monotonic() - t0, index=i,
                      n_configs=len(configs))
-            write_heartbeat(heartbeat, status="running", current=None,
-                            last=cfg.tag, n_done=n_done,
+            write_heartbeat(heartbeat, recorder=rec, status="running",
+                            current=None, last=cfg.tag, n_done=n_done,
                             n_skipped=n_skipped, n_configs=len(configs))
             if verbose:
                 print(f"[done] {cfg.family} {cfg.tag} "
                       f"waits={data['waits_sum']:.4g} "
-                      f"({time.time() - t0:.1f}s)")
+                      f"({time.monotonic() - t0:.1f}s)")
             results.append((cfg, data))
     finally:
         sweep_span.end(n_done=n_done, n_skipped=n_skipped)
-    write_heartbeat(heartbeat, status="complete", current=None,
-                    last=None, n_done=n_done, n_skipped=n_skipped,
-                    n_configs=len(configs))
+    write_heartbeat(heartbeat, recorder=rec, status="complete",
+                    current=None, last=None, n_done=n_done,
+                    n_skipped=n_skipped, n_configs=len(configs))
     return results

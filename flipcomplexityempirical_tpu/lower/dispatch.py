@@ -15,6 +15,23 @@ from __future__ import annotations
 from ..graphs.lattice import LatticeGraph
 from .stencil import stencil_for
 
+# The dispatch order, fastest body first. Degradation (resilience.degrade)
+# walks this ladder downward when a body fails to compile or run — but
+# only between bodies that share a state layout: bitboard -> board is an
+# in-segment retry (both carry BoardState), everything else -> general
+# means a config-level restart on the general runner.
+DISPATCH_LADDER = ("lowered", "bitboard", "board", "general")
+
+
+def next_path(path: str) -> str | None:
+    """The next-slower rung of the dispatch ladder, or None at the
+    bottom (and for unknown paths)."""
+    try:
+        i = DISPATCH_LADDER.index(path)
+    except ValueError:
+        return None
+    return DISPATCH_LADDER[i + 1] if i + 1 < len(DISPATCH_LADDER) else None
+
 
 def kernel_path_for(graph: LatticeGraph, spec) -> str:
     """'lowered' | 'bitboard' | 'board' | 'general' — the body the

@@ -62,6 +62,29 @@ Core field semantics:
   maps name -> {count, sum, min, max, mean, p50, p95, p99}. Runners
   emit exactly one per run (right before ``run_end``, which embeds the
   same object under ``metrics=``).
+- ``retry``: the supervisor (resilience.supervisor) is retrying a
+  failed config: ``attempt`` is the try that just failed (1-based),
+  ``error_class`` the transient/resource/deterministic classification,
+  ``backoff_s`` the jittered wait about to be slept (wrapped in a
+  ``backoff`` span).
+- ``config_failed``: a config exhausted its retry budget; the sweep
+  continues without it.
+- ``config_quarantined``: a config hit ``quarantine_after``
+  deterministic failures and is isolated (poison config); the driver
+  exits nonzero when any config carries this event.
+- ``checkpoint_corrupt``: a checkpoint generation failed its SHA-256
+  manifest (truncated/bit-rotted part); the generation was moved to
+  the ``.corrupt/`` subdir and resume fell back to the previous one.
+- ``kernel_path_degraded``: a dispatch-ladder body failed
+  (compile/XLA runtime) and the runner fell to ``to_path`` for the
+  same segment; bench records reached through a degradation are
+  refused by ``tools/bench_compare.py`` gating.
+- ``sweep_summary``: one per supervised sweep, after the ``sweep``
+  span closes — completed/retried/quarantined/failed counts (plus the
+  quarantined/failed tag lists as extra fields).
+- ``heartbeat_error``: a heartbeat write failed (full disk, missing
+  dir); the run continued — heartbeats are liveness telemetry, never
+  load-bearing.
 
 Adding a new event *type* (as ``diag``/``anomaly`` were added) does NOT
 bump SCHEMA_VERSION: readers fold by type and validation rejects only
@@ -131,6 +154,37 @@ EVENT_REGISTRY = {
     "metrics_snapshot": {
         "fields": ("counters", "gauges", "histograms"),
         "doc": "obs.metrics.MetricsRegistry snapshot",
+    },
+    "retry": {
+        "fields": ("tag", "attempt", "error_class", "backoff_s"),
+        "doc": "supervisor retrying a failed config after backoff",
+    },
+    "config_failed": {
+        "fields": ("tag", "error_class", "message"),
+        "doc": "a config exhausted its retry budget; sweep continues",
+    },
+    "config_quarantined": {
+        "fields": ("tag", "failures"),
+        "doc": "poison config isolated after N deterministic failures",
+    },
+    "checkpoint_corrupt": {
+        "fields": ("tag", "path", "reason"),
+        "doc": "checkpoint generation failed integrity; quarantined "
+               "to .corrupt/ and resume fell back a generation",
+    },
+    "kernel_path_degraded": {
+        "fields": ("from_path", "to_path", "reason"),
+        "doc": "dispatch ladder fell to the next body after a kernel "
+               "error; bench_compare refuses to gate such records",
+    },
+    "sweep_summary": {
+        "fields": ("completed", "retried", "quarantined", "failed"),
+        "doc": "supervised sweep totals; quarantined/failed nonzero "
+               "means nonzero driver exit",
+    },
+    "heartbeat_error": {
+        "fields": ("message",),
+        "doc": "heartbeat write failed; run continues (non-fatal)",
     },
 }
 

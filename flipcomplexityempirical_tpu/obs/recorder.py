@@ -26,6 +26,13 @@ import time
 
 from .events import EVENT_FIELDS, SCHEMA_VERSION
 
+# Lazy fault-injection hook (resilience.faults.install_plan sets this to
+# the active plan's ``check``; None means no plan). obs must not import
+# the resilience package at module level — the dependency points the
+# other way — so the harness reaches in through this slot to make
+# ``recorder.emit`` an injectable site.
+_fault_check = None
+
 
 class NullRecorder:
     """Default recorder: every emit is a no-op and ``bool(rec)`` is
@@ -123,6 +130,8 @@ class Recorder:
         return True
 
     def emit(self, event, ts=None, **fields):
+        if _fault_check is not None:
+            _fault_check("recorder.emit", event=event)
         if event not in EVENT_FIELDS:
             raise ValueError(f"unknown event type {event!r} "
                              f"(schema v{SCHEMA_VERSION}: "

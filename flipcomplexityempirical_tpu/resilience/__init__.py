@@ -1,0 +1,45 @@
+"""Fault tolerance for long sweeps: injection, supervision, degradation.
+
+Four pieces (ISSUE 7):
+
+- ``faults``      — deterministic fault-injection harness (named sites,
+  env/CLI-configurable FaultPlan) so every recovery path below is
+  testable on CPU in tier-1;
+- ``supervisor``  — per-config isolation, classified retries with
+  seeded backoff, quarantine of poison configs, cooperative deadlines;
+- ``errors``      — the typed failure vocabulary the classifier keys on;
+- ``degrade``     — dispatch-ladder fallback bookkeeping
+  (kernel_path_degraded events + the process-wide audit trail bench
+  records consume).
+
+Checkpoint integrity (SHA-256 manifests, generations, ``.corrupt/``
+quarantine) lives in ``experiments.driver`` next to the checkpoint
+format itself; this package supplies the errors and fault sites it
+uses.
+"""
+
+from .errors import (CheckpointIdentityError, ConfigDeadlineExceeded,
+                     KernelPathError)
+from .faults import (ENV_VAR, SITES, FaultPlan, FaultRule, InjectedFault,
+                     active_plan, corrupt_file, fault_point,
+                     install_from_env, install_from_spec, install_plan,
+                     truncate_file)
+from .degrade import (DEGRADATIONS, is_kernel_error, next_board_body,
+                      record_degradation)
+from .supervisor import (DETERMINISTIC, RESOURCE, TRANSIENT, RetryPolicy,
+                         SweepReport, check_deadline, classify_error,
+                         clear_deadline, run_supervised_sweep,
+                         set_deadline)
+
+__all__ = [
+    "CheckpointIdentityError", "ConfigDeadlineExceeded",
+    "KernelPathError",
+    "ENV_VAR", "SITES", "FaultPlan", "FaultRule", "InjectedFault",
+    "active_plan", "corrupt_file", "fault_point", "install_from_env",
+    "install_from_spec", "install_plan", "truncate_file",
+    "DEGRADATIONS", "is_kernel_error", "next_board_body",
+    "record_degradation",
+    "DETERMINISTIC", "RESOURCE", "TRANSIENT", "RetryPolicy",
+    "SweepReport", "check_deadline", "classify_error", "clear_deadline",
+    "run_supervised_sweep", "set_deadline",
+]

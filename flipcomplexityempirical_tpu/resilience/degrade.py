@@ -1,0 +1,82 @@
+"""Graceful kernel degradation: fall down the dispatch ladder, loudly.
+
+The ladder (lower.dispatch) is lowered -> bitboard -> int8 board ->
+general. When a body fails to compile or trips an XLA runtime error
+mid-segment, the runners retry the same segment on the next body down
+instead of surfacing the error — emitting a ``kernel_path_degraded``
+event and appending to the process-wide ``DEGRADATIONS`` audit trail,
+which bench.py folds into its record (``degraded``/``degradations``)
+so ``tools/bench_compare.py`` can refuse to gate a record whose winning
+body was reached by falling off the intended path.
+
+Within the board family only bitboard -> int8 board is retryable
+*in-segment* (both bodies advance the same BoardState; the bit-packing
+happens inside ``run_board_chunk``). A lowered or int8-board failure
+raises ``KernelPathError`` instead, and the driver reruns the config on
+the general gather kernel from its last compatible checkpoint (board
+and general states are different pytrees, so there is no mid-segment
+hop between them).
+"""
+
+from __future__ import annotations
+
+from . import faults
+
+# Process-wide audit trail: one dict per degradation event, in order.
+# bench.py snapshots len() around a timed run to tag its record.
+DEGRADATIONS: list = []
+
+# Kernel errors we treat as "this body is broken here", by exception
+# class name (jax's exception classes move between versions; matching
+# the terminal name over the MRO is the stable check).
+_KERNEL_ERROR_NAMES = frozenset({
+    "XlaRuntimeError", "JaxRuntimeError", "JaxStackTraceBeforeTransformation",
+    "InternalError", "UnfilteredStackTrace", "CompilationError",
+})
+
+
+def is_kernel_error(exc: BaseException) -> bool:
+    """Does this exception mean the *body* failed (compile/XLA runtime),
+    as opposed to a bug in the calling code? Injected ``compile``-site
+    faults count — that is how chaos tests exercise this path on CPU."""
+    if isinstance(exc, faults.InjectedFault):
+        return exc.site == "compile"
+    return any(k.__name__ in _KERNEL_ERROR_NAMES
+               for k in type(exc).__mro__)
+
+
+def next_board_body(path: str):
+    """The next body down *within the board family*, or None when the
+    fall must leave the family (KernelPathError -> general rerun).
+    Only bitboard -> board shares a state layout; see module doc."""
+    from ..lower.dispatch import next_path  # import-light until needed
+
+    nxt = next_path(path)
+    return nxt if (path, nxt) == ("bitboard", "board") else None
+
+
+def describe_error(exc: BaseException) -> str:
+    return f"{type(exc).__name__}: {exc}"
+
+
+def record_degradation(rec, from_path: str, to_path: str, reason: str,
+                       **ctx):
+    """Append to the audit trail and (when a recorder is live) emit the
+    ``kernel_path_degraded`` event."""
+    entry = {"from_path": from_path, "to_path": to_path,
+             "reason": reason}
+    entry.update(ctx)
+    DEGRADATIONS.append(entry)
+    if rec:
+        rec.emit("kernel_path_degraded", from_path=from_path,
+                 to_path=to_path, reason=reason, **ctx)
+
+
+def snapshot() -> int:
+    """Marker for "how many degradations so far" — diff two snapshots
+    around a run to attribute degradations to it (bench.py)."""
+    return len(DEGRADATIONS)
+
+
+def since(marker: int) -> list:
+    return list(DEGRADATIONS[marker:])

@@ -1,0 +1,65 @@
+"""Typed failure vocabulary for the resilience layer.
+
+Every exception here exists so the supervisor (resilience.supervisor)
+can *classify* a failure instead of pattern-matching strings: checkpoint
+identity clashes are deterministic (retrying reproduces them), deadline
+overruns are resource pressure (a retry under less contention may pass),
+and kernel-path failures carry which dispatch-ladder body died so the
+driver can fall to the next one.
+"""
+
+from __future__ import annotations
+
+
+class CheckpointIdentityError(RuntimeError):
+    """A checkpoint exists for this tag but was written under a different
+    kernel path or Spec: the state fields on disk don't cover the fields
+    the current run's state template needs. Resuming would silently mix
+    two walks, so this refuses loudly and names both sides plus the
+    remedy (ISSUE 7 satellite: previously a bare KeyError)."""
+
+    def __init__(self, tag: str, expected_fields, found_fields,
+                 identity: str = ""):
+        self.tag = tag
+        self.expected_fields = tuple(sorted(expected_fields))
+        self.found_fields = tuple(sorted(found_fields))
+        self.identity = identity
+        missing = sorted(set(self.expected_fields)
+                         - set(self.found_fields))
+        super().__init__(
+            f"checkpoint for {tag!r} was written by a different kernel "
+            f"path or Spec: it carries state fields "
+            f"{list(self.found_fields)} but the current run's state "
+            f"template needs {list(self.expected_fields)} "
+            f"(missing: {missing}). Remedy: delete the checkpoint "
+            f"(fresh start) or rerun under the config that wrote it "
+            f"(identity {identity!r}).")
+
+
+class ConfigDeadlineExceeded(RuntimeError):
+    """The cooperative per-config wall-clock watchdog tripped: the
+    segment loop checked ``supervisor.check_deadline()`` between
+    segments and found the budget spent. Classified as a *resource*
+    failure — the retry resumes from the last checkpoint with a fresh
+    budget, so a config slightly over the line still finishes."""
+
+    def __init__(self, tag: str, budget_s: float):
+        self.tag = tag
+        self.budget_s = float(budget_s)
+        super().__init__(
+            f"config {tag!r} exceeded its {budget_s:.1f}s wall-clock "
+            "deadline (checked between segments; resume from the last "
+            "checkpoint continues the walk)")
+
+
+class KernelPathError(RuntimeError):
+    """A dispatch-ladder body failed (compile or runtime) and no
+    lower body exists *within the board family* — the driver catches
+    this and reruns the config on the general gather kernel."""
+
+    def __init__(self, path: str, cause: BaseException):
+        self.path = path
+        self.cause = cause
+        super().__init__(
+            f"kernel path {path!r} failed "
+            f"({type(cause).__name__}: {cause})")

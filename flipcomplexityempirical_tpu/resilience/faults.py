@@ -1,0 +1,280 @@
+"""Deterministic fault injection: every recovery path testable on CPU.
+
+A ``FaultPlan`` arms named *sites* — fixed strings the production code
+consults at its failure-prone boundaries:
+
+=================  ====================================================
+site               consulted by
+=================  ====================================================
+checkpoint.write   driver.save_checkpoint (raise before writing;
+                   truncate rules corrupt the freshly-renamed file)
+checkpoint.load    driver.load_checkpoint (raise before reading;
+                   truncate rules corrupt the on-disk main file)
+segment.step       the segment loops in driver._run_jax /
+                   _run_temper_segmented, before each segment
+compile            sampling.board_runner / distribute.sharded, before
+                   each chunk dispatch (stands in for an XLA
+                   compile/runtime error to exercise degradation)
+recorder.emit      obs.recorder.Recorder.emit (telemetry sink I/O)
+heartbeat.write    driver.write_heartbeat (must be non-fatal)
+=================  ====================================================
+
+Plan grammar (CLI ``--faults`` / env ``GRAFT_FAULTS``), comma-separated
+entries::
+
+    checkpoint.write:once,segment.step:once@4,compile:p=0.1,seed=7
+
+    entry := SITE ':' MODE | 'seed=' INT
+    MODE  := 'once'['@'HIT]        fail exactly one hit
+           | 'fail*'COUNT['@'HIT]  fail COUNT consecutive hits
+           | 'always'              poison: fail every hit (deterministic)
+           | 'p='PROB['@'HIT]      fail each hit w.p. PROB (seeded PRNG)
+           | 'truncate'['@'HIT]    I/O sites: truncate the file instead
+                                   of raising (a torn write)
+
+``@HIT`` is the 1-based hit ordinal at which the rule arms (default 1);
+earlier hits pass through. Hit counters are per site and process-wide,
+so a spec addresses "the 4th segment dispatched anywhere in the sweep"
+— which is what makes chaos tests byte-reproducible. Raising modes and
+truncate modes count hits independently (a site's ``fault_point`` calls
+vs its ``corrupt_file`` calls are different streams).
+
+Everything is plain-Python and host-side: with no plan installed,
+``fault_point`` is one global read — nothing is added to traced code.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+from typing import Optional
+
+ENV_VAR = "GRAFT_FAULTS"
+
+SITES = ("checkpoint.write", "checkpoint.load", "segment.step",
+         "compile", "recorder.emit", "heartbeat.write")
+
+_RAISING_MODES = ("fail", "always", "p")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed ``fault_point``. ``poison`` marks the
+    ``always`` mode — a deterministic failure the supervisor must
+    quarantine rather than burn retries on."""
+
+    def __init__(self, site: str, mode: str, hit: int):
+        self.site = site
+        self.mode = mode
+        self.hit = hit
+        super().__init__(
+            f"injected fault at site {site!r} (mode {mode}, hit {hit})")
+
+    @property
+    def poison(self) -> bool:
+        return self.mode == "always"
+
+
+class FaultRule:
+    """One armed behavior at one site. ``kind`` in fail/always/p/
+    truncate; see the module docstring for semantics."""
+
+    def __init__(self, site: str, kind: str, count: int = 1,
+                 prob: float = 0.0, at: int = 1):
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} "
+                             f"(sites: {list(SITES)})")
+        if kind not in _RAISING_MODES + ("truncate",):
+            raise ValueError(f"unknown fault mode {kind!r}")
+        if at < 1:
+            raise ValueError(f"@HIT ordinal must be >= 1, got {at}")
+        self.site = site
+        self.kind = kind
+        self.count = int(count)
+        self.prob = float(prob)
+        self.at = int(at)
+        self.fired = 0
+
+    def should_fire(self, hit: int, rng: random.Random) -> bool:
+        if hit < self.at:
+            return False
+        if self.kind == "always":
+            return True
+        if self.kind == "p":
+            return rng.random() < self.prob
+        if self.fired >= self.count:       # fail / truncate: budgeted
+            return False
+        self.fired += 1
+        return True
+
+    def describe(self) -> str:
+        mode = {"fail": (f"once" if self.count == 1
+                         else f"fail*{self.count}"),
+                "always": "always",
+                "p": f"p={self.prob:g}",
+                "truncate": "truncate"}[self.kind]
+        return (f"{self.site}:{mode}"
+                + (f"@{self.at}" if self.at != 1 else ""))
+
+
+def _parse_mode(tok: str):
+    """(kind, count, prob, at) from one MODE token."""
+    at = 1
+    if "@" in tok:
+        tok, at_s = tok.split("@", 1)
+        at = int(at_s)
+    if tok == "once":
+        return "fail", 1, 0.0, at
+    if tok == "always":
+        return "always", 0, 0.0, at
+    if tok == "truncate":
+        return "truncate", 1, 0.0, at
+    m = re.fullmatch(r"fail\*(\d+)", tok)
+    if m:
+        return "fail", int(m.group(1)), 0.0, at
+    m = re.fullmatch(r"p=([0-9.eE+-]+)", tok)
+    if m:
+        return "p", 0, float(m.group(1)), at
+    raise ValueError(f"unknown fault mode {tok!r} (grammar: once[@H], "
+                     "fail*N[@H], always, p=X[@H], truncate[@H])")
+
+
+class FaultPlan:
+    """A parsed, seeded set of FaultRules plus the per-site hit
+    counters. One plan is installed process-wide (``install_plan``);
+    the production sites consult it through ``fault_point`` /
+    ``corrupt_file`` below."""
+
+    def __init__(self, rules=(), seed: int = 0):
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._hits: dict = {}      # site -> fault_point hit count
+        self._io_hits: dict = {}   # site -> corrupt_file hit count
+        self._lock = threading.Lock()
+        self.log: list = []        # (site, mode, hit) of every firing
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        rules = []
+        seed = 0
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            m = re.fullmatch(r"seed=(\d+)", entry)
+            if m:
+                seed = int(m.group(1))
+                continue
+            if ":" not in entry:
+                raise ValueError(f"fault entry {entry!r} is not "
+                                 "SITE:MODE or seed=N")
+            site, mode = entry.split(":", 1)
+            kind, count, prob, at = _parse_mode(mode.strip())
+            rules.append(FaultRule(site.strip(), kind, count=count,
+                                   prob=prob, at=at))
+        return cls(rules, seed=seed)
+
+    def describe(self) -> str:
+        parts = [r.describe() for r in self.rules]
+        if self.seed:
+            parts.append(f"seed={self.seed}")
+        return ",".join(parts)
+
+    def check(self, site: str, **ctx):
+        """Raise InjectedFault when a raising rule at ``site`` fires."""
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+            for rule in self.rules:
+                if rule.site != site or rule.kind == "truncate":
+                    continue
+                if rule.should_fire(hit, self._rng):
+                    mode = ("always" if rule.kind == "always"
+                            else rule.kind)
+                    self.log.append((site, mode, hit))
+                    raise InjectedFault(site, mode, hit)
+
+    def wants_corruption(self, site: str) -> bool:
+        """One truncate-rule consultation for ``site`` (independent hit
+        stream from ``check``)."""
+        with self._lock:
+            hit = self._io_hits.get(site, 0) + 1
+            self._io_hits[site] = hit
+            for rule in self.rules:
+                if rule.site != site or rule.kind != "truncate":
+                    continue
+                if rule.should_fire(hit, self._rng):
+                    self.log.append((site, "truncate", hit))
+                    return True
+        return False
+
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install ``plan`` process-wide (None clears). Also syncs the
+    recorder's lazy hook so ``Recorder.emit`` consults the plan without
+    obs importing this package at module level. Returns the previous
+    plan."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = plan
+    from ..obs import recorder as _recorder_mod
+
+    _recorder_mod._fault_check = (None if plan is None
+                                  else plan.check)
+    return prev
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def install_from_spec(spec: Optional[str]) -> Optional[FaultPlan]:
+    """``--faults`` / env plumbing: parse and install, or clear on a
+    falsy spec. Returns the installed plan (or None)."""
+    if not spec:
+        install_plan(None)
+        return None
+    plan = FaultPlan.from_spec(spec)
+    install_plan(plan)
+    return plan
+
+
+def install_from_env(environ=os.environ) -> Optional[FaultPlan]:
+    return install_from_spec(environ.get(ENV_VAR))
+
+
+def fault_point(site: str, **ctx):
+    """The production-code hook: no-op unless a plan is installed and a
+    raising rule at ``site`` fires (then: InjectedFault)."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.check(site, **ctx)
+
+
+def truncate_file(path: str, keep_numerator: int = 1,
+                  keep_denominator: int = 2):
+    """Cut a file to its leading fraction in place — a torn write. The
+    default half is enough to invalidate any npz/json payload while
+    keeping the file present (the harder failure mode: exists but
+    unreadable)."""
+    size = os.path.getsize(path)
+    keep = (size * keep_numerator) // keep_denominator
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def corrupt_file(site: str, path: str) -> bool:
+    """I/O-site hook: when a truncate rule at ``site`` fires, tear the
+    file at ``path``. Returns whether corruption happened."""
+    plan = _ACTIVE
+    if plan is None or not os.path.exists(path):
+        return False
+    if not plan.wants_corruption(site):
+        return False
+    truncate_file(path)
+    return True

@@ -1,0 +1,313 @@
+"""Sweep supervisor: per-config isolation, classified retries, quarantine.
+
+``run_supervised_sweep`` is the fault-tolerant counterpart of
+``experiments.driver.run_sweep``: one config's failure no longer kills
+the sweep. Each failure is classified (``classify_error``) as
+
+- ``transient``  — I/O hiccups, injected non-poison faults, anything
+  unrecognized: retry with exponential backoff + seeded jitter,
+  resuming from the config's last checkpoint;
+- ``resource``   — OOM / RESOURCE_EXHAUSTED / deadline overruns: also
+  retried (the resume shrinks the remaining work, and pressure may
+  pass);
+- ``deterministic`` — identity/shape/value errors, poison faults, or
+  failures under frozen-chain / acceptance-collapse anomalies (the PR 3
+  taxonomy: the walk itself is sick, not the machinery): these count
+  toward quarantine — after ``quarantine_after`` of them the config is
+  isolated (``config_quarantined`` event) so a poison config cannot
+  starve the rest of the sweep.
+
+Everything here is host-side between segments: backoff sleeps, deadline
+checks and event emission never touch the device, so the
+no-added-syncs guard-rail (PROFILE.md) is untouched.
+
+The wall-clock watchdog is cooperative: ``set_deadline`` arms a
+monotonic budget and the driver's segment loops call
+``check_deadline()`` between segments — a JAX dispatch cannot be
+interrupted mid-flight, but a segment is bounded (checkpoint_every
+steps), which bounds the overshoot.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import obs
+from .errors import CheckpointIdentityError, ConfigDeadlineExceeded
+from .faults import InjectedFault
+
+TRANSIENT = "transient"
+RESOURCE = "resource"
+DETERMINISTIC = "deterministic"
+
+# message markers for resource pressure (jax surfaces OOM as
+# XlaRuntimeError text, not a dedicated class)
+_RESOURCE_MARKERS = ("resource_exhausted", "resource exhausted",
+                     "out of memory", "oom", "memory_limit",
+                     "allocation failure")
+
+# monitor anomaly kinds that mark the *walk* as deterministically sick
+# (PR 3 taxonomy): a config failing while frozen or collapsed will fail
+# the same way on every retry.
+_POISON_ANOMALIES = ("frozen_chain", "acceptance_collapse")
+
+
+def classify_error(exc: BaseException, anomalies=()) -> str:
+    """transient / resource / deterministic for one failure, given the
+    exception and the per-kind anomaly tally observed during the
+    attempt (the heartbeat hook state of driver.install_live_hooks)."""
+    if isinstance(exc, InjectedFault):
+        return DETERMINISTIC if exc.poison else TRANSIENT
+    if isinstance(exc, (ConfigDeadlineExceeded, MemoryError)):
+        return RESOURCE
+    msg = str(exc).lower()
+    if any(m in msg for m in _RESOURCE_MARKERS):
+        return RESOURCE
+    if isinstance(exc, (CheckpointIdentityError, ValueError, TypeError,
+                        KeyError, IndexError, AssertionError,
+                        ZeroDivisionError)):
+        return DETERMINISTIC
+    if any(k in _POISON_ANOMALIES for k in anomalies):
+        return DETERMINISTIC
+    if isinstance(exc, (OSError, TimeoutError, ConnectionError)):
+        return TRANSIENT
+    return TRANSIENT
+
+
+# ---------------------------------------------------------------------
+# cooperative per-config deadline
+
+_deadline = None  # (monotonic end, budget_s, tag) or None
+
+
+def set_deadline(budget_s: Optional[float], tag: str = ""):
+    global _deadline
+    _deadline = ((time.monotonic() + budget_s, float(budget_s), tag)
+                 if budget_s else None)
+
+
+def clear_deadline():
+    set_deadline(None)
+
+
+def check_deadline():
+    """Called by the driver's segment loops between segments."""
+    if _deadline is None:
+        return
+    end, budget_s, tag = _deadline
+    if time.monotonic() > end:
+        raise ConfigDeadlineExceeded(tag, budget_s)
+
+
+# ---------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Retry/backoff/quarantine knobs. ``max_retries`` is retries, not
+    attempts (a config gets 1 + max_retries tries). ``seed`` drives the
+    jitter PRNG — supervised sweeps are as reproducible as the faults
+    they absorb."""
+
+    max_retries: int = 3
+    quarantine_after: int = 2       # deterministic failures -> quarantine
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 5.0
+    jitter: float = 0.25            # uniform extra fraction of the backoff
+    deadline_s: Optional[float] = None  # per-config wall budget
+    seed: int = 0
+
+    def backoff(self, attempt: int, rng: random.Random) -> float:
+        base = min(self.backoff_base_s
+                   * self.backoff_factor ** (attempt - 1),
+                   self.backoff_max_s)
+        return base * (1.0 + self.jitter * rng.random())
+
+
+@dataclass
+class SweepReport:
+    """What ``run_supervised_sweep`` returns. ``results`` matches
+    run_sweep's (cfg, data) list for the configs that completed this
+    call; the tag lists drive the CLI exit code and sweep_summary."""
+
+    results: list = field(default_factory=list)
+    completed: list = field(default_factory=list)
+    skipped: list = field(default_factory=list)
+    quarantined: list = field(default_factory=list)
+    failed: list = field(default_factory=list)
+    retried: int = 0
+    attempts: dict = field(default_factory=dict)   # tag -> tries used
+
+    @property
+    def exit_code(self) -> int:
+        return 2 if (self.quarantined or self.failed) else 0
+
+
+def run_supervised_sweep(configs, outdir: str,
+                         checkpoint_dir: Optional[str] = None,
+                         verbose: bool = True, recorder=None,
+                         heartbeat: Optional[str] = None,
+                         policy: Optional[RetryPolicy] = None
+                         ) -> SweepReport:
+    """The fault-tolerant sweep. Same per-config telemetry contract as
+    driver.run_sweep (sweep/config spans, sweep_config events, live
+    heartbeat hooks) plus: ``retry`` events with ``backoff`` spans
+    around the waits, ``config_failed`` / ``config_quarantined`` when a
+    config is given up on, and one ``sweep_summary`` at the end.
+    Retries resume from the config's last checkpoint automatically
+    (run_config's segment resume)."""
+    from ..experiments import driver as drv
+
+    policy = policy or RetryPolicy()
+    rng = random.Random(policy.seed)
+    rec = obs.resolve_recorder(recorder)
+    configs = list(configs)
+    report = SweepReport()
+    n_configs = len(configs)
+
+    def _progress():
+        return dict(n_done=len(report.completed),
+                    n_skipped=len(report.skipped), n_configs=n_configs)
+
+    sweep_span = obs.span(rec, "sweep", n_configs=n_configs,
+                          supervised=True)
+    sweep_span.begin()
+    try:
+        for i, cfg in enumerate(configs):
+            if drv.is_done(cfg, outdir):
+                report.skipped.append(cfg.tag)
+                if verbose:
+                    print(f"[skip] {cfg.family} {cfg.tag} "
+                          f"(artifacts complete)")
+                rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
+                         status="skip",
+                         artifacts=len(drv.artifact_kinds(cfg.family)),
+                         index=i, n_configs=n_configs)
+                drv.write_heartbeat(heartbeat, recorder=rec,
+                                    status="running", current=None,
+                                    last=cfg.tag, **_progress())
+                continue
+            attempts = 0
+            det_failures = 0
+            while True:
+                attempts += 1
+                report.attempts[cfg.tag] = attempts
+                t0 = time.monotonic()
+                rec.emit("sweep_config", tag=cfg.tag, family=cfg.family,
+                         status="start",
+                         artifacts=drv.count_artifacts(cfg, outdir),
+                         index=i, n_configs=n_configs,
+                         attempt=attempts)
+                drv.write_heartbeat(heartbeat, recorder=rec,
+                                    status="running", current=cfg.tag,
+                                    last=None, attempt=attempts,
+                                    **_progress())
+                cfg_span = obs.span(rec, "config", tag=cfg.tag,
+                                    family=cfg.family,
+                                    attempt=attempts).begin()
+                hb_state, uninstall = drv.install_live_hooks(
+                    rec, heartbeat, cfg, _progress())
+                set_deadline(policy.deadline_s, cfg.tag)
+                try:
+                    data = drv.run_config(cfg, outdir, checkpoint_dir,
+                                          recorder=rec)
+                except Exception as e:
+                    clear_deadline()
+                    uninstall()
+                    klass = classify_error(
+                        e, anomalies=hb_state["anomalies"])
+                    msg = f"{type(e).__name__}: {e}"
+                    rec.emit("error", message=msg, tag=cfg.tag,
+                             family=cfg.family, error_class=klass,
+                             attempt=attempts)
+                    cfg_span.end(error=type(e).__name__,
+                                 error_class=klass)
+                    if klass == DETERMINISTIC:
+                        det_failures += 1
+                    if det_failures >= policy.quarantine_after:
+                        report.quarantined.append(cfg.tag)
+                        rec.emit("config_quarantined", tag=cfg.tag,
+                                 failures=det_failures)
+                        if verbose:
+                            print(f"[quarantine] {cfg.family} {cfg.tag} "
+                                  f"after {det_failures} deterministic "
+                                  f"failures ({msg})")
+                        drv.write_heartbeat(
+                            heartbeat, recorder=rec,
+                            status="quarantined", current=cfg.tag,
+                            last=None, error=msg, **_progress())
+                        break
+                    if attempts > policy.max_retries:
+                        report.failed.append(cfg.tag)
+                        rec.emit("config_failed", tag=cfg.tag,
+                                 error_class=klass, message=msg,
+                                 attempts=attempts)
+                        if verbose:
+                            print(f"[failed] {cfg.family} {cfg.tag} "
+                                  f"after {attempts} attempts ({msg})")
+                        drv.write_heartbeat(
+                            heartbeat, recorder=rec, status="failed",
+                            current=cfg.tag, last=None, error=msg,
+                            **_progress())
+                        break
+                    report.retried += 1
+                    wait = policy.backoff(attempts, rng)
+                    rec.emit("retry", tag=cfg.tag, attempt=attempts,
+                             error_class=klass, backoff_s=wait,
+                             message=msg)
+                    if verbose:
+                        print(f"[retry] {cfg.family} {cfg.tag} "
+                              f"attempt {attempts} failed "
+                              f"({klass}: {msg}); backing off "
+                              f"{wait:.2f}s")
+                    with obs.span(rec, "backoff", tag=cfg.tag,
+                                  attempt=attempts, backoff_s=wait,
+                                  error_class=klass):
+                        time.sleep(wait)
+                    continue
+                else:
+                    clear_deadline()
+                    uninstall()
+                    report.completed.append(cfg.tag)
+                    report.results.append((cfg, data))
+                    seconds = time.monotonic() - t0
+                    cfg_span.end(seconds=seconds, attempts=attempts)
+                    rec.emit("sweep_config", tag=cfg.tag,
+                             family=cfg.family, status="done",
+                             artifacts=drv.count_artifacts(cfg, outdir),
+                             seconds=seconds, index=i,
+                             n_configs=n_configs, attempt=attempts)
+                    drv.write_heartbeat(heartbeat, recorder=rec,
+                                        status="running", current=None,
+                                        last=cfg.tag, **_progress())
+                    if verbose:
+                        print(f"[done] {cfg.family} {cfg.tag} "
+                              f"waits={data['waits_sum']:.4g} "
+                              f"({seconds:.1f}s"
+                              + (f", attempt {attempts}"
+                                 if attempts > 1 else "") + ")")
+                    break
+    finally:
+        clear_deadline()
+        sweep_span.end(n_done=len(report.completed),
+                       n_skipped=len(report.skipped),
+                       n_quarantined=len(report.quarantined),
+                       n_failed=len(report.failed))
+    rec.emit("sweep_summary", completed=len(report.completed),
+             retried=report.retried,
+             quarantined=len(report.quarantined),
+             failed=len(report.failed),
+             skipped=len(report.skipped),
+             quarantined_tags=list(report.quarantined),
+             failed_tags=list(report.failed))
+    drv.write_heartbeat(
+        heartbeat, recorder=rec,
+        status=("complete" if not (report.quarantined or report.failed)
+                else "complete_with_failures"),
+        current=None, last=None, quarantined=list(report.quarantined),
+        failed=list(report.failed), **_progress())
+    return report

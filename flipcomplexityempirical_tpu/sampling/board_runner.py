@@ -20,6 +20,9 @@ from ..graphs.lattice import LatticeGraph
 from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
+from ..resilience import degrade as rdegrade
+from ..resilience import faults as rfaults
+from ..resilience.errors import KernelPathError
 from .runner import (RunResult, assemble_history, default_label_values,
                      maybe_host, pick_chunk, pop_bounds, snap_chunk_to,
                      thin_outs)
@@ -211,9 +214,31 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
     done = 0
     while done < n_transitions:
         this = min(chunk, n_transitions - done)
-        state, outs = kboard.run_board_chunk(bg, spec, params, state, this,
-                                             collect=record_history,
-                                             bits=bits)
+        try:
+            rfaults.fault_point("compile", path=path, done=done)
+            state, outs = kboard.run_board_chunk(bg, spec, params, state,
+                                                 this,
+                                                 collect=record_history,
+                                                 bits=bits)
+        except Exception as e:
+            if not rdegrade.is_kernel_error(e):
+                raise
+            nxt = rdegrade.next_board_body(path)
+            if nxt is None:
+                # no lower body shares this state layout — hand the
+                # ladder back to the driver (general-kernel rerun)
+                raise KernelPathError(path, e) from e
+            # bitboard -> int8 board: same BoardState, the bit-packing
+            # lives inside run_board_chunk, so the SAME segment retries
+            # on the next body down with nothing converted
+            rdegrade.record_degradation(
+                rec, path, nxt, reason=rdegrade.describe_error(e),
+                done=done)
+            path, bits = nxt, False
+            state, outs = kboard.run_board_chunk(bg, spec, params, state,
+                                                 this,
+                                                 collect=record_history,
+                                                 bits=bits)
         if rec:
             watch.poll(rec, chunk=this,
                        cost=lambda: obs.aot_cost(

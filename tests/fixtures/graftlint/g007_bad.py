@@ -1,0 +1,32 @@
+"""G007 positive fixture: every retry/timeout hygiene hazard."""
+
+import random
+import time
+
+
+def swallow_everything(op):
+    try:
+        op()
+    except Exception:  # swallowed: neither retries nor quarantines
+        pass
+
+
+def swallow_bare(op):
+    try:
+        op()
+    except:  # noqa: E722
+        pass
+
+
+def wall_clock_deadline(budget_s):
+    start = time.time()
+    while time.time() - start < budget_s:  # NTP slew breaks this
+        pass
+
+
+def wall_clock_duration(t0):
+    return time.time() - t0
+
+
+def unseeded_jitter(base):
+    return base * (1.0 + random.uniform(0.0, 0.25))

@@ -1,0 +1,31 @@
+"""G007 negative fixture: the hygienic forms of every hazard."""
+
+import random
+import time
+
+
+def typed_and_recorded(op, log):
+    try:
+        op()
+    except OSError as e:  # typed, recorded
+        log.append(e)
+    try:
+        op()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+
+
+def monotonic_deadline(budget_s):
+    start = time.monotonic()
+    while time.monotonic() - start < budget_s:
+        break
+
+
+def timestamp_is_fine():
+    # time.time() as a TIMESTAMP (never subtracted) stays legal
+    return {"ts": time.time()}
+
+
+def seeded_jitter(base, seed):
+    rng = random.Random(seed)
+    return base * (1.0 + rng.uniform(0.0, 0.25))

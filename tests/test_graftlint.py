@@ -111,6 +111,13 @@ def test_g005_covers_span_and_metrics_calls(tmp_path):
     assert lint_file(str(p2), cfg) == []
 
 
+def test_g007_catches_each_hazard_kind():
+    msgs = "\n".join(f.message for f in _lint_fixture("g007_bad.py", "G007"))
+    assert "swallowed broad exception" in msgs
+    assert "time.time()" in msgs
+    assert "random.uniform" in msgs
+
+
 def test_g006_threshold_is_configurable():
     cfg = LintConfig(root=REPO, rules=frozenset({"G006"}),
                      max_test_steps=100000)

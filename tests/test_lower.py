@@ -257,10 +257,15 @@ def test_lowered_run_invariants():
 
 def test_checkpoint_field_mismatch_restarts():
     """A checkpoint written by a different kernel path (missing state
-    fields the current path carries) must KeyError out of
-    _state_from_arrays — the _load_resume restart-from-scratch guard."""
+    fields the current path carries) must raise CheckpointIdentityError
+    out of _state_from_arrays — the _load_resume guard that refuses to
+    silently mix two walks (the supervisor classifies it deterministic,
+    and only the kernel-degradation rerun downgrades it to a fresh
+    start)."""
     from flipcomplexityempirical_tpu.experiments.driver import \
         _state_from_arrays
+    from flipcomplexityempirical_tpu.resilience.errors import \
+        CheckpointIdentityError
 
     g = surgical_grid()
     spec = fce.Spec(contiguity="patch")
@@ -281,10 +286,11 @@ def test_checkpoint_field_mismatch_restarts():
             np.testing.assert_array_equal(np.asarray(getattr(back, f)),
                                           np.asarray(v))
 
-    # drop a field the lowered path requires => loud KeyError
+    # drop a field the lowered path requires => loud identity refusal
     partial = {k: v for k, v in full.items() if k != "state_cut_times_se"}
-    with pytest.raises(KeyError):
+    with pytest.raises(CheckpointIdentityError) as ei:
         _state_from_arrays(st, partial)
+    assert "cut_times_se" in str(ei.value)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,604 @@
+"""Fault-tolerant sweep execution (ISSUE 7): the fault-injection
+harness, error classification, the sweep supervisor's retry/quarantine
+machinery, checkpoint integrity (manifest, generations, ``.corrupt/``
+fallback), graceful kernel degradation, and the non-fatal heartbeat.
+
+The chaos tests drive REAL sweeps on CPU with injected faults and
+assert the recovered run is byte-identical to a fault-free one — the
+acceptance bar for every recovery path being exercised in tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import flipcomplexityempirical_tpu.experiments as ex
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu import resilience as rz
+from flipcomplexityempirical_tpu.experiments import driver as drv
+from flipcomplexityempirical_tpu.resilience import faults as rfaults
+from flipcomplexityempirical_tpu.resilience import supervisor as rsup
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends with no fault plan installed."""
+    rfaults.install_plan(None)
+    yield
+    rfaults.install_plan(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---- fault plan --------------------------------------------------------
+
+def test_fault_plan_parse_and_describe():
+    spec = ("checkpoint.write:once,segment.step:fail*2@4,"
+            "compile:p=0.5,checkpoint.load:truncate@2,"
+            "recorder.emit:always,seed=7")
+    plan = rfaults.FaultPlan.from_spec(spec)
+    assert plan.seed == 7
+    assert [r.describe() for r in plan.rules] == [
+        "checkpoint.write:once", "segment.step:fail*2@4",
+        "compile:p=0.5", "checkpoint.load:truncate@2",
+        "recorder.emit:always"]
+    # describe() round-trips through from_spec
+    again = rfaults.FaultPlan.from_spec(plan.describe())
+    assert again.describe() == plan.describe()
+
+
+@pytest.mark.parametrize("bad", ["nosuchsite:once", "compile:meh",
+                                 "compile", "segment.step:once@0"])
+def test_fault_plan_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        rfaults.FaultPlan.from_spec(bad)
+
+
+def test_fault_plan_hit_ordinal_and_budget():
+    plan = rfaults.FaultPlan.from_spec("segment.step:fail*2@3")
+    fired = []
+    for hit in range(1, 7):
+        try:
+            plan.check("segment.step")
+        except rfaults.InjectedFault as e:
+            fired.append((hit, e.hit))
+    # arms at hit 3, budget 2 -> fires exactly on hits 3 and 4
+    assert fired == [(3, 3), (4, 4)]
+    assert plan.log == [("segment.step", "fail", 3),
+                        ("segment.step", "fail", 4)]
+
+
+def test_fault_plan_sites_count_independently():
+    plan = rfaults.FaultPlan.from_spec(
+        "checkpoint.write:once@2,segment.step:once")
+    with pytest.raises(rfaults.InjectedFault):
+        plan.check("segment.step")         # its own hit 1
+    plan.check("checkpoint.write")         # hit 1 < @2: passes
+    with pytest.raises(rfaults.InjectedFault):
+        plan.check("checkpoint.write")     # hit 2
+
+
+def test_fault_plan_p_mode_is_seeded():
+    def firing_pattern(seed):
+        plan = rfaults.FaultPlan.from_spec(f"compile:p=0.5,seed={seed}")
+        out = []
+        for _ in range(20):
+            try:
+                plan.check("compile")
+                out.append(0)
+            except rfaults.InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = firing_pattern(3), firing_pattern(3)
+    assert a == b                       # reproducible
+    assert 0 < sum(a) < 20              # actually probabilistic
+    assert firing_pattern(4) != a       # and seed-dependent
+
+
+def test_poison_mode_marks_injected_fault():
+    plan = rfaults.FaultPlan.from_spec("segment.step:always")
+    with pytest.raises(rfaults.InjectedFault) as ei:
+        plan.check("segment.step")
+    assert ei.value.poison
+    plan2 = rfaults.FaultPlan.from_spec("segment.step:once")
+    with pytest.raises(rfaults.InjectedFault) as ei:
+        plan2.check("segment.step")
+    assert not ei.value.poison
+
+
+def test_truncate_and_corrupt_file(tmp_path):
+    p = tmp_path / "blob.npz"
+    p.write_bytes(b"x" * 1000)
+    rfaults.truncate_file(str(p))
+    assert p.stat().st_size == 500
+    # corrupt_file: independent hit stream, truncate rules only
+    plan = rfaults.FaultPlan.from_spec("checkpoint.write:truncate@2")
+    rfaults.install_plan(plan)
+    q = tmp_path / "part.npz"
+    q.write_bytes(b"y" * 100)
+    assert not rfaults.corrupt_file("checkpoint.write", str(q))  # hit 1
+    assert rfaults.corrupt_file("checkpoint.write", str(q))      # hit 2
+    assert q.stat().st_size == 50
+    # missing files never count a hit
+    assert not rfaults.corrupt_file("checkpoint.write",
+                                    str(tmp_path / "nope.npz"))
+
+
+def test_install_from_env_and_fault_point():
+    assert rfaults.install_from_env({}) is None
+    assert rfaults.active_plan() is None
+    rfaults.fault_point("segment.step")   # no plan: no-op
+    plan = rfaults.install_from_env(
+        {rfaults.ENV_VAR: "segment.step:once,seed=5"})
+    assert plan is rfaults.active_plan()
+    with pytest.raises(rfaults.InjectedFault):
+        rfaults.fault_point("segment.step")
+    rfaults.fault_point("segment.step")   # budget spent
+
+
+def test_recorder_emit_fault_site(tmp_path):
+    rfaults.install_from_spec("recorder.emit:once@2")
+    rec = obs.from_spec(str(tmp_path / "ev.jsonl"))
+    rec.emit("sweep_summary", completed=0, retried=0, quarantined=0,
+             failed=0)
+    with pytest.raises(rfaults.InjectedFault):
+        rec.emit("sweep_summary", completed=0, retried=0, quarantined=0,
+                 failed=0)
+    rec.close()
+
+
+# ---- classification / policy / deadline --------------------------------
+
+def test_classify_error_taxonomy():
+    c = rsup.classify_error
+    assert c(OSError("disk hiccup")) == rsup.TRANSIENT
+    assert c(TimeoutError()) == rsup.TRANSIENT
+    assert c(RuntimeError("mystery")) == rsup.TRANSIENT
+    assert c(MemoryError()) == rsup.RESOURCE
+    assert c(RuntimeError("RESOURCE_EXHAUSTED: hbm")) == rsup.RESOURCE
+    assert c(rz.ConfigDeadlineExceeded("t", 1.0)) == rsup.RESOURCE
+    assert c(ValueError("bad shape")) == rsup.DETERMINISTIC
+    assert c(rz.CheckpointIdentityError("t", ["a"], [])) \
+        == rsup.DETERMINISTIC
+    inj = rfaults.InjectedFault("segment.step", "fail", 1)
+    assert c(inj) == rsup.TRANSIENT
+    poison = rfaults.InjectedFault("segment.step", "always", 1)
+    assert c(poison) == rsup.DETERMINISTIC
+    # PR 3 anomaly taxonomy: a sick walk makes the failure deterministic
+    assert c(RuntimeError("x"), anomalies={"frozen_chain": 2}) \
+        == rsup.DETERMINISTIC
+    assert c(RuntimeError("x"), anomalies={"throughput_regression": 1}) \
+        == rsup.TRANSIENT
+
+
+def test_backoff_grows_caps_and_jitters():
+    import random
+    pol = rsup.RetryPolicy(backoff_base_s=0.1, backoff_factor=2.0,
+                           backoff_max_s=0.5, jitter=0.25)
+    rng = random.Random(0)
+    waits = [pol.backoff(a, rng) for a in range(1, 6)]
+    for w, base in zip(waits, (0.1, 0.2, 0.4, 0.5, 0.5)):
+        assert base <= w <= base * 1.25
+    # seeded: the schedule replays
+    rng2 = random.Random(0)
+    assert waits == [pol.backoff(a, rng2) for a in range(1, 6)]
+
+
+def test_cooperative_deadline():
+    rsup.clear_deadline()
+    rsup.check_deadline()                  # unarmed: no-op
+    rsup.set_deadline(1e-9, tag="T")
+    try:
+        with pytest.raises(rz.ConfigDeadlineExceeded) as ei:
+            import time
+            time.sleep(0.01)
+            rsup.check_deadline()
+        assert "T" in str(ei.value)
+    finally:
+        rsup.clear_deadline()
+    rsup.check_deadline()
+
+
+def test_checkpoint_identity_error_names_both_sides():
+    e = rz.CheckpointIdentityError(
+        "2B30P10", expected_fields=["state_key", "state_assignment"],
+        found_fields=["state_assignment"], identity="frank/seed0")
+    msg = str(e)
+    assert "2B30P10" in msg
+    assert "state_key" in msg and "state_assignment" in msg
+    assert "delete the checkpoint" in msg
+
+
+def test_dispatch_ladder_and_board_fallback():
+    from flipcomplexityempirical_tpu.lower import dispatch
+    assert dispatch.DISPATCH_LADDER == ("lowered", "bitboard", "board",
+                                        "general")
+    assert dispatch.next_path("lowered") == "bitboard"
+    assert dispatch.next_path("general") is None
+    assert dispatch.next_path("pallas") is None
+    # only the state-compatible bitboard -> board hop stays in-segment
+    assert rz.next_board_body("bitboard") == "board"
+    assert rz.next_board_body("lowered") is None
+    assert rz.next_board_body("board") is None
+
+
+# ---- supervisor over a stubbed driver ----------------------------------
+
+class _Flaky:
+    """A run_config stand-in failing ``fails`` times before succeeding."""
+
+    def __init__(self, fails, exc=OSError("flaky")):
+        self.fails = fails
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self, cfg, outdir, checkpoint_dir=None, recorder=None):
+        self.calls += 1
+        if self.calls <= self.fails:
+            raise self.exc
+        return {"waits_sum": 1.0}
+
+
+_FAST = dict(backoff_base_s=0.001, backoff_max_s=0.002)
+
+
+def _cfg():
+    return ex.ExperimentConfig(family="frank", alignment=0, base=0.3,
+                               pop_tol=0.5, total_steps=10, n_chains=1)
+
+
+def test_supervisor_retries_transient_then_succeeds(tmp_path,
+                                                    monkeypatch):
+    flaky = _Flaky(fails=2)
+    monkeypatch.setattr(drv, "run_config", flaky)
+    rep = rsup.run_supervised_sweep(
+        [_cfg()], str(tmp_path), verbose=False,
+        policy=rsup.RetryPolicy(max_retries=3, **_FAST))
+    assert flaky.calls == 3
+    assert rep.completed == [_cfg().tag] and rep.retried == 2
+    assert rep.attempts[_cfg().tag] == 3 and rep.exit_code == 0
+
+
+def test_supervisor_exhausts_retries_and_fails(tmp_path, monkeypatch):
+    flaky = _Flaky(fails=99)
+    monkeypatch.setattr(drv, "run_config", flaky)
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    rep = rsup.run_supervised_sweep(
+        [_cfg()], str(tmp_path), verbose=False, recorder=rec,
+        policy=rsup.RetryPolicy(max_retries=2, **_FAST))
+    rec.close()
+    assert flaky.calls == 3 and rep.failed == [_cfg().tag]
+    assert rep.exit_code == 2
+    kinds = [e["event"] for e in _events(ev)]
+    assert kinds.count("retry") == 2
+    assert "config_failed" in kinds and "sweep_summary" in kinds
+
+
+def test_supervisor_quarantines_deterministic_failures(tmp_path,
+                                                       monkeypatch):
+    flaky = _Flaky(fails=99, exc=ValueError("bad shape"))
+    monkeypatch.setattr(drv, "run_config", flaky)
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    rep = rsup.run_supervised_sweep(
+        [_cfg()], str(tmp_path), verbose=False, recorder=rec,
+        policy=rsup.RetryPolicy(max_retries=10, quarantine_after=2,
+                                **_FAST))
+    rec.close()
+    # 2 deterministic failures -> quarantined, NOT 11 attempts
+    assert flaky.calls == 2 and rep.quarantined == [_cfg().tag]
+    assert rep.exit_code == 2
+    events = _events(ev)
+    q = [e for e in events if e["event"] == "config_quarantined"]
+    assert q and q[0]["tag"] == _cfg().tag and q[0]["failures"] == 2
+    summary = [e for e in events if e["event"] == "sweep_summary"][-1]
+    assert summary["quarantined"] == 1
+
+
+def test_supervisor_isolates_failures_between_configs(tmp_path,
+                                                      monkeypatch):
+    cfg_bad = _cfg()
+    cfg_ok = ex.ExperimentConfig(family="frank", alignment=1, base=0.3,
+                                 pop_tol=0.5, total_steps=10, n_chains=1)
+
+    def run_config(cfg, outdir, checkpoint_dir=None, recorder=None):
+        if cfg.tag == cfg_bad.tag:
+            raise ValueError("poison config")
+        return {"waits_sum": 2.0}
+
+    monkeypatch.setattr(drv, "run_config", run_config)
+    rep = rsup.run_supervised_sweep(
+        [cfg_bad, cfg_ok], str(tmp_path), verbose=False,
+        policy=rsup.RetryPolicy(quarantine_after=1, **_FAST))
+    assert rep.quarantined == [cfg_bad.tag]
+    assert rep.completed == [cfg_ok.tag]      # the sweep went on
+    assert rep.exit_code == 2
+
+
+def test_supervisor_sweep_stream_validates(tmp_path, monkeypatch):
+    """The supervised sweep's full event stream (retry + backoff spans +
+    sweep/config spans) passes the schema AND span-nesting gates."""
+    flaky = _Flaky(fails=1)
+    monkeypatch.setattr(drv, "run_config", flaky)
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    rsup.run_supervised_sweep(
+        [_cfg()], str(tmp_path), verbose=False, recorder=rec,
+        heartbeat=str(tmp_path / "hb.json"),
+        policy=rsup.RetryPolicy(**_FAST))
+    rec.close()
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--check", ev], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    hb = json.load(open(tmp_path / "hb.json"))
+    assert hb["status"] == "complete"
+
+
+# ---- heartbeat is non-fatal --------------------------------------------
+
+def test_heartbeat_write_failure_is_nonfatal(tmp_path, capsys):
+    rfaults.install_from_spec("heartbeat.write:once")
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    hb = str(tmp_path / "hb.json")
+    drv.write_heartbeat(hb, recorder=rec, status="running")  # absorbed
+    drv.write_heartbeat(hb, recorder=rec, status="running")  # lands
+    rec.close()
+    assert json.load(open(hb))["status"] == "running"
+    errs = [e for e in _events(ev) if e["event"] == "heartbeat_error"]
+    assert len(errs) == 1 and "InjectedFault" in errs[0]["message"]
+    assert "continuing" in capsys.readouterr().err
+
+
+def test_heartbeat_oserror_is_nonfatal(tmp_path, monkeypatch):
+    monkeypatch.setattr(drv.os, "replace",
+                        lambda *a: (_ for _ in ()).throw(OSError("full")))
+    drv.write_heartbeat(str(tmp_path / "hb.json"), status="running")
+
+
+# ---- checkpoint integrity ----------------------------------------------
+
+def _ckpt_cfg(**over):
+    kw = dict(family="frank", alignment=0, base=0.3, pop_tol=0.5,
+              total_steps=60, n_chains=2, checkpoint_every=20)
+    kw.update(over)
+    return ex.ExperimentConfig(**kw)
+
+
+@pytest.mark.slow
+def test_checkpoint_manifest_and_rotation(tmp_path):
+    ck = str(tmp_path / "ck")
+    cfg = _ckpt_cfg()
+    ex.run_config(cfg, str(tmp_path / "o"), checkpoint_dir=ck)
+    man = json.load(open(os.path.join(ck, cfg.tag + ".manifest.json")))
+    assert man["version"] == 1
+    assert man["current"]["file"] == cfg.tag + ".npz"
+    assert man["previous"]["file"] == cfg.tag + ".prev.npz"
+    assert man["current"]["gen"] == man["previous"]["gen"] + 1
+    # every manifest digest matches the bytes on disk
+    for name, digest in [(man["current"]["file"],
+                          man["current"]["sha256"]),
+                         (man["previous"]["file"],
+                          man["previous"]["sha256"])] + \
+            sorted(man["parts"].items()):
+        assert drv._sha256_file(os.path.join(ck, name)) == digest, name
+    # keep-last-2: exactly the current + previous generations on disk
+    mains = [f for f in os.listdir(ck) if f.endswith(".npz")
+             and ".h" not in f]
+    assert sorted(mains) == [cfg.tag + ".npz", cfg.tag + ".prev.npz"]
+
+
+def test_corrupt_main_falls_back_to_previous_generation(tmp_path):
+    ck = str(tmp_path / "ck")
+    cfg = _ckpt_cfg()
+    ex.run_config(cfg, str(tmp_path / "o"), checkpoint_dir=ck)
+    main = os.path.join(ck, cfg.tag + ".npz")
+    rfaults.truncate_file(main)
+    loaded = ex.load_checkpoint(ck, cfg)
+    # fell back to the previous generation (one 20-step segment earlier)
+    assert loaded is not None and int(loaded["meta_done"]) == 40
+    assert os.path.exists(os.path.join(ck, ".corrupt"))
+    assert not os.path.exists(main)       # quarantined, not left behind
+    # the fallback is now current; a second load needs no repair
+    assert int(ex.load_checkpoint(ck, cfg)["meta_done"]) == 40
+
+
+def test_corrupt_part_quarantines_generation(tmp_path):
+    ck = str(tmp_path / "ck")
+    cfg = _ckpt_cfg()
+    ex.run_config(cfg, str(tmp_path / "o"), checkpoint_dir=ck)
+    man = json.load(open(os.path.join(ck, cfg.tag + ".manifest.json")))
+    # tear the newest history part (exclusive to the last generation)
+    newest = sorted(man["parts"])[-1]
+    rfaults.truncate_file(os.path.join(ck, newest))
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    loaded = drv.load_checkpoint(ck, cfg, recorder=rec)
+    rec.close()
+    assert loaded is not None and int(loaded["meta_done"]) == 40
+    corrupt = [e for e in _events(ev)
+               if e["event"] == "checkpoint_corrupt"]
+    assert corrupt and corrupt[0]["tag"] == cfg.tag
+    assert "checksum" in corrupt[0]["reason"]
+    quarantined = os.listdir(os.path.join(ck, ".corrupt"))
+    assert any(newest in q for q in quarantined)
+
+
+def test_both_generations_corrupt_means_fresh_start(tmp_path):
+    ck = str(tmp_path / "ck")
+    cfg = _ckpt_cfg()
+    ex.run_config(cfg, str(tmp_path / "o"), checkpoint_dir=ck)
+    rfaults.truncate_file(os.path.join(ck, cfg.tag + ".npz"))
+    rfaults.truncate_file(os.path.join(ck, cfg.tag + ".prev.npz"))
+    assert ex.load_checkpoint(ck, cfg) is None
+
+
+def test_checkpoint_identity_error_on_foreign_state(tmp_path):
+    """A checkpoint whose state fields do not match the template raises
+    the typed error (naming both sides), no longer a bare KeyError."""
+    ck = str(tmp_path / "ck")
+    cfg = _ckpt_cfg(total_steps=40)
+    ex.run_config(cfg, str(tmp_path / "o"), checkpoint_dir=ck)
+    loaded = ex.load_checkpoint(ck, cfg)
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Fake:
+        here: int = 0
+        missing_field: int = 0
+    with pytest.raises(rz.CheckpointIdentityError) as ei:
+        drv._state_from_arrays(Fake(), loaded, tag=cfg.tag)
+    assert "missing_field" in str(ei.value)
+    assert cfg.tag in str(ei.value)
+
+
+# ---- chaos: injected faults leave bit-identical sweeps -----------------
+
+_CHAOS_SPEC = ("checkpoint.write:once,checkpoint.write:truncate@3,"
+               "segment.step:once@4,seed=7")
+
+
+def _history_equal(a, b):
+    for k in a["history"]:
+        np.testing.assert_array_equal(a["history"][k], b["history"][k],
+                                      err_msg=k)
+
+
+@pytest.mark.slow
+def test_chaos_sweep_recovers_bit_identically_lowered(tmp_path):
+    """The acceptance scenario on the lowered fast path: one checkpoint
+    write failure, one torn checkpoint part, one segment failure — the
+    supervised sweep completes and every artifact is byte-identical to
+    the fault-free run (checksum fallback replays from generation 1)."""
+    cfg = _ckpt_cfg()
+    clean = ex.run_config(cfg, str(tmp_path / "clean"))
+
+    rfaults.install_from_spec(_CHAOS_SPEC)
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    rep = rsup.run_supervised_sweep(
+        [cfg], str(tmp_path / "fault"),
+        checkpoint_dir=str(tmp_path / "ck"), verbose=False,
+        recorder=rec, policy=rsup.RetryPolicy(seed=7, **_FAST))
+    rec.close()
+    plan = rfaults.active_plan()
+    assert [f[:2] for f in plan.log] == [
+        ("checkpoint.write", "fail"), ("checkpoint.write", "truncate"),
+        ("segment.step", "fail")]
+    assert rep.completed == [cfg.tag] and rep.retried == 2
+    assert rep.exit_code == 0
+
+    _history_equal(clean, rep.results[0][1])
+    for kind in ex.ARTIFACT_KINDS:
+        a = open(os.path.join(tmp_path, "clean", cfg.tag + kind),
+                 "rb").read()
+        b = open(os.path.join(tmp_path, "fault", cfg.tag + kind),
+                 "rb").read()
+        assert a == b, kind
+    kinds = [e["event"] for e in _events(ev)]
+    assert kinds.count("retry") == 2
+    assert "checkpoint_corrupt" in kinds
+
+
+def test_chaos_sweep_recovers_bit_identically_general(tmp_path):
+    """The same fault set on the general gather path (hex lattice is
+    rejected by the board family), exercising the general runner's
+    segment resume under injected faults."""
+    cfg = ex.ExperimentConfig(family="hex", alignment=1, base=0.3,
+                              pop_tol=0.1, lattice_m=6, lattice_n=10,
+                              total_steps=60, n_chains=2,
+                              checkpoint_every=20)
+    clean = ex.run_config(cfg, str(tmp_path / "clean"))
+
+    rfaults.install_from_spec(_CHAOS_SPEC)
+    rep = rsup.run_supervised_sweep(
+        [cfg], str(tmp_path / "fault"),
+        checkpoint_dir=str(tmp_path / "ck"), verbose=False,
+        policy=rsup.RetryPolicy(seed=7, **_FAST))
+    assert rep.completed == [cfg.tag] and rep.exit_code == 0
+    _history_equal(clean, rep.results[0][1])
+    from flipcomplexityempirical_tpu.experiments.artifacts import (
+        artifact_kinds)
+    for kind in artifact_kinds("hex"):
+        a = open(os.path.join(tmp_path, "clean", cfg.tag + kind),
+                 "rb").read()
+        b = open(os.path.join(tmp_path, "fault", cfg.tag + kind),
+                 "rb").read()
+        assert a == b, kind
+
+
+def test_poison_config_quarantined_with_nonzero_exit(tmp_path):
+    """segment.step:always is deterministic poison: quarantine after
+    quarantine_after attempts, exit code 2, sweep keeps going."""
+    cfg = _ckpt_cfg()
+    rfaults.install_from_spec("segment.step:always")
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    rep = rsup.run_supervised_sweep(
+        [cfg], str(tmp_path), verbose=False, recorder=rec,
+        policy=rsup.RetryPolicy(quarantine_after=2, **_FAST))
+    rec.close()
+    assert rep.quarantined == [cfg.tag] and rep.exit_code == 2
+    kinds = [e["event"] for e in _events(ev)]
+    assert "config_quarantined" in kinds
+
+
+# ---- graceful kernel degradation ---------------------------------------
+
+def test_compile_fault_degrades_to_general(tmp_path):
+    """A persistent kernel failure on the lowered body reruns the
+    config on the general gather kernel — completing with a
+    kernel_path_degraded event instead of crashing."""
+    cfg = _ckpt_cfg(total_steps=40, checkpoint_every=0)
+    rfaults.install_from_spec("compile:always")
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    mark = len(rz.DEGRADATIONS)
+    data = ex.run_config(cfg, str(tmp_path / "o"), recorder=rec)
+    rec.close()
+    assert data["history"]["cut_count"].shape == (2, 40)
+    deg = [e for e in _events(ev) if e["event"] == "kernel_path_degraded"]
+    assert deg and deg[0]["from_path"] == "lowered"
+    assert deg[0]["to_path"] == "general"
+    assert len(rz.DEGRADATIONS) > mark   # audit trail for bench records
+
+
+def test_bench_compare_refuses_degraded_records(tmp_path):
+    from tools import bench_compare
+    rec_ok = {"metrics": {"flips_per_sec": 100.0}, "device": "cpu"}
+    rec_deg = {"metrics": {"flips_per_sec": 50.0}, "device": "cpu",
+               "degraded": True,
+               "degradations": [{"from_path": "bitboard",
+                                 "to_path": "board", "reason": "x"}]}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(rec_ok))
+    b.write_text(json.dumps(rec_deg))
+    assert bench_compare.record_degraded(rec_deg)
+    assert not bench_compare.record_degraded(rec_ok)
+    # a 50% drop would gate... but the degraded record is refused
+    assert bench_compare.main([str(a), str(b),
+                               "--tolerance", "0.05"]) == 0
+
+
+# ---- the CI chaos gate --------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_check_gate_passes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHON=sys.executable)
+    res = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "chaos_check.sh")],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert res.returncode == 0, (res.stdout + "\n" + res.stderr)

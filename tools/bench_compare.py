@@ -18,7 +18,10 @@ when their device tags differ (``device`` fields anywhere in the
 walked blocks, or a truthy ``cpu_fallback`` marker), the delta table
 still prints but the tolerance gate is refused — an "incomparable
 devices" note and exit 0, because a TPU-vs-CPU-fallback "regression"
-is a config problem, not a perf one.
+is a config problem, not a perf one. Records carrying a truthy
+``degraded`` marker (bench.py: the dispatch ladder fell to a slower
+kernel body during the timed region) are refused the same way — their
+number measures the fallback body, not the intended path.
 
 One exception: multi-chip records tag the device as ``"<dev0> xN"``
 (bench.py --mesh), so a 4-chip and an 8-chip run of the same silicon
@@ -130,6 +133,35 @@ def device_tags(doc, out: set | None = None) -> set:
     return out
 
 
+def record_degraded(doc) -> bool:
+    """Did any block of this record run on a kernel body it DEGRADED to
+    (bench.py's ``degraded`` marker, set when resilience.degrade logged
+    a dispatch-ladder fall during the timed region)? Walks the same
+    blocks as extract_metrics. Such a record's throughput measures the
+    fallback body, not the intended path — gating on it would bless a
+    broken fast path."""
+    if isinstance(doc, dict):
+        if doc.get("degraded"):
+            return True
+        for key in ("parsed", "results", "metrics"):
+            if key in doc and record_degraded(doc[key]):
+                return True
+        tail = doc.get("tail")
+        if isinstance(tail, str):
+            for line in tail.splitlines():
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    if record_degraded(json.loads(line)):
+                        return True
+                except ValueError:
+                    pass
+    elif isinstance(doc, list):
+        return any(record_degraded(item) for item in doc)
+    return False
+
+
 def _base_silicon(tag: str) -> str:
     """Collapse a device tag to the silicon it names: lowercase, strip
     parenthesized detail, a trailing ``xN`` device count (bench --mesh
@@ -196,6 +228,19 @@ def main(argv=None) -> int:
     if not common:
         print("bench_compare: no metric appears in both records — "
               "nothing to gate on", file=sys.stderr)
+        return 0
+
+    deg_a, deg_b = record_degraded(doc_a), record_degraded(doc_b)
+    if deg_a or deg_b:
+        # a degraded record timed whatever body the dispatch ladder fell
+        # to, not the intended path — same shape as the cpu_fallback
+        # refusal: print the deltas for eyeballing, refuse the gate
+        compare(a, b, args.tolerance)
+        which = " and ".join(s for s, d in (("A", deg_a), ("B", deg_b))
+                             if d)
+        print(f"bench_compare: record {which} ran degraded (kernel-path "
+              "fallback during the timed region) — refusing --tolerance "
+              "gate", file=sys.stderr)
         return 0
 
     tags_a, tags_b = device_tags(doc_a), device_tags(doc_b)

@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# Fault-tolerance CI gate (`make chaos-check`): the ISSUE 7 acceptance
+# scenario end to end, on CPU.
+#
+#   1. graftlint over the package + tools (G007 retry/timeout hygiene
+#      rides the same run as the emit/sync/RNG contracts)
+#   2. a seeded chaos sweep through the supervised CLI: one checkpoint
+#      write failure + one torn checkpoint part + one segment failure
+#      across a 3-config frank sweep — every config must complete and
+#      every artifact must be byte-identical to a fault-free reference
+#      sweep (retries resume from checkpoints; the torn part forces the
+#      checksum fallback to the previous generation)
+#   3. the chaos run's event stream passes obs_report --check, carries
+#      retry + checkpoint_corrupt events, survives trace_export
+#      --validate, and obs_report --strict (with the heartbeat probe)
+#      exits 0 — recovered-from faults are not health failures
+#   4. a poison config (segment.step:always) is quarantined: the CLI
+#      exits nonzero and emits config_quarantined; obs_report --strict
+#      then fails on that stream
+#
+#   tools/chaos_check.sh
+#
+# Exercised by tests/test_resilience.py, so tier-1 fails when any gate
+# rots.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+tmp="$(mktemp -d)"
+trap 'rm -rf "$tmp"' EXIT
+
+"$PY" -m tools.graftlint flipcomplexityempirical_tpu tools
+
+SWEEP_ARGS=(--family frank --steps 60 --chains 2 --checkpoint-every 20
+            --cpu --only 2B30P50 1B30P50 0B30P50)
+
+# fault-free reference sweep (supervised CLI, no plan installed)
+GRAFT_FAULTS= "$PY" -m flipcomplexityempirical_tpu.experiments \
+    "${SWEEP_ARGS[@]}" --out "$tmp/clean" \
+    --checkpoint-dir "$tmp/ck_clean" \
+    --heartbeat "$tmp/heartbeat_clean.json" > /dev/null
+
+# the chaos sweep: fail save 1, tear a part of save 2, fail segment 4 —
+# all absorbed by retries + the checksum fallback, same seed, same bits
+"$PY" -m flipcomplexityempirical_tpu.experiments \
+    "${SWEEP_ARGS[@]}" --out "$tmp/fault" --checkpoint-dir "$tmp/ck" \
+    --faults 'checkpoint.write:once,checkpoint.write:truncate@3,segment.step:once@4,seed=7' \
+    --events "$tmp/chaos_events.jsonl" \
+    --heartbeat "$tmp/heartbeat.json" > /dev/null
+
+for f in "$tmp"/clean/*; do
+    cmp "$f" "$tmp/fault/$(basename "$f")" \
+        || { echo "chaos-check: artifact diverged: $(basename "$f")"; exit 1; }
+done
+
+"$PY" tools/obs_report.py --check "$tmp/chaos_events.jsonl"
+"$PY" tools/trace_export.py --validate "$tmp/chaos_events.jsonl"
+"$PY" tools/obs_report.py --strict \
+    --heartbeat "$tmp/heartbeat.json" \
+    "$tmp/chaos_events.jsonl" > /dev/null
+"$PY" - "$tmp/chaos_events.jsonl" <<'PYEOF'
+import json
+import sys
+
+kinds = {}
+with open(sys.argv[1], encoding="utf-8") as f:
+    for line in f:
+        e = json.loads(line)
+        kinds[e["event"]] = kinds.get(e["event"], 0) + 1
+assert kinds.get("retry", 0) == 2, kinds
+assert kinds.get("checkpoint_corrupt", 0) == 1, kinds
+assert kinds.get("config_quarantined", 0) == 0, kinds
+summary = [json.loads(l) for l in open(sys.argv[1], encoding="utf-8")
+           if '"sweep_summary"' in l][-1]
+assert summary["completed"] == 3 and summary["retried"] == 2, summary
+print("chaos-check: chaos stream OK "
+      f"(retries={kinds['retry']}, corrupt={kinds['checkpoint_corrupt']})")
+PYEOF
+
+# poison: a config that fails deterministically every attempt must be
+# quarantined with a nonzero exit, not retried forever
+set +e
+"$PY" -m flipcomplexityempirical_tpu.experiments \
+    --family frank --steps 40 --chains 2 --cpu --only 0B30P50 \
+    --out "$tmp/poison" --faults 'segment.step:always' \
+    --quarantine-after 2 \
+    --events "$tmp/poison_events.jsonl" > /dev/null
+poison_rc=$?
+set -e
+[ "$poison_rc" -ne 0 ] \
+    || { echo "chaos-check: poison sweep exited 0"; exit 1; }
+grep -q '"config_quarantined"' "$tmp/poison_events.jsonl" \
+    || { echo "chaos-check: no config_quarantined event"; exit 1; }
+set +e
+"$PY" tools/obs_report.py --strict "$tmp/poison_events.jsonl" > /dev/null
+strict_rc=$?
+set -e
+[ "$strict_rc" -ne 0 ] \
+    || { echo "chaos-check: --strict passed a quarantined stream"; exit 1; }
+
+echo "chaos-check: OK"

@@ -1,0 +1,115 @@
+"""G007: retry/timeout hygiene in the fault-tolerance layer.
+
+The resilience package and the experiments driver are the code that
+runs UNATTENDED for days: retry loops, backoff waits, checkpoint
+rotation, deadline checks. Three classes of bug hide well there and
+surface only in production sweeps:
+
+- ``except Exception: pass`` (or bare / BaseException) — a swallowed
+  error defeats the supervisor's classifier: the failure neither
+  retries nor quarantines, it silently vanishes. Handle a TYPED
+  exception, or re-raise / record something.
+- ``time.time()`` in duration arithmetic — wall-clock time jumps under
+  NTP slew; a backoff or deadline computed from it can go negative or
+  stretch unboundedly. Durations and deadlines must use
+  ``time.monotonic()`` (or ``perf_counter``); ``time.time()`` stays
+  legal for event TIMESTAMPS, which are never subtracted.
+- module-level ``random.*`` calls — backoff jitter from the unseeded
+  process-global RNG makes retry schedules (and therefore chaos-test
+  streams) unreproducible. Jitter must come from a seeded
+  ``random.Random(seed)`` instance (RetryPolicy does this).
+
+Statically: in ``resilience/`` and ``experiments/`` modules, flag (a)
+any ExceptHandler whose type is missing / ``Exception`` /
+``BaseException`` and whose body is a single ``pass``; (b) any ``-``
+BinOp where an operand is a ``time.time()`` call or a name assigned
+from one; (c) any ``random.<fn>()`` call on the ``random`` MODULE
+(instantiating ``random.Random``/``SystemRandom`` is the fix, so those
+are exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted_name
+
+RULE_ID = "G007"
+
+_BROAD = frozenset({"Exception", "BaseException"})
+_SEEDED_FACTORIES = frozenset({"Random", "SystemRandom"})
+
+
+def applies(module) -> bool:
+    in_scope = ("resilience/" in module.path
+                or "experiments/" in module.path)
+    return in_scope and not module.is_test
+
+
+def _is_time_time(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) == "time.time")
+
+
+def _wall_clock_names(tree) -> set:
+    """Names bound (anywhere in the module) from a bare ``time.time()``
+    call — subtracting one of these is the same bug as subtracting the
+    call itself."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_time_time(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    if not (len(handler.body) == 1
+            and isinstance(handler.body[0], ast.Pass)):
+        return False
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any((dotted_name(el) or "").split(".")[-1] in _BROAD
+                   for el in t.elts)
+    return (dotted_name(t) or "").split(".")[-1] in _BROAD
+
+
+def check(module, config):
+    findings = []
+    tree = module.tree
+    wall_names = _wall_clock_names(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and _swallows(node):
+            what = (dotted_name(node.type) if node.type is not None
+                    else "bare except")
+            findings.append(module.finding(
+                RULE_ID, node,
+                f"swallowed broad exception ({what}: pass) — a failure "
+                "here neither retries nor quarantines; catch a typed "
+                "exception or record it"))
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            for side in (node.left, node.right):
+                if _is_time_time(side) or (isinstance(side, ast.Name)
+                                           and side.id in wall_names):
+                    findings.append(module.finding(
+                        RULE_ID, node,
+                        "duration computed from time.time() — wall "
+                        "clock jumps under NTP; use time.monotonic() "
+                        "for durations/deadlines (time.time() is for "
+                        "timestamps only)"))
+                    break
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "random"
+                    and fn.attr not in _SEEDED_FACTORIES):
+                findings.append(module.finding(
+                    RULE_ID, node,
+                    f"random.{fn.attr}() uses the unseeded process "
+                    "RNG — backoff jitter must come from a seeded "
+                    "random.Random(seed) so retry schedules replay"))
+    return findings

@@ -28,8 +28,14 @@ violation — the CI gate on anything that emits telemetry. It also
 prints the grandfathered-finding count from the committed
 ``graftlint_baseline.json`` so static-analysis debt is visible in the
 same report (target: 0). ``--strict`` additionally exits nonzero (after
-printing the report) when the stream carries any ``anomaly`` events —
-the CI gate on chain HEALTH rather than stream shape. Stdlib-only: the
+printing the report) when the stream carries any ``anomaly``,
+``config_quarantined``, or ``kernel_path_degraded`` events — the CI
+gate on chain and sweep HEALTH rather than stream shape — or when
+``--heartbeat PATH`` names a sweep heartbeat whose mtime is staler than
+2x ``--heartbeat-interval`` without a complete status. A Resilience
+section summarizes retries by error class, quarantines, kernel-path
+degradations, corrupt checkpoint generations, and heartbeat write
+failures whenever the stream carries any. Stdlib-only: the
 schema module is loaded by file path, so neither gate needs jax (or any
 package import) at all. ``.jsonl.gz`` streams (obs.Recorder gzip sinks)
 are read transparently.
@@ -437,6 +443,87 @@ def report_timing(events, runs, out):
                       f"| {h.get('count', 0)} | {cells} |", file=out)
 
 
+def report_resilience(events, out):
+    """The fault-tolerance section: retries grouped by error class,
+    quarantined / failed configs, kernel-path degradations, corrupt
+    checkpoint generations, and heartbeat write failures. Rendered only
+    when the stream carries any of it (fault-free streams stay
+    byte-identical). ``--strict`` turns quarantines and degradations
+    into a nonzero exit — the health gate on sweep resilience."""
+    retries = [e for e in events if e["event"] == "retry"]
+    quarantined = [e for e in events if e["event"] == "config_quarantined"]
+    failed = [e for e in events if e["event"] == "config_failed"]
+    degraded = [e for e in events if e["event"] == "kernel_path_degraded"]
+    corrupt = [e for e in events if e["event"] == "checkpoint_corrupt"]
+    hb_err = [e for e in events if e["event"] == "heartbeat_error"]
+    summary = [e for e in events if e["event"] == "sweep_summary"]
+    if not (retries or quarantined or failed or degraded or corrupt
+            or hb_err or summary):
+        return
+
+    print("\n## Resilience", file=out)
+    if summary:
+        s = summary[-1]
+        print(f"sweep summary: {s['completed']} completed, "
+              f"{s['retried']} retried, {s['quarantined']} quarantined, "
+              f"{s['failed']} failed", file=out)
+    if retries:
+        by_class: dict = {}
+        for e in retries:
+            by_class.setdefault(e.get("error_class", "?"), []).append(e)
+        print("\n### Retries by error class", file=out)
+        print("| error_class | retries | configs | backoff_s total |",
+              file=out)
+        print("|---|---|---|---|", file=out)
+        for cls in sorted(by_class):
+            es = by_class[cls]
+            tags = sorted({e.get("tag", "?") for e in es})
+            backoff = sum(e.get("backoff_s", 0.0) for e in es)
+            print(f"| {cls} | {len(es)} | {', '.join(tags)} "
+                  f"| {backoff:.2f} |", file=out)
+    for label, es, keys in (
+            ("quarantined", quarantined, ("failures",)),
+            ("failed", failed, ("error_class", "message"))):
+        for e in es:
+            detail = ", ".join(f"{k}={e.get(k)}" for k in keys)
+            print(f"- {label.upper()} [{e.get('tag', '?')}]: {detail}",
+                  file=out)
+    for e in degraded:
+        print(f"- DEGRADED {e['from_path']} -> {e['to_path']}: "
+              f"{e.get('reason', '?')}", file=out)
+    for e in corrupt:
+        print(f"- CORRUPT CHECKPOINT [{e.get('tag', '?')}] "
+              f"{e.get('path', '?')}: {e.get('reason', '?')}", file=out)
+    if hb_err:
+        print(f"- heartbeat write failures: {len(hb_err)} "
+              f"(non-fatal; last: {hb_err[-1].get('message', '?')})",
+              file=out)
+
+
+def check_heartbeat(path: str, interval_s: float):
+    """Stale-heartbeat probe: returns an error string when the heartbeat
+    file is missing, unparsable, or its mtime is older than 2x the
+    expected refresh interval — unless its payload says the sweep
+    finished (a completed sweep stops refreshing by design)."""
+    import time as _time
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        mtime = os.path.getmtime(path)
+    except (OSError, json.JSONDecodeError) as e:
+        return f"heartbeat {path}: unreadable ({e})"
+    status = str(payload.get("status", ""))
+    if status.startswith("complete"):
+        return None
+    age = _time.time() - mtime
+    if age > 2 * interval_s:
+        return (f"heartbeat {path}: stale — last refreshed {age:.0f}s "
+                f"ago (> 2x the {interval_s:.0f}s interval); status="
+                f"{status or '?'}")
+    return None
+
+
 def report_sweep(events, out):
     sweep = [e for e in events if e["event"] == "sweep_config"]
     errors = [e for e in events if e["event"] == "error"]
@@ -476,7 +563,16 @@ def main(argv=None):
                          "unknown/malformed event (CI gate)")
     ap.add_argument("--strict", action="store_true",
                     help="after the report, exit nonzero if the stream "
-                         "carries any anomaly events (health gate)")
+                         "carries any anomaly, config_quarantined, or "
+                         "kernel_path_degraded events (health gate)")
+    ap.add_argument("--heartbeat", metavar="PATH", default=None,
+                    help="also probe this sweep heartbeat file for "
+                         "staleness (mtime > 2x --heartbeat-interval "
+                         "with a non-complete status); fails --strict")
+    ap.add_argument("--heartbeat-interval", type=float, default=300.0,
+                    metavar="S",
+                    help="expected heartbeat refresh cadence for the "
+                         "staleness probe (default: 300)")
     args = ap.parse_args(argv)
     schema = _load_schema()
 
@@ -500,12 +596,26 @@ def main(argv=None):
         report_runs(runs, out)
     report_health(events, runs, out)
     report_timing(events, runs, out)
+    report_resilience(events, out)
     report_sweep(events, out)
+    hb_error = None
+    if args.heartbeat:
+        hb_error = check_heartbeat(args.heartbeat, args.heartbeat_interval)
+        if hb_error:
+            print(f"\n{hb_error}", file=out)
     if args.strict:
-        n_anom = sum(1 for e in events if e["event"] == "anomaly")
-        if n_anom:
-            print(f"--strict: {n_anom} anomaly event(s) in stream",
-                  file=sys.stderr)
+        gated = {"anomaly": 0, "config_quarantined": 0,
+                 "kernel_path_degraded": 0}
+        for e in events:
+            if e["event"] in gated:
+                gated[e["event"]] += 1
+        bad_kinds = [f"{n} {k}" for k, n in sorted(gated.items()) if n]
+        if bad_kinds:
+            print("--strict: " + ", ".join(bad_kinds)
+                  + " event(s) in stream", file=sys.stderr)
+            return 2
+        if hb_error:
+            print(f"--strict: {hb_error}", file=sys.stderr)
             return 2
     return 0
 
