@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test obs-check mesh-check chaos-check lint
+.PHONY: test obs-check mesh-check chaos-check bitpack-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -25,6 +25,12 @@ mesh-check:
 # and a poison config must quarantine with a nonzero exit
 chaos-check:
 	PYTHON=$(PYTHON) JAX_PLATFORMS=cpu tools/chaos_check.sh
+
+# bit-identity gate: the packed lowered_bits body vs the int8 lowered
+# body on a small surgical grid must agree bit-for-bit (fast smoke; the
+# full parity matrix is tests/test_bitboard_lowered.py)
+bitpack-check:
+	PYTHON=$(PYTHON) tools/bitpack_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

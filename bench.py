@@ -98,8 +98,12 @@ def main():
     ap.add_argument("--body", choices=["int8", "bits"], default=None,
                     help="force ONE board body instead of timing both "
                          "and reporting the faster (for per-body "
-                         "records, e.g. the v4-vs-v5 on-chip comparison); "
-                         "board path only, incompatible with "
+                         "records, e.g. the v4-vs-v5 on-chip comparison). "
+                         "On the rook grid 'bits' is the bit-board and "
+                         "'int8' the plane body; on sec11/frank (the "
+                         "lowered stencil family) 'bits' is the packed "
+                         "lowered_bits body and 'int8' the int8 lowered "
+                         "body. Board path only, incompatible with "
                          "--pallas/--general")
     ap.add_argument("--block-chains", type=int, default=128)
     ap.add_argument("--profile", metavar="DIR", default=None,
@@ -289,11 +293,6 @@ def main():
         print("bench: --body given but the board path does not support "
               "this workload", file=sys.stderr)
         sys.exit(2)
-    if args.body is not None and args.graph != "square":
-        print("bench: --body selects between the rook int8/bit bodies; "
-              "sec11/frank run the lowered stencil body only",
-              file=sys.stderr)
-        sys.exit(2)
     if args.mesh is not None:
         if not use_board:
             print("bench: --mesh requires a board-path workload "
@@ -326,8 +325,14 @@ def main():
                     block_chains=args.block_chains)
         else:
             from flipcomplexityempirical_tpu.kernel import bitboard
-            bits_ok = (bitboard.supported(bg, spec)
-                       or bitboard.supported_pair(bg, spec))
+            # 'lowered' here mirrors run_board_chunk's own branch: a
+            # surgical/interface workload runs the stencil family, so
+            # --body / the two-variant race selects lowered_bits vs
+            # lowered instead of bitboard vs int8
+            lowered = bg.surgical or spec.record_interface
+            bits_ok = (bitboard.supported_lowered(bg, spec) if lowered
+                       else (bitboard.supported(bg, spec)
+                             or bitboard.supported_pair(bg, spec)))
             if args.body is not None:
                 if args.body == "bits" and not bits_ok:
                     print("bench: --body bits unsupported for this "
@@ -335,7 +340,7 @@ def main():
                     sys.exit(2)
                 variants = [args.body == "bits"]
             elif bits_ok:
-                # the bit-board and int8 bodies are bit-identical; time
+                # the bit-packed and int8 bodies are bit-identical; time
                 # BOTH and report the faster (which body wins is a pure
                 # hardware/compiler question the benchmark answers)
                 variants = [True, False]
@@ -400,9 +405,10 @@ def main():
     flips = args.chains * (args.steps - 1)  # yields minus the initial record
     fps = flips / dt
     s = res.host_state()
-    # the body that actually produced the winning time: 'lowered' |
-    # 'bitboard' | 'board' | 'pallas' | 'general' — scoreboards key on
-    # this, so a graph silently falling off the fast path is visible
+    # the body that actually produced the winning time: 'lowered_bits' |
+    # 'lowered' | 'bitboard' | 'board' | 'pallas' | 'general' —
+    # scoreboards key on this, so a graph silently falling off the fast
+    # path is visible
     kernel_path = ("pallas" if use_board and args.pallas
                    else kboard.body_for(bg, spec, best) if use_board
                    else "general")
@@ -427,7 +433,8 @@ def main():
     }
     if use_board and not args.pallas and (len(variants) > 1
                                           or args.body is not None):
-        meta["body"] = "bitboard" if best else "int8"
+        meta["body"] = (("lowered_bits" if best else "lowered") if lowered
+                        else ("bitboard" if best else "int8"))
 
     if args.ess:
         # recorded pass at the winning variant: effective samples of the

@@ -146,10 +146,11 @@ class _ShardedStep:
         self.exchange = exchange
         self._body = body
         self._built: dict = {}
-        # bitboard steps get a zero-arg rebuild hook -> (body, path) so
-        # run_sharded can drop to the int8 board body on a kernel error
-        # (BoardState is shared between the two: the bit-pack happens
-        # inside run_board_chunk, so the carried states need no rewrite)
+        # packed steps (bitboard / lowered_bits) get a zero-arg rebuild
+        # hook -> (body, path) so run_sharded can drop to the int8 body
+        # of the same family on a kernel error (BoardState is shared:
+        # the bit-pack happens inside run_board_chunk, so the carried
+        # states need no rewrite)
         self.fallback = None
 
     def degrade(self):
@@ -247,26 +248,34 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
 
     The local advance is ``kernel.board.run_board_chunk``, so the body
     dispatch is board_runner's: surgical/interface stencils run the
-    lowered body, plain grids the bit-board body where supported, int8
-    otherwise. ``bits`` forces the rook-body choice exactly like the
-    runner's flag (None = auto); the selected body is exposed as
-    ``step.kernel_path``. Invalid forcings fail here, at build time,
-    with ``run_board_chunk``'s messages — not at first dispatch.
+    lowered family (packed ``lowered_bits`` where
+    ``bitboard.supported_lowered`` holds, int8 ``lowered`` otherwise),
+    plain grids the bit-board body where supported, int8 otherwise.
+    ``bits`` forces the packed/int8 choice within the active family
+    exactly like the runner's flag (None = auto); the selected body is
+    exposed as ``step.kernel_path``. Invalid forcings fail here, at
+    build time, with ``run_board_chunk``'s messages — not at first
+    dispatch.
     """
     _check_exchange(exchange, spec)
     n_dev = _mesh_size(mesh)
     lowered = bg.surgical or spec.record_interface
-    if lowered and bits:
-        raise ValueError("bits=True: the lowered stencil body has no "
-                         "bit-board backend")
-    if bits and not lowered:
-        bits_ok = (bitboard.supported_pair(bg, spec)
-                   if spec.proposal == "pair"
-                   else bitboard.supported(bg, spec))
-        if not bits_ok:
-            raise ValueError("bits=True: workload not supported by the "
-                             "bit-board body (see bitboard.supported / "
-                             "supported_pair)")
+    if bits:
+        if lowered:
+            if not bitboard.supported_lowered(bg, spec):
+                raise ValueError("bits=True: workload not supported by "
+                                 "the packed lowered body (see "
+                                 "bitboard.supported_lowered); "
+                                 "bits=False selects the int8 'lowered' "
+                                 "body")
+        else:
+            bits_ok = (bitboard.supported_pair(bg, spec)
+                       if spec.proposal == "pair"
+                       else bitboard.supported(bg, spec))
+            if not bits_ok:
+                raise ValueError("bits=True: workload not supported by "
+                                 "the bit-board body (see "
+                                 "bitboard.supported / supported_pair)")
     kernel_path = kboard.body_for(bg, spec, bits)
 
     def make_body(body_bits):
@@ -293,7 +302,7 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
 
     step = _ShardedStep(mesh, make_body(bits), kernel_path, n_dev,
                         exchange)
-    if kernel_path == "bitboard":
+    if kernel_path in ("bitboard", "lowered_bits"):
         step.fallback = lambda: (make_body(False),
                                  kboard.body_for(bg, spec, False))
     return step

@@ -40,6 +40,25 @@ per-plane XORs, the population gates become one per-cell plane per side
 built in a single pass over the k districts, and selection runs over
 the four per-direction pair planes in the int8 body's (node, direction)
 order. Everything outside both gates silently uses the int8 bodies.
+
+The LOWERED stencil family (surgical canvases — sec11, Frankengraph,
+queen grids — and record_interface runs) has its own packed body at the
+bottom of this module, gated by ``supported_lowered()``. It drops the
+W % 32 requirement by packing ROW-ALIGNED: each canvas row is padded up
+to a word boundary (``canvas_words`` words per row), so the (dr, dc)
+stencil read of any direction — diagonals included — is one funnel
+shift by ``dr * row_bits + dc`` (``shift_canvas``). Cross-row and
+frame garbage from the shift is never masked arithmetically; every
+consumer ANDs with an exact packed plane (``adj`` per direction,
+``b2_in`` per window offset), which is also what makes holes exact:
+hole cells pack as district-0 bits, but no adjacency plane ever has a
+bit over a hole. The B2-window contiguity check
+(board._stencil_patch_ok's bitset label propagation) vectorizes across
+cells the other way around: one packed PLANE per window offset k
+(member/seed/reach), with the static offset-pair adjacency
+``b2_adj[k] bit j`` packed per (k, j) pair — the same Jacobi rounds in
+the same order, so the result is bit-identical. cut_times keeps all
+FOUR forward planes (E, SE, S, SW) in bit-sliced ripple-carry counters.
 """
 
 from __future__ import annotations
@@ -433,4 +452,202 @@ def counter_fold(slices, n: int):
     tot = 0
     for k, s in enumerate(slices):
         tot = tot + (unpack_bits(s, n).astype(jnp.int32) << k)
+    return tot
+
+
+# ---------------------------------------------------------------------------
+# Lowered stencil family: row-aligned packing over the HxW canvas
+# ---------------------------------------------------------------------------
+
+# ring-order (dr, dc) canvas deltas, E SE S SW W NW N NE — the same
+# order as lower.stencil.RING_DELTAS and board._ring_offsets (kept
+# literal here so this module stays import-light, like _ring_offsets)
+_RING_DELTAS = ((0, 1), (1, 1), (1, 0), (1, -1),
+                (0, -1), (-1, -1), (-1, 0), (-1, 1))
+
+
+def supported_lowered(bg, spec: Spec) -> bool:
+    """Static gate: may a lowered-family chunk (surgical stencil and/or
+    record_interface) run on the packed stencil body? Duck-types on
+    BoardGraph / lower.StencilSpec like the rook gates. Requirements:
+    uniform node population (one pop boolean per chain per side), the
+    2-district 'bi' walk, accept in ('cut', 'always') (the 'corrected'
+    reversibility term needs per-neighbor boundary counts the bit
+    planes don't keep), and — under 'patch' contiguity — an unambiguous
+    2-D displacement per B2-window offset (``b2_disp``; a flat offset
+    realized by two (dr, dc) pairs only happens at canvas width <= 4).
+    No width restriction: rows pack word-aligned."""
+    return (
+        bool(bg.uniform_pop)
+        and spec.n_districts == 2
+        and spec.proposal == "bi"
+        and spec.accept in ("cut", "always")
+        and spec.contiguity in ("patch", "none")
+        and (spec.contiguity != "patch"
+             or getattr(bg, "b2_disp", None) is not None)
+    )
+
+
+def canvas_words(w: int) -> int:
+    """Words per canvas row (rows pad up to a word boundary so every
+    row starts at bit 0 of a fresh word)."""
+    return n_words(w)
+
+
+def pack_canvas(plane, h: int, w: int) -> jnp.ndarray:
+    """(..., N=h*w) {0,1}/bool -> (..., h*wpr) uint32, row-aligned: row
+    r occupies words [r*wpr, (r+1)*wpr), bit j of word r*wpr+q = cell
+    r*w + q*32 + j. Pad bits (columns >= w) are zero."""
+    wpr = canvas_words(w)
+    p = plane.reshape(*plane.shape[:-1], h, w)
+    return pack_bits(p).reshape(*plane.shape[:-1], h * wpr)
+
+
+def unpack_canvas(words, h: int, w: int) -> jnp.ndarray:
+    """(..., h*wpr) uint32 -> (..., N) int8 (inverse of pack_canvas)."""
+    wpr = words.shape[-1] // h
+    u = unpack_bits(words.reshape(*words.shape[:-1], h, wpr), w)
+    return u.reshape(*words.shape[:-1], h * w)
+
+
+def canvas_bit_index(flat, w: int):
+    """Canvas-flat cell index -> bit index in the row-aligned packing
+    (identity when w % 32 == 0)."""
+    r = flat // w
+    return r * (canvas_words(w) * 32) + (flat - r * w)
+
+
+def shift_canvas(words, dr: int, dc: int, w: int):
+    """Packed read of the (dr, dc) canvas neighbor: cell (r+dr, c+dc)'s
+    bit moves to cell (r, c)'s position. Cross-row and frame garbage
+    survives in the shifted words — every caller masks with an exact
+    packed plane (adj / b2_in), never arithmetically."""
+    off = dr * canvas_words(w) * 32 + dc
+    if off == 0:
+        return words
+    return shift_down(words, off) if off > 0 else shift_up(words, -off)
+
+
+def _patch_ok_bits(bg, board_w):
+    """EXACT board._stencil_patch_ok on packed planes: per-cell bitsets
+    over the K B2-window offsets become K packed PLANES (member / seed /
+    reach), and the per-cell offset-pair adjacency ``b2_adj[k] bit j``
+    becomes one static packed plane per nonzero (k, j) pair
+    (``bg.b2_pairs``, precomputed on the host). Same lowest-seed
+    initialization and the same ``b2_iters`` Jacobi rounds in the same
+    order, so the reachability fixpoint — and therefore the contiguity
+    verdict — is bit-identical. Holes are exact for free: ``b2_in[k]``
+    is only set where both the cell and its offset-k partner are real
+    nodes, so the hole cells' district-0 packing never leaks in."""
+    h, w = bg.h, bg.w
+    kk = len(bg.b2_offsets)
+    member = []
+    for k in range(kk):
+        dr, dc = bg.b2_disp[k]
+        same_k = ~(board_w ^ shift_canvas(board_w, dr, dc, w))
+        member.append(same_k & pack_canvas(bg.b2_in[k][None, :], h, w))
+    seeds = [member[k] & pack_canvas(
+        ((bg.nbr_bits >> k) & 1)[None, :], h, w) for k in range(kk)]
+
+    # reach starts at the lowest-index seed (int32 body: seeds & -seeds)
+    reach = []
+    lower = None
+    for k in range(kk):
+        reach.append(seeds[k] if lower is None else seeds[k] & ~lower)
+        lower = seeds[k] if lower is None else lower | seeds[k]
+
+    adj_pair = {(k, j): pack_canvas(((bg.b2_adj[k] >> j) & 1)[None, :],
+                                    h, w)
+                for (k, j) in bg.b2_pairs}
+    for _ in range(bg.b2_iters):
+        contrib = [None] * kk
+        for (k, j) in bg.b2_pairs:
+            t = reach[k] & adj_pair[(k, j)]
+            contrib[j] = t if contrib[j] is None else contrib[j] | t
+        reach = [r if c is None else r | (c & m)
+                 for r, c, m in zip(reach, contrib, member)]
+
+    bad = None
+    for k in range(kk):
+        b = seeds[k] & ~reach[k]
+        bad = b if bad is None else bad | b
+    return ~bad
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def planes_bits_lowered(bg, spec: Spec, params: StepParams, board_w,
+                        dist_pop, count: bool = False):
+    """Bit-plane analogue of board._planes_stencil: 8 masked direction
+    planes (diagonals are just two more shift offsets), boundary mask
+    and count, exact B2 contiguity, population gate, validity, and all
+    four forward cut planes. ``count`` adds ``has_pop`` (C,) for the
+    reject-reason taxonomy."""
+    h, w = bg.h, bg.w
+    diff = []
+    for d, (dr, dc) in enumerate(_RING_DELTAS):
+        x = board_w ^ shift_canvas(board_w, dr, dc, w)
+        diff.append(x & pack_canvas(bg.adj[d][None, :], h, w))
+
+    # adj planes only exist over real cells, so the boundary mask needs
+    # no separate node_mask AND (board._planes_stencil's b_mask)
+    b_mask = diff[0]
+    for p in diff[1:]:
+        b_mask = b_mask | p
+    b_count = jax.lax.population_count(b_mask).astype(jnp.int32).sum(1)
+
+    if spec.contiguity == "patch":
+        contig = _patch_ok_bits(bg, board_w)
+    else:
+        contig = ~jnp.zeros_like(b_mask)
+
+    # uniform population (gated): same exact-f32 threshold trick as the
+    # rook bit body; the unit comes from the first REAL cell (bg.pop[0]
+    # may be a hole carrying population 0)
+    unit = bg.pop[bg.cell_of_node[0]].astype(jnp.float32)
+    p0 = dist_pop[:, 0].astype(jnp.float32)
+    p1 = dist_pop[:, 1].astype(jnp.float32)
+    lo = jnp.ceil(params.pop_lo)
+    hi = jnp.floor(params.pop_hi)
+    ok0 = unit <= jnp.minimum(p0 - lo, hi - p1)
+    ok1 = unit <= jnp.minimum(p1 - lo, hi - p0)
+    full = U32(0xFFFFFFFF)
+    pop_ok = ((board_w & jnp.where(ok1, full, U32(0))[:, None])
+              | (~board_w & jnp.where(ok0, full, U32(0))[:, None]))
+
+    valid = b_mask & contig & pop_ok
+    out = dict(valid=valid, b_count=b_count, diff=diff,
+               cut_e=diff[0], cut_se=diff[1], cut_s=diff[2],
+               cut_sw=diff[3])
+    if count:
+        out["has_pop"] = (jax.lax.population_count(b_mask & pop_ok)
+                          .astype(jnp.int32).sum(1) > 0)
+    return out
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def select_flat_lowered(bg, valid, u):
+    """The (m+1)-th valid cell in CANVAS row-major order — identical
+    choice to board._select_two_level on the unpacked plane, via per-row
+    popcounts over the row-aligned words. Returns (flat, any_valid)
+    with ``flat`` a canvas-flat index (callers convert to a packed bit
+    index with ``canvas_bit_index``)."""
+    c = valid.shape[0]
+    h, w = bg.h, bg.w
+    wpr = canvas_words(w)
+    pc = jax.lax.population_count(valid).astype(jnp.int32)
+    row, m_in_row, any_valid, oh_row = _pick_row(
+        pc.reshape(c, h, wpr).sum(-1), u)
+
+    rw = jnp.sum(jnp.where(oh_row, valid.reshape(c, h, wpr), U32(0)),
+                 axis=1, dtype=U32)        # (C, wpr): the chosen row
+    colcum = jnp.cumsum(unpack_bits(rw, w).astype(jnp.int32), axis=1)
+    col = jnp.argmax(colcum > m_in_row[:, None], axis=1).astype(jnp.int32)
+    return row * w + col, any_valid
+
+
+def counter_fold_canvas(slices, h: int, w: int):
+    """Bit-sliced canvas counters -> (C, N) int32 totals."""
+    tot = 0
+    for k, s in enumerate(slices):
+        tot = tot + (unpack_canvas(s, h, w).astype(jnp.int32) << k)
     return tot

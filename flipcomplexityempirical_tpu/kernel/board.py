@@ -49,7 +49,10 @@ and the memory system:
   (``kernel/bitboard.py``): board and planes packed 32 cells per uint32
   lane, cut_times in bit-sliced ripple-carry counters — bit-identical
   trajectories at a fraction of the plane traffic
-  (``tests/test_bitboard.py``).
+  (``tests/test_bitboard.py``). The lowered stencil family (surgical
+  canvases, record_interface) has its own packed body with row-aligned
+  words and all four forward cut counters bit-sliced
+  (``bitboard.supported_lowered``; ``tests/test_bitboard_lowered.py``).
 - The k-district 'pair' proposal (slow_reversible_propose semantics,
   grid_chain_sec11.py:117-130) has its own int8 body: per-(node,
   direction) pair validity planes with district dedup, selection over
@@ -123,6 +126,11 @@ class BoardGraph:
     surgical: bool = struct.field(pytree_node=False, default=False)
     real_nodes: int = struct.field(pytree_node=False, default=0)
     b2_offsets: tuple = struct.field(pytree_node=False, default=())
+    # 2-D (dr, dc) displacement per B2 offset and the static nonzero
+    # (k, j) pairs of b2_adj — consumed only by the packed lowered body
+    # (bitboard.supported_lowered / _patch_ok_bits)
+    b2_disp: Optional[tuple] = struct.field(pytree_node=False, default=None)
+    b2_pairs: tuple = struct.field(pytree_node=False, default=())
     b2_iters: int = struct.field(pytree_node=False, default=0)
     patch_exact: bool = struct.field(pytree_node=False, default=False)
     iface_ok: bool = struct.field(pytree_node=False, default=False)
@@ -267,11 +275,15 @@ def supports(graph: LatticeGraph, spec: Spec) -> bool:
 
 
 def body_for(bg: BoardGraph, spec: Spec, bits: Optional[bool] = None) -> str:
-    """The body ``run_board_chunk`` will execute: 'lowered' | 'bitboard'
-    | 'board'. Surgical stencils and interface recording need the masked
-    lowered body; plain rook grids keep the bit-identical rook bodies."""
+    """The body ``run_board_chunk`` will execute: 'lowered_bits' |
+    'lowered' | 'bitboard' | 'board'. Surgical stencils and interface
+    recording run the lowered family — packed (lowered_bits) where
+    ``bitboard.supported_lowered`` holds, the int8 stencil body
+    otherwise; plain rook grids keep the bit-identical rook bodies."""
     if bg.surgical or spec.record_interface:
-        return "lowered"
+        lbits_ok = bitboard.supported_lowered(bg, spec)
+        use_bits = lbits_ok if bits is None else bool(bits)
+        return "lowered_bits" if use_bits else "lowered"
     bits_ok = (bitboard.supported_pair(bg, spec)
                if spec.proposal == "pair" else bitboard.supported(bg, spec))
     use_bits = bits_ok if bits is None else bool(bits)
@@ -283,6 +295,10 @@ def make_board_graph(graph: LatticeGraph) -> BoardGraph:
     if st is None:
         raise ValueError(f"graph {graph.name!r} does not lower to a board "
                          "stencil (see lower.lower_to_stencil)")
+    b2_adj_np = np.asarray(st.b2_adj)
+    kk = len(st.b2_offsets)
+    b2_pairs = tuple((k, j) for k in range(kk) for j in range(kk)
+                     if bool(np.any(b2_adj_np[k] & (1 << j))))
     return BoardGraph(
         pop=jnp.asarray(st.pop),
         deg=jnp.asarray(st.deg),
@@ -301,6 +317,8 @@ def make_board_graph(graph: LatticeGraph) -> BoardGraph:
         surgical=st.surgical,
         real_nodes=st.n_real,
         b2_offsets=st.b2_offsets,
+        b2_disp=st.b2_disp,
+        b2_pairs=b2_pairs,
         b2_iters=st.b2_iters,
         patch_exact=st.patch_exact,
         iface_ok=st.iface_ok,
@@ -1203,6 +1221,105 @@ def _scan_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
     return loop_state, outs, logs, cts16
 
 
+def _record_stencil_bits(bg: BoardGraph, spec: Spec, state: BoardState,
+                         planes, cur_wait):
+    """``_record_stencil`` on packed planes: the cut-plane accumulation
+    moves to the caller's bit-sliced counters; the measurement-only
+    interface/abits outputs unpack the packed planes per RECORDED step
+    (exactly the int8 formulas, so bit-identical — and dead-code-
+    eliminated entirely when the chunk does not collect)."""
+    h, w = bg.h, bg.w
+    state, out, log = _record_common(state, planes["b_count"], cur_wait)
+    if spec.record_interface:
+        if not bg.iface_ok:
+            raise ValueError("record_interface needs wall planes the "
+                             "lowering could not encode (lower.stencil)")
+        cuts = [bitboard.unpack_canvas(planes[k], h, w).astype(bool)
+                for k in _CUT_KEYS]
+        out["slope"], out["angle"] = _interface_stencil(bg, cuts)
+    if spec.record_assignment_bits:
+        bits_per = max(1, (spec.n_districts - 1).bit_length())
+        if bg.n_real * bits_per > 32:
+            raise ValueError("record_assignment_bits needs n_nodes * "
+                             "ceil(log2(k)) <= 32")
+        ub = bitboard.unpack_canvas(state.board, h, w)
+        rank = jnp.cumsum(bg.node_mask.astype(jnp.uint32)) - 1
+        shifts = (rank * bits_per)[None, :]
+        out["abits"] = jnp.sum(
+            jnp.where(bg.node_mask[None],
+                      ub.astype(jnp.uint32) << shifts, 0),
+            axis=1, dtype=jnp.uint32)
+    return state, out, log
+
+
+def _scan_bits_lowered(bg: BoardGraph, spec: Spec, params: StepParams,
+                       loop_state: BoardState, chunk: int, collect: bool):
+    """The lowered-family chunk scan on the packed stencil backend
+    (kernel/bitboard.py's row-aligned canvas packing): the board rides
+    as one bit per cell (holes pack as 0 — every packed plane that
+    could read them is masked by exact adjacency/window planes), all
+    four forward cut planes accumulate in bit-sliced ripple-carry
+    counters, and the trajectory is bit-identical to ``_scan_stencil``
+    (same PRNG stream, same m-th-valid selection, same acceptance and
+    B2-contiguity arithmetic — tests/test_bitboard_lowered.py asserts
+    equality field-for-field)."""
+    c, n = loop_state.board.shape
+    h, w = bg.h, bg.w
+    count = loop_state.reject_count is not None
+
+    def body(carry, _):
+        state, ct_sl = carry
+        key, kprop, kacc, kwait = _split4(state.key)
+        state = state.replace(key=key)
+        planes = bitboard.planes_bits_lowered(
+            bg, spec, params, state.board, state.dist_pop, count=count)
+        cur_wait = _complete_wait(spec, state, planes["b_count"], kwait,
+                                  bg.n_real)
+        state, out, log = _record_stencil_bits(bg, spec, state, planes,
+                                               cur_wait)
+        ct_sl = tuple(bitboard.counter_add(sl, planes[k])
+                      for sl, k in zip(ct_sl, _CUT_KEYS))
+
+        # transition: single masked draw, flip the chosen cell's bit
+        u = _uniform(kprop)
+        flat, any_valid = bitboard.select_flat_lowered(
+            bg, planes["valid"], u)
+        pflat = bitboard.canvas_bit_index(flat, w)
+        d_from = bitboard.bit_at(state.board, pflat)
+        d_to = 1 - d_from
+        dd = bitboard.bit_at(planes["diff"][0], pflat)
+        for p in planes["diff"][1:]:
+            dd = dd + bitboard.bit_at(p, pflat)
+        dcut = bg.deg[flat] - 2 * dd
+        accept = _accept_decision(spec, params, state.move_clock, dcut,
+                                  any_valid, kacc)
+        # uniform pop (gated); bg.pop[0] may be a hole carrying pop 0
+        unit = bg.pop[bg.cell_of_node[0]]
+        popv = unit * accept.astype(jnp.int32)
+        sgn = jnp.where(d_from == 0, 1, -1)
+        dist_pop = state.dist_pop.at[:, 0].add(-popv * sgn)
+        dist_pop = dist_pop.at[:, 1].add(popv * sgn)
+        rej = (_reject_increment(planes["b_count"], planes["has_pop"],
+                                 accept, any_valid) if count else None)
+        state = _commit_transition(
+            state, params, bitboard.flip_bit(state.board, pflat, accept),
+            dist_pop, flat, d_to, dcut, accept, any_valid, rej=rej)
+        return (state, ct_sl), (out if collect else {}, log)
+
+    npw = h * bitboard.canvas_words(w)
+    slices = max(chunk.bit_length(), 1)
+    loop_state = loop_state.replace(
+        board=bitboard.pack_canvas(loop_state.board == 1, h, w))
+    ct0 = tuple(bitboard.counter_init(c, npw, slices) for _ in _CUT_KEYS)
+    (loop_state, ct_sl), (outs, logs) = jax.lax.scan(
+        body, (loop_state, ct0), None, length=chunk)
+    board = bitboard.unpack_canvas(loop_state.board, h, w)
+    loop_state = loop_state.replace(
+        board=jnp.where(bg.node_mask[None], board, jnp.int8(-1)))
+    cts = tuple(bitboard.counter_fold_canvas(sl, h, w) for sl in ct_sl)
+    return loop_state, outs, logs, cts
+
+
 def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
                loop_state: BoardState, chunk: int, collect: bool):
     """The chunk scan on the bit-board backend (kernel/bitboard.py): the
@@ -1354,8 +1471,10 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
     accumulators stay OUT of the scan carry: cut_times in int16 planes
     folded afterwards, flip bookkeeping replayed from the emitted log.
     ``bits`` overrides the bit-board dispatch (None = auto via
-    ``bitboard.supported``; False forces the int8 body — the two are
-    bit-identical, so the choice is purely a performance matter)."""
+    ``bitboard.supported`` / ``supported_pair`` /
+    ``supported_lowered``; False forces the int8 body of the active
+    family — packed and int8 bodies are bit-identical, so the choice is
+    purely a performance matter)."""
     if chunk > 32767:
         raise ValueError("chunk must be <= 32767 (int16 cut_times planes)")
     n = bg.n
@@ -1368,10 +1487,15 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
 
     lowered = bg.surgical or spec.record_interface
     if lowered:
-        if bits:
-            raise ValueError("bits=True: the lowered stencil body has no "
-                             "bit-board backend")
-        loop_state, outs, logs, cts16 = _scan_stencil(
+        lbits_ok = bitboard.supported_lowered(bg, spec)
+        use_lbits = lbits_ok if bits is None else bool(bits)
+        if use_lbits and not lbits_ok:
+            raise ValueError("bits=True: workload not supported by the "
+                             "packed lowered body (see "
+                             "bitboard.supported_lowered); bits=False "
+                             "selects the int8 'lowered' body")
+        scan = _scan_bits_lowered if use_lbits else _scan_stencil
+        loop_state, outs, logs, cts16 = scan(
             bg, spec, params, loop_state, chunk, collect)
         for k, ct in zip(("cut_times_e", "cut_times_se", "cut_times_s",
                           "cut_times_sw"), cts16):

@@ -1,9 +1,9 @@
 """Kernel-path resolution: which body will run a (graph, spec) workload.
 
-The dispatch order is lowered stencil -> bitboard -> int8 board ->
-general: ``kernel/board.py::supports`` decides whether the board family
-applies at all (via the lowering pass), and ``body_for`` picks the body
-within it. This module exposes that decision as a cheap, import-light
+The dispatch order is packed lowered stencil -> int8 lowered stencil ->
+bitboard -> int8 board -> general: ``kernel/board.py::supports`` decides
+whether the board family applies at all (via the lowering pass), and
+``body_for`` picks the body within it. This module exposes that decision as a cheap, import-light
 query for tagging — bench records, obs events, reports — so fallback
 regressions show up in scoreboards instead of silently running 50x
 slower. Kernel imports happen lazily inside the functions to keep
@@ -17,10 +17,12 @@ from .stencil import stencil_for
 
 # The dispatch order, fastest body first. Degradation (resilience.degrade)
 # walks this ladder downward when a body fails to compile or run — but
-# only between bodies that share a state layout: bitboard -> board is an
-# in-segment retry (both carry BoardState), everything else -> general
-# means a config-level restart on the general runner.
-DISPATCH_LADDER = ("lowered", "bitboard", "board", "general")
+# only between bodies that share a state layout: lowered_bits -> lowered
+# and bitboard -> board are in-segment retries (each pair carries the
+# same BoardState), everything else -> general means a config-level
+# restart on the general runner.
+DISPATCH_LADDER = ("lowered_bits", "lowered", "bitboard", "board",
+                   "general")
 
 
 def next_path(path: str) -> str | None:
@@ -34,16 +36,20 @@ def next_path(path: str) -> str | None:
 
 
 def kernel_path_for(graph: LatticeGraph, spec) -> str:
-    """'lowered' | 'bitboard' | 'board' | 'general' — the body the
-    runners will select for this workload (sampling/board_runner.py +
-    kernel/board.py::run_board_chunk dispatch, bits=None auto)."""
+    """'lowered_bits' | 'lowered' | 'bitboard' | 'board' | 'general' —
+    the body the runners will select for this workload
+    (sampling/board_runner.py + kernel/board.py::run_board_chunk
+    dispatch, bits=None auto)."""
     from ..kernel import bitboard, board
 
     if not board.supports(graph, spec):
         return "general"
     st = stencil_for(graph)
     if st.surgical or spec.record_interface:
-        return "lowered"
+        # the packed-body gate duck-types on StencilSpec (uniform_pop,
+        # b2_disp) just like the rook gates below
+        return ("lowered_bits" if bitboard.supported_lowered(st, spec)
+                else "lowered")
     # bitboard gates duck-type on (uniform_pop, w, n, surgical), which
     # StencilSpec provides — no BoardGraph construction needed here
     bits_ok = (bitboard.supported_pair(st, spec)

@@ -91,6 +91,11 @@ class StencilSpec:
     b2_adj: np.ndarray            # int32[K, N] bitset: offsets adjacent
                                   #   to cell+offset_k within patch(cell)
     nbr_bits: np.ndarray          # int32[N] bitset of direct-nbr offsets
+    b2_disp: Optional[tuple]      # K (dr, dc) label displacements, one per
+                                  #   b2_offset; None when a flat offset is
+                                  #   realized by two distinct (dr, dc)
+                                  #   pairs (only possible at w <= 4) —
+                                  #   packed bodies need the 2-D form
     b2_iters: int                 # propagation rounds (max patch size - 1)
     patch_exact: bool             # B2 tables == graph patch tables
     # --- canonical edge mapping (cut_times in LatticeGraph edge order) ---
@@ -215,9 +220,21 @@ def lower_to_stencil(graph: LatticeGraph) -> Optional[StencilSpec]:
     b2_adj = np.zeros((k, n), np.int32)
     nbr_bits = np.zeros(n, np.int32)
     nbrsets = [set(nl) for nl in nbr_lists]
+    # 2-D displacement behind each flat offset: packed (bit-board) bodies
+    # shift rows and columns separately, so they need (dr, dc), not dr*w+dc.
+    # A flat offset realized by two distinct (dr, dc) pairs (needs
+    # |dc|, |dc'| <= 2 with (dr - dr') * w == dc' - dc, i.e. w <= 4) makes
+    # the 2-D form ill-defined — record None and let dispatch skip packing.
+    disp_of_off: dict[int, tuple] = {}
+    disp_ambiguous = False
     for v, pl in enumerate(patches):
         cv = int(cell_of_node[v])
         slot = {u: off_idx[int(cell_of_node[u]) - cv] for u in pl}
+        for u in pl:
+            o = int(cell_of_node[u]) - cv
+            d2 = (int(xs[u] - xs[v]), int(ys[u] - ys[v]))
+            if disp_of_off.setdefault(o, d2) != d2:
+                disp_ambiguous = True
         for u, ku in slot.items():
             b2_in[ku, cv] = True
             word = 0
@@ -228,6 +245,8 @@ def lower_to_stencil(graph: LatticeGraph) -> Optional[StencilSpec]:
             b2_adj[ku, cv] = word
         for u in nbr_lists[v]:
             nbr_bits[cv] |= 1 << slot[u]
+    b2_disp = (None if disp_ambiguous
+               else tuple(disp_of_off[o] for o in b2_offsets))
     b2_iters = max(max_patch - 1, 0)
     patch_exact = bool(graph.patch_ok) and all(
         set(np.asarray(graph.patch_nodes[v, :graph.patch_size[v]]).tolist())
@@ -266,7 +285,8 @@ def lower_to_stencil(graph: LatticeGraph) -> Optional[StencilSpec]:
         uniform_pop=bool(pops.size) and bool((pops == pops[0]).all()),
         node_mask=node_mask, cell_of_node=cell_of_node, pop=pop, deg=deg,
         adj=adj, b2_offsets=b2_offsets, b2_in=b2_in, b2_adj=b2_adj,
-        nbr_bits=nbr_bits, b2_iters=b2_iters, patch_exact=patch_exact,
+        nbr_bits=nbr_bits, b2_disp=b2_disp, b2_iters=b2_iters,
+        patch_exact=patch_exact,
         edge_plane=edge_plane, edge_cell=edge_cell,
         iface_ok=iface_ok, iface_key=iface_key, iface_decode=iface_decode,
         center=(float(graph.center[0]), float(graph.center[1])))

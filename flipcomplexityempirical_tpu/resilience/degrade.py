@@ -1,21 +1,22 @@
 """Graceful kernel degradation: fall down the dispatch ladder, loudly.
 
-The ladder (lower.dispatch) is lowered -> bitboard -> int8 board ->
-general. When a body fails to compile or trips an XLA runtime error
-mid-segment, the runners retry the same segment on the next body down
-instead of surfacing the error — emitting a ``kernel_path_degraded``
-event and appending to the process-wide ``DEGRADATIONS`` audit trail,
-which bench.py folds into its record (``degraded``/``degradations``)
-so ``tools/bench_compare.py`` can refuse to gate a record whose winning
-body was reached by falling off the intended path.
+The ladder (lower.dispatch) is packed lowered -> int8 lowered ->
+bitboard -> int8 board -> general. When a body fails to compile or
+trips an XLA runtime error mid-segment, the runners retry the same
+segment on the next body down instead of surfacing the error — emitting
+a ``kernel_path_degraded`` event and appending to the process-wide
+``DEGRADATIONS`` audit trail, which bench.py folds into its record
+(``degraded``/``degradations``) so ``tools/bench_compare.py`` can
+refuse to gate a record whose winning body was reached by falling off
+the intended path.
 
-Within the board family only bitboard -> int8 board is retryable
-*in-segment* (both bodies advance the same BoardState; the bit-packing
-happens inside ``run_board_chunk``). A lowered or int8-board failure
-raises ``KernelPathError`` instead, and the driver reruns the config on
-the general gather kernel from its last compatible checkpoint (board
-and general states are different pytrees, so there is no mid-segment
-hop between them).
+Within the board family, lowered_bits -> lowered and bitboard -> int8
+board are retryable *in-segment* (each pair advances the same
+BoardState; the bit-packing happens inside ``run_board_chunk``). A
+lowered or int8-board failure raises ``KernelPathError`` instead, and
+the driver reruns the config on the general gather kernel from its last
+compatible checkpoint (board and general states are different pytrees,
+so there is no mid-segment hop between them).
 """
 
 from __future__ import annotations
@@ -48,11 +49,13 @@ def is_kernel_error(exc: BaseException) -> bool:
 def next_board_body(path: str):
     """The next body down *within the board family*, or None when the
     fall must leave the family (KernelPathError -> general rerun).
-    Only bitboard -> board shares a state layout; see module doc."""
+    Only lowered_bits -> lowered and bitboard -> board share a state
+    layout; see module doc."""
     from ..lower.dispatch import next_path  # import-light until needed
 
     nxt = next_path(path)
-    return nxt if (path, nxt) == ("bitboard", "board") else None
+    return (nxt if (path, nxt) in (("lowered_bits", "lowered"),
+                                   ("bitboard", "board")) else None)
 
 
 def describe_error(exc: BaseException) -> str:

@@ -228,17 +228,18 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                 # no lower body shares this state layout — hand the
                 # ladder back to the driver (general-kernel rerun)
                 raise KernelPathError(path, e) from e
-            # bitboard -> int8 board: same BoardState, the bit-packing
-            # lives inside run_board_chunk, so the SAME segment retries
-            # on the next body down with nothing converted
+            # lowered_bits -> lowered / bitboard -> int8 board: same
+            # BoardState, the bit-packing lives inside run_board_chunk,
+            # so the SAME segment retries on the next body down with
+            # nothing converted. Loop back (``done`` unchanged) rather
+            # than retrying inline: a persistent failure then keeps
+            # falling through the ladder instead of surfacing on the
+            # retry.
             rdegrade.record_degradation(
                 rec, path, nxt, reason=rdegrade.describe_error(e),
                 done=done)
             path, bits = nxt, False
-            state, outs = kboard.run_board_chunk(bg, spec, params, state,
-                                                 this,
-                                                 collect=record_history,
-                                                 bits=bits)
+            continue
         if rec:
             watch.poll(rec, chunk=this,
                        cost=lambda: obs.aot_cost(

@@ -1,0 +1,297 @@
+"""Packed lowered-stencil backend verification (kernel/bitboard.py's
+lowered family, ISSUE 8).
+
+Same promise as the rook bit-board: BIT-IDENTICAL trajectories to the
+int8 lowered body — same PRNG stream, same m-th-valid selection, same
+acceptance arithmetic, same cut_times planes and interface metrics — so
+the primary tests run the same chunk through both bodies and assert
+every state field and history row equal, on the paper's own workloads
+(sec11, queen, Frankengraph). Because the int8 lowered body is already
+proven against the general kernel and the compat/ (gerrychain-
+semantics) oracle (tests/test_lower.py), bit-identity transfers every
+one of those guarantees to the packed body; the packed body also gets
+its own exact-enumeration chi2 bar in test_lower.py. Plus unit tests of
+the canvas packing/shift primitives and the supported_lowered gate, and
+the `make bitpack-check` CI wrapper.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.kernel import bitboard as bb
+from flipcomplexityempirical_tpu.kernel import board as kb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def surgical_grid(h=5, w=7):
+    """test_lower.py's surgery menu: two holes, two diagonal bypasses."""
+    return fce.graphs.square_grid(
+        h, w, remove_nodes=[(0, 0), (2, 3)],
+        extra_edges=[((0, 1), (1, 0)), ((3, 4), (4, 5))])
+
+
+DEFAULT_KW = dict(n_districts=2, proposal="bi", contiguity="patch",
+                  invalid="repropose", accept="cut",
+                  parity_metrics=True, geom_waits=True)
+
+
+def make_spec(**overrides):
+    kw = dict(DEFAULT_KW)
+    kw.update(overrides)
+    return fce.Spec(**kw)
+
+
+# ---------------------------------------------------------------------------
+# canvas packing primitives
+# ---------------------------------------------------------------------------
+
+def test_pack_canvas_roundtrip(rng):
+    for h, w in ((5, 7), (3, 32), (4, 40), (2, 33)):
+        plane = rng.integers(0, 2, size=(3, h * w)).astype(np.int8)
+        words = bb.pack_canvas(jnp.asarray(plane), h, w)
+        assert words.shape == (3, h * bb.canvas_words(w))
+        back = bb.unpack_canvas(words, h, w)
+        np.testing.assert_array_equal(np.asarray(back), plane,
+                                      err_msg=f"{h}x{w}")
+
+
+def test_canvas_bit_index():
+    for h, w in ((5, 7), (4, 40)):
+        wpr32 = bb.canvas_words(w) * 32
+        for flat in (0, 1, w - 1, w, h * w - 1):
+            r, c = divmod(flat, w)
+            got = int(bb.canvas_bit_index(jnp.asarray(flat), w))
+            assert got == r * wpr32 + c, (h, w, flat)
+
+
+def test_shift_canvas_matches_numpy(rng):
+    """shift_canvas(dr, dc) reads the (r+dr, c+dc) neighbor wherever the
+    destination cell's masked read is in-frame; out-of-frame garbage is
+    the caller's to mask, so the check ANDs with the in-frame window
+    exactly as the kernel ANDs with adj/b2_in planes."""
+    h, w = 6, 40
+    plane = rng.integers(0, 2, size=(2, h * w)).astype(np.int8)
+    arr = plane.reshape(2, h, w)
+    words = bb.pack_canvas(jnp.asarray(plane), h, w)
+    for dr, dc in ((0, 1), (1, 1), (1, 0), (1, -1), (0, -1), (-1, -1),
+                   (-1, 0), (-1, 1), (2, -2), (-2, 2)):
+        got = np.asarray(bb.unpack_canvas(
+            bb.shift_canvas(words, dr, dc, w), h, w)).reshape(2, h, w)
+        want = np.zeros_like(arr)
+        rs = slice(max(0, -dr), min(h, h - dr))
+        cs = slice(max(0, -dc), min(w, w - dc))
+        want[:, rs, cs] = arr[:, slice(max(0, dr), min(h, h + dr)),
+                              slice(max(0, dc), min(w, w + dc))]
+        inframe = np.zeros((h, w), dtype=bool)
+        inframe[rs, cs] = True
+        np.testing.assert_array_equal(got * inframe, want * inframe,
+                                      err_msg=f"({dr},{dc})")
+
+
+def test_counter_fold_canvas(rng):
+    h, w, c, t = 4, 40, 3, 37
+    planes = rng.integers(0, 2, size=(t, c, h * w)).astype(np.int8)
+    npw = h * bb.canvas_words(w)
+    slices = bb.counter_init(c, npw, t.bit_length())
+    for r in range(t):
+        slices = bb.counter_add(
+            slices, bb.pack_canvas(jnp.asarray(planes[r]), h, w))
+    got = bb.counter_fold_canvas(slices, h, w)
+    np.testing.assert_array_equal(np.asarray(got), planes.sum(0))
+
+
+# ---------------------------------------------------------------------------
+# the supported_lowered gate
+# ---------------------------------------------------------------------------
+
+def test_supported_lowered_gate():
+    g = surgical_grid()
+    bg = kb.make_board_graph(g)
+    assert bb.supported_lowered(bg, make_spec())
+    assert bb.supported_lowered(bg, make_spec(contiguity="none"))
+    assert bb.supported_lowered(bg, make_spec(accept="always"))
+    # off-menu spec axes stand down to the int8 body
+    assert not bb.supported_lowered(bg, make_spec(accept="corrected"))
+    assert not bb.supported_lowered(
+        bg, make_spec(n_districts=3, proposal="pair"))
+    # w=4: one flat B2 offset realized by two (dr, dc) pairs => b2_disp
+    # None => patch contiguity cannot run packed (but "none" still can)
+    g4 = fce.graphs.square_grid(3, 4, remove_nodes=[(0, 0)],
+                                extra_edges=[((0, 1), (1, 0))])
+    bg4 = kb.make_board_graph(g4)
+    assert bg4.b2_disp is None
+    assert not bb.supported_lowered(bg4, make_spec())
+    assert bb.supported_lowered(bg4, make_spec(contiguity="none"))
+    assert kb.body_for(bg4, make_spec()) == "lowered"
+
+
+def test_bits_true_on_unsupported_lowered_raises():
+    """The stale 'no bit-board backend' rejection is gone: bits=True on
+    a SUPPORTED lowered workload dispatches (the parity tests), and on
+    an unsupported one the refusal names the gate and the opt-out."""
+    g4 = fce.graphs.square_grid(3, 4, remove_nodes=[(0, 0)],
+                                extra_edges=[((0, 1), (1, 0))])
+    spec = make_spec()
+    plan = fce.graphs.stripes_plan(g4, 2)
+    bg, st, params = fce.sampling.init_board(
+        g4, plan, n_chains=2, seed=0, spec=spec, base=1.3, pop_tol=0.5)
+    with pytest.raises(ValueError, match="supported_lowered"):
+        kb.run_board_chunk(bg, spec, params, st, 5, bits=True)
+    # the explicit opt-out says which body it picked
+    bgs = kb.make_board_graph(surgical_grid())
+    assert kb.body_for(bgs, spec, bits=False) == "lowered"
+    assert kb.body_for(bgs, spec, bits=True) == "lowered_bits"
+    assert kb.body_for(bgs, spec) == "lowered_bits"
+
+
+# ---------------------------------------------------------------------------
+# packed B2 contiguity == int8 _stencil_patch_ok
+# ---------------------------------------------------------------------------
+
+def test_patch_ok_bits_matches_int8(rng):
+    for g in (surgical_grid(), fce.graphs.square_grid(6, queen=True)):
+        bg = kb.make_board_graph(g)
+        cell = np.asarray(bg.cell_of_node)
+        for _ in range(8):
+            a = rng.integers(0, 2, g.n_nodes).astype(np.int8)
+            board = np.full(bg.n, -1, np.int8)
+            board[cell] = a
+            want = np.asarray(kb._stencil_patch_ok(
+                bg, jnp.asarray(board[None])))
+            bw = bb.pack_canvas(
+                jnp.asarray((board[None] == 1).astype(np.int8)),
+                bg.h, bg.w)
+            got = np.asarray(bb.unpack_canvas(
+                bb._patch_ok_bits(bg, bw), bg.h, bg.w)).astype(bool)
+            # int8 planes are only meaningful on real cells; the packed
+            # verdict is consumed under the same adj masks
+            mask = np.asarray(bg.node_mask).astype(bool)
+            np.testing.assert_array_equal(got[:, mask], want[:, mask],
+                                          err_msg=g.name)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: lowered_bits vs the int8 lowered body
+# ---------------------------------------------------------------------------
+
+def assert_chunks_equal(got, want):
+    """Field-for-field equality of two (state, outs) chunk results,
+    including None bookkeeping fields."""
+    got_state, got_outs = got
+    want_state, want_outs = want
+    assert set(got_outs) == set(want_outs)
+    for key in want_outs:
+        np.testing.assert_array_equal(np.asarray(got_outs[key]),
+                                      np.asarray(want_outs[key]),
+                                      err_msg=key)
+    for f in want_state.__dataclass_fields__:
+        a, b = getattr(got_state, f), getattr(want_state, f)
+        if b is None:
+            assert a is None, f
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f)
+
+
+def _parity(g, plan, spec, chains=6, steps=75, seed=3):
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=seed, spec=spec, base=1.3,
+        pop_tol=0.3)
+    assert bb.supported_lowered(bg, spec)
+    assert kb.body_for(bg, spec) == "lowered_bits"
+    assert_chunks_equal(
+        kb.run_board_chunk(bg, spec, params, st, steps),
+        kb.run_board_chunk(bg, spec, params, st, steps, bits=False))
+
+
+@pytest.mark.parametrize("spec_kw", [
+    {},
+    dict(accept="always"),
+    dict(contiguity="none"),
+])
+def test_bit_identity_surgical(spec_kw):
+    """Tier-1 parity on the small surgical grid (holes + diagonals):
+    the auto-dispatched packed body equals the int8 body forced via
+    bits=False, field for field."""
+    g = surgical_grid()
+    _parity(g, fce.graphs.stripes_plan(g, 2), make_spec(**spec_kw))
+
+
+def test_bit_identity_assignment_bits():
+    """record_assignment_bits (n_real <= 32): the per-yield packed
+    assignments must match exactly too."""
+    g = fce.graphs.square_grid(4, 7, remove_nodes=[(0, 0), (2, 3)],
+                               extra_edges=[((0, 1), (1, 0)),
+                                            ((2, 4), (3, 5))])
+    spec = make_spec(record_assignment_bits=True, parity_metrics=False,
+                     geom_waits=False)
+    _parity(g, fce.graphs.stripes_plan(g, 2), spec)
+
+
+@pytest.mark.parametrize("record_interface", [False, True])
+@pytest.mark.slow
+def test_bit_identity_sec11(record_interface):
+    """The paper's corner-surgery grid, both record_interface settings:
+    trajectories, cut_times planes, and the keyed min-reduce interface
+    metrics all bit-identical."""
+    g = fce.graphs.grid_sec11()
+    plan = fce.graphs.sec11_plan(g, alignment=0)
+    _parity(g, plan, make_spec(record_interface=record_interface),
+            chains=4, steps=40)
+
+
+@pytest.mark.slow
+def test_bit_identity_queen():
+    g = fce.graphs.square_grid(8, queen=True)
+    _parity(g, fce.graphs.stripes_plan(g, 2), make_spec())
+
+
+@pytest.mark.slow
+def test_bit_identity_frankengraph():
+    g = fce.graphs.frankengraph()
+    _parity(g, fce.graphs.frank_plan(g, alignment=0), make_spec(),
+            chains=4, steps=40)
+
+
+@pytest.mark.slow
+def test_bit_identity_full_run_sec11():
+    """Multi-chunk run through the runner (scan boundaries, history
+    assembly, cut_times accumulation across chunks): edge_cut_times and
+    every history row agree."""
+    g = fce.graphs.grid_sec11()
+    plan = fce.graphs.sec11_plan(g, alignment=0)
+    spec = make_spec()
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=4, seed=7, spec=spec, base=1.4, pop_tol=0.3)
+    res_p = fce.sampling.run_board(bg, spec, params, st, n_steps=121,
+                                   chunk=40)
+    res_i = fce.sampling.run_board(bg, spec, params, st, n_steps=121,
+                                   chunk=40, bits=False)
+    for key in res_i.history:
+        np.testing.assert_array_equal(
+            np.asarray(res_p.history[key]), np.asarray(res_i.history[key]),
+            err_msg=key)
+    np.testing.assert_array_equal(kb.edge_cut_times(g, res_p.state),
+                                  kb.edge_cut_times(g, res_i.state))
+
+
+# ---------------------------------------------------------------------------
+# the CI gate wrapper
+# ---------------------------------------------------------------------------
+
+def test_bitpack_check_gate_passes():
+    """make bitpack-check: the fast lowered_bits-vs-lowered parity smoke
+    as one script, tier-1 so the bit-identity contract gates every
+    commit."""
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "bitpack_check.sh")],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "bitpack-check: OK" in r.stdout

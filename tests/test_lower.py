@@ -141,17 +141,26 @@ def test_lower_rejections():
 
 def test_kernel_path_routing():
     spec = fce.Spec(contiguity="patch")
-    assert lower.kernel_path_for(fce.graphs.grid_sec11(), spec) == "lowered"
-    assert lower.kernel_path_for(fce.graphs.frankengraph(), spec) == "lowered"
+    assert lower.kernel_path_for(fce.graphs.grid_sec11(),
+                                 spec) == "lowered_bits"
+    assert lower.kernel_path_for(fce.graphs.frankengraph(),
+                                 spec) == "lowered_bits"
     assert lower.kernel_path_for(
-        fce.graphs.square_grid(6, queen=True), spec) == "lowered"
+        fce.graphs.square_grid(6, queen=True), spec) == "lowered_bits"
     assert lower.kernel_path_for(fce.graphs.square_grid(6, 6), spec) == "board"
     assert lower.kernel_path_for(fce.graphs.hex_lattice(4, 4),
                                  spec) == "general"
+    # a w=4 canvas realizes one flat B2 offset by two distinct (dr, dc)
+    # pairs => b2_disp is None and the packed body stands down to the
+    # int8 lowered body (bitboard.supported_lowered)
+    g4 = fce.graphs.square_grid(3, 4, remove_nodes=[(0, 0)],
+                                extra_edges=[((0, 1), (1, 0))])
+    assert lower.kernel_path_for(g4, spec) == "lowered"
     # record_interface: lowered where wall planes encode, general where
     # the graph has no walls at all
     ispec = fce.Spec(record_interface=True)
-    assert lower.kernel_path_for(fce.graphs.grid_sec11(), ispec) == "lowered"
+    assert lower.kernel_path_for(fce.graphs.grid_sec11(),
+                                 ispec) == "lowered_bits"
     assert lower.kernel_path_for(fce.graphs.square_grid(6, 6),
                                  ispec) == "general"
     # dispatch agrees with the body the runner will build
@@ -220,7 +229,7 @@ def test_lowered_run_invariants():
     plan = fce.graphs.stripes_plan(g, 2)
     bg, st, params = fce.sampling.init_board(
         g, plan, n_chains=8, seed=9, spec=spec, base=1.3, pop_tol=0.3)
-    assert kb.body_for(bg, spec) == "lowered"
+    assert kb.body_for(bg, spec) == "lowered_bits"
     res = fce.sampling.run_board(bg, spec, params, st, n_steps=301, chunk=100)
     s = res.host_state()
     board = np.asarray(s.board)
@@ -322,7 +331,7 @@ def test_lowered_matches_general_trajectory(graph):
 
     bg, st_b, par_b = fce.sampling.init_board(
         g, plan, n_chains=chains, seed=17, spec=spec, base=base, pop_tol=tol)
-    assert kb.body_for(bg, spec) == "lowered"
+    assert kb.body_for(bg, spec) == "lowered_bits"
     res_b = fce.sampling.run_board(bg, spec, par_b, st_b, n_steps=steps)
 
     sub = slice(burn, None, 20)
@@ -479,6 +488,8 @@ def test_lowered_matches_exact_stationary_chi2():
 
     spec = fce.Spec(contiguity="patch", record_assignment_bits=True,
                     geom_waits=False, parity_metrics=False)
+    # w=4: b2_disp is ambiguous, so this stays on the int8 lowered body
+    # (the packed rerun is test_lowered_bits_matches_exact_stationary_chi2)
     assert lower.kernel_path_for(g, spec) == "lowered"
     plan = fce.graphs.stripes_plan(g, 2)
     chains, steps, burn, stride = 48, 12000, 2000, 25
@@ -527,3 +538,40 @@ def test_lowered_matches_exact_stationary_chi2():
                                 << np.arange(g.n_nodes)).sum()))
     _occupancy_checks(np.array(masks_c), states, pi, cuts, "oracle",
                       tv_tol=0.15, cut_tol=0.05)
+
+
+@pytest.mark.slow
+def test_lowered_bits_matches_exact_stationary_chi2():
+    """The exact-enumeration bar rerun on the PACKED lowered body
+    (ISSUE 8 satellite): the 3x4 miniature widened to 3x5 so b2_disp is
+    unambiguous and dispatch takes the lowered_bits rung. Same gates —
+    chi-square occupancy over thinned samples plus TV/E[cut] against
+    the power-iterated stationary distribution. (Bit-identity against
+    the int8 body is tests/test_bitboard_lowered.py; this proves the
+    packed body is ALSO exactly right in distribution on its own.)"""
+    base = 1.5
+    g = fce.graphs.square_grid(3, 5, remove_nodes=[(0, 0)],
+                               extra_edges=[((0, 1), (1, 0))])
+    nbrmask = _nbr_bitmasks(g)
+    states = _enumerate_states(g, nbrmask)
+    P, cuts = _build_transition(states, g, base)
+    pi = _stationary(P)
+
+    spec = fce.Spec(contiguity="patch", record_assignment_bits=True,
+                    geom_waits=False, parity_metrics=False)
+    assert lower.kernel_path_for(g, spec) == "lowered_bits"
+    plan = fce.graphs.stripes_plan(g, 2)
+    chains, steps, burn, stride = 48, 12000, 2000, 25
+
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=chains, seed=13, spec=spec, base=base,
+        pop_tol=CHI_EPS)
+    assert kb.body_for(bg, spec) == "lowered_bits"
+    res_b = fce.sampling.run_board(bg, spec, params, st, n_steps=steps)
+    rank = np.cumsum(np.asarray(bg.node_mask)) - 1
+    rank_of_node = rank[np.asarray(bg.cell_of_node)]
+    abits = np.asarray(res_b.history["abits"][:, burn::stride])
+    per_node = (abits[..., None] >> rank_of_node) & 1
+    masks_b = (per_node << np.arange(g.n_nodes)).sum(axis=-1).ravel()
+    _occupancy_checks(masks_b, states, pi, cuts, "lowered_bits",
+                      chi2_tol=2.0)

@@ -486,20 +486,24 @@ def test_reject_breakdown_general_path(tmp_path):
 
 
 def test_reject_breakdown_lowered_path(tmp_path):
-    """The queen-adjacency grid takes the surgical-stencil LOWERED board
-    body; its reject counters obey the same sum-to-proposals invariant
-    (board proposals = chains * steps, one draw per step)."""
+    """The queen-adjacency grid takes the surgical-stencil lowered
+    family (bit-packed since round 8); its reject counters obey the same
+    sum-to-proposals invariant (board proposals = chains * steps, one
+    draw per step)."""
     from flipcomplexityempirical_tpu.kernel import board as kboard
     g = fce.graphs.square_grid(8, 8, queen=True)
     plan = fce.graphs.stripes_plan(g, 2)
     spec = fce.Spec(contiguity="patch")
     bg, st, params = fce.sampling.init_board(
         g, plan, n_chains=4, seed=0, spec=spec, base=1.3, pop_tol=0.4)
-    assert kboard.body_for(bg, spec) == "lowered"
+    assert kboard.body_for(bg, spec) == "lowered_bits"
     path = str(tmp_path / "low.jsonl")
+    # bits=False: the reject stream is body-independent (the packed body
+    # is bit-identical, gated by tests/test_bitboard_lowered.py) and the
+    # int8 body compiles well inside the fast-tier budget
     with obs.Recorder(path=path) as rec:
         res = fce.sampling.run_board(bg, spec, params, st, n_steps=61,
-                                     chunk=20, recorder=rec)
+                                     chunk=20, bits=False, recorder=rec)
     assert res.state.reject_count is None
     events = read_events(path)
     assert_stream_valid(events)

@@ -221,12 +221,15 @@ def test_checkpoint_identity_error_names_both_sides():
 
 def test_dispatch_ladder_and_board_fallback():
     from flipcomplexityempirical_tpu.lower import dispatch
-    assert dispatch.DISPATCH_LADDER == ("lowered", "bitboard", "board",
-                                        "general")
+    assert dispatch.DISPATCH_LADDER == ("lowered_bits", "lowered",
+                                        "bitboard", "board", "general")
+    assert dispatch.next_path("lowered_bits") == "lowered"
     assert dispatch.next_path("lowered") == "bitboard"
     assert dispatch.next_path("general") is None
     assert dispatch.next_path("pallas") is None
-    # only the state-compatible bitboard -> board hop stays in-segment
+    # only the state-compatible lowered_bits -> lowered and
+    # bitboard -> board hops stay in-segment
+    assert rz.next_board_body("lowered_bits") == "lowered"
     assert rz.next_board_body("bitboard") == "board"
     assert rz.next_board_body("lowered") is None
     assert rz.next_board_body("board") is None
@@ -558,9 +561,10 @@ def test_poison_config_quarantined_with_nonzero_exit(tmp_path):
 # ---- graceful kernel degradation ---------------------------------------
 
 def test_compile_fault_degrades_to_general(tmp_path):
-    """A persistent kernel failure on the lowered body reruns the
-    config on the general gather kernel — completing with a
-    kernel_path_degraded event instead of crashing."""
+    """A persistent kernel failure walks the WHOLE ladder: the packed
+    lowered_bits body falls in-segment to the int8 lowered body, which
+    then hands the config to the general gather kernel — completing
+    with two kernel_path_degraded events instead of crashing."""
     cfg = _ckpt_cfg(total_steps=40, checkpoint_every=0)
     rfaults.install_from_spec("compile:always")
     ev = str(tmp_path / "ev.jsonl")
@@ -570,9 +574,26 @@ def test_compile_fault_degrades_to_general(tmp_path):
     rec.close()
     assert data["history"]["cut_count"].shape == (2, 40)
     deg = [e for e in _events(ev) if e["event"] == "kernel_path_degraded"]
-    assert deg and deg[0]["from_path"] == "lowered"
-    assert deg[0]["to_path"] == "general"
+    assert [(d["from_path"], d["to_path"]) for d in deg] == [
+        ("lowered_bits", "lowered"), ("lowered", "general")]
     assert len(rz.DEGRADATIONS) > mark   # audit trail for bench records
+
+
+def test_compile_fault_once_degrades_in_segment(tmp_path):
+    """A transient kernel failure on the packed lowered body retries
+    the SAME segment on the int8 lowered body (shared BoardState — no
+    general rerun, no state conversion) and the run completes with
+    exactly one kernel_path_degraded event."""
+    cfg = _ckpt_cfg(total_steps=40, checkpoint_every=0)
+    rfaults.install_from_spec("compile:once")
+    ev = str(tmp_path / "ev.jsonl")
+    rec = obs.from_spec(ev)
+    data = ex.run_config(cfg, str(tmp_path / "o"), recorder=rec)
+    rec.close()
+    assert data["history"]["cut_count"].shape == (2, 40)
+    deg = [e for e in _events(ev) if e["event"] == "kernel_path_degraded"]
+    assert [(d["from_path"], d["to_path"]) for d in deg] == [
+        ("lowered_bits", "lowered")]
 
 
 def test_bench_compare_refuses_degraded_records(tmp_path):

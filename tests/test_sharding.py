@@ -375,9 +375,12 @@ def test_sharded_board_step_dispatches_bitboard(mesh8):
 
 
 def test_sharded_board_step_dispatches_lowered(mesh8):
-    """The queen-adjacency (surgical) grid takes the LOWERED stencil body
-    through the sharded step — the treedef with cut_times_se/sw leaves
-    that a fixed placeholder in_specs struct used to reject."""
+    """The queen-adjacency (surgical) grid takes the PACKED lowered
+    stencil body through the sharded step — the treedef with
+    cut_times_se/sw leaves that a fixed placeholder in_specs struct
+    used to reject, now on the lowered_bits rung (ISSUE 8). bits=True
+    builds first-class; bits=False opts down to the int8 lowered body;
+    a workload the packed gate rejects still refuses bits=True loudly."""
     g = fce.graphs.square_grid(8, 8, queen=True)
     plan = fce.graphs.stripes_plan(g, 2)
     spec = fce.Spec(contiguity="patch")
@@ -386,13 +389,41 @@ def test_sharded_board_step_dispatches_lowered(mesh8):
     st = distribute.shard_chain_batch(mesh8, st)
     params = distribute.shard_chain_batch(mesh8, params)
     step = distribute.make_board_train_step(bg, spec, mesh8, inner_steps=3)
-    assert step.kernel_path == "lowered"
-    with pytest.raises(ValueError, match="no bit-board backend"):
-        distribute.make_board_train_step(bg, spec, mesh8, inner_steps=3,
+    assert step.kernel_path == "lowered_bits"
+    # b2_disp-ambiguous miniature: the packed gate rejects bits=True
+    # loudly (before any compile)
+    g4 = fce.graphs.square_grid(3, 4, remove_nodes=[(0, 0)],
+                                extra_edges=[((0, 1), (1, 0))])
+    plan4 = fce.graphs.stripes_plan(g4, 2)
+    bg4, _, _ = fce.sampling.init_board(
+        g4, plan4, n_chains=8, seed=0, spec=spec, base=1.3, pop_tol=0.5)
+    with pytest.raises(ValueError, match="supported_lowered"):
+        distribute.make_board_train_step(bg4, spec, mesh8, inner_steps=3,
                                          bits=True)
     _, st2, info = step(jax.random.PRNGKey(0), params, st)
     assert int(np.asarray(jax.device_get(st2.t_yield)).sum()) == 8 * 3
     assert int(info["accepts"]) > 0
+
+
+@pytest.mark.slow
+def test_sharded_board_step_lowered_bits_forcing(mesh8):
+    """Explicit bits= on the lowered family through the sharded step:
+    True builds the packed body first-class, False opts down to the
+    int8 lowered body, and both advance the same BoardState."""
+    g = fce.graphs.square_grid(8, 8, queen=True)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    bg, st, params = fce.sampling.init_board(
+        g, plan, n_chains=8, seed=0, spec=spec, base=1.3, pop_tol=0.4)
+    st = distribute.shard_chain_batch(mesh8, st)
+    params = distribute.shard_chain_batch(mesh8, params)
+    for bits, want in ((True, "lowered_bits"), (False, "lowered")):
+        step = distribute.make_board_train_step(
+            bg, spec, mesh8, inner_steps=3, bits=bits)
+        assert step.kernel_path == want
+        _, st2, info = step(jax.random.PRNGKey(0), params, st)
+        assert int(np.asarray(jax.device_get(st2.t_yield)).sum()) == 8 * 3
+        assert int(info["accepts"]) > 0
 
 
 # ---------------------------------------------------------------------------

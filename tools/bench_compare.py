@@ -43,9 +43,14 @@ import sys
 
 def _config_name(d: dict) -> str:
     """Stable name for a bench config line, from the fields that define
-    the workload (not the measurement)."""
+    the workload (not the measurement). kernel_path and graph keep the
+    per-path metrics distinct: a sec11 run on lowered_bits and a square
+    run on bitboard both say path=board, and both reuse grid/chains
+    defaults — without these keys their throughputs would collide into
+    one gated metric."""
     parts = []
-    for k in ("path", "body", "grid", "k", "chains", "device"):
+    for k in ("path", "kernel_path", "body", "graph", "grid", "k",
+              "chains", "device"):
         if k in d:
             parts.append(f"{k}={d[k]}")
     return "config[" + ",".join(parts) + "]"

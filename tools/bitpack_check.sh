@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Bit-identity CI gate (`make bitpack-check`): the packed lowered_bits
+# body must produce bit-identical trajectories to the int8 lowered body
+# (ISSUE 8). The full parity matrix — sec11, queen, Frankengraph, both
+# record_interface settings — lives in tests/test_bitboard_lowered.py;
+# this is the fast tier-1 smoke on a small surgical grid so the
+# contract gates every commit, not just slow-marked runs.
+#
+#   tools/bitpack_check.sh
+#
+# Exercised by tests/test_tools.py, so tier-1 fails when the gate rots.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+
+JAX_PLATFORMS=cpu "$PY" - <<'PYEOF'
+import numpy as np
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.kernel import bitboard
+from flipcomplexityempirical_tpu.kernel import board as kboard
+
+# a surgical grid (holes + extra diagonal edges) small enough that the
+# whole check compiles and runs in seconds on CPU, but wide enough
+# (w=7 > 4) that b2_disp is unambiguous and the packed body engages
+g = fce.graphs.square_grid(
+    5, 7, remove_nodes=[(0, 0), (2, 3)],
+    extra_edges=[((0, 1), (1, 0)), ((3, 4), (4, 5))])
+plan = fce.graphs.stripes_plan(g, 2)
+spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                invalid="repropose", accept="cut",
+                parity_metrics=True, geom_waits=True)
+bg, st, params = fce.sampling.init_board(
+    g, plan, n_chains=6, seed=3, spec=spec, base=1.3, pop_tol=0.3)
+assert bitboard.supported_lowered(bg, spec), "gate rejects the fixture"
+assert kboard.body_for(bg, spec) == "lowered_bits", \
+    f"dispatch fell off the packed rung: {kboard.body_for(bg, spec)}"
+
+got_state, got_outs = kboard.run_board_chunk(bg, spec, params, st, 60)
+want_state, want_outs = kboard.run_board_chunk(bg, spec, params, st, 60,
+                                               bits=False)
+assert set(got_outs) == set(want_outs), (set(got_outs), set(want_outs))
+for k in want_outs:
+    np.testing.assert_array_equal(np.asarray(got_outs[k]),
+                                  np.asarray(want_outs[k]), err_msg=k)
+for f in want_state.__dataclass_fields__:
+    a, b = getattr(got_state, f), getattr(want_state, f)
+    if b is None:
+        assert a is None, f
+        continue
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                  err_msg=f)
+print("bitpack-check: lowered_bits == lowered (60 steps, 6 chains, "
+      "5x7 surgical grid)")
+PYEOF
+echo "bitpack-check: OK"

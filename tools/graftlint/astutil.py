@@ -212,12 +212,15 @@ STATIC_ATTRS = frozenset({
 STATIC_CALLS = frozenset({
     "len", "isinstance", "max", "min", "range", "tuple", "zip", "enumerate",
     "getattr", "hasattr", "abs", "int", "float", "bool", "str", "sorted",
-    "supported", "supported_pair", "geom_denom_finite", "kstep_geom_ok",
-    "n_words", "field",
+    "divmod",
+    "supported", "supported_pair", "supported_lowered",
+    "geom_denom_finite", "kstep_geom_ok",
+    "n_words", "canvas_words", "field",
 })
 # attribute calls: host predicates over static config + python int methods
 STATIC_ATTR_CALLS = frozenset({
-    "bit_length", "n_words", "supported", "supported_pair",
+    "bit_length", "n_words", "canvas_words",
+    "supported", "supported_pair", "supported_lowered",
     "geom_denom_finite", "kstep_geom_ok", "field", "get", "keys", "values",
     "items",
 })

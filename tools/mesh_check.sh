@@ -10,8 +10,11 @@
 #      contract per_host_path/trace_export promise multi-host runs
 #   3. a live 2-device forced-host mesh smoke through `bench.py --mesh`:
 #      the MULTICHIP record must select a fast-path body (bitboard or
-#      lowered, not int8/general), carry per-chip flips/s, and emit an
-#      event stream that survives trace_export --validate
+#      the lowered family, not int8/general), carry per-chip flips/s,
+#      and emit an event stream that survives trace_export --validate
+#   4. the same mesh smoke on the sec11 surgical graph: the sharded
+#      step must resolve the packed lowered_bits body (ISSUE 8 — the
+#      mesh path picks the new rung up through run_board_chunk)
 #
 #   tools/mesh_check.sh
 #
@@ -41,12 +44,28 @@ import sys
 with open(sys.argv[1], encoding="utf-8") as f:
     rec = json.load(f)
 assert rec["devices"] == 2, rec
-assert rec["body"] in ("bitboard", "lowered"), \
+assert rec["body"] in ("bitboard", "lowered_bits", "lowered"), \
     f"mesh smoke fell off the fast path: {rec['body']}"
 assert rec["flips_per_s_per_chip"] > 0, rec
 assert [r["devices"] for r in rec["scaling"]] == [1, 2], rec
 print("mesh-check: bench record OK "
       f"(body={rec['body']}, "
+      f"per-chip {rec['flips_per_s_per_chip']:,.0f} flips/s)")
+PYEOF
+
+"$PY" bench.py --mesh 2 --cpu --graph sec11 --chains 2 --steps 21 \
+    --warmup 21 --chunk 20 \
+    > "$tmp/mesh_sec11.json" 2> "$tmp/mesh_sec11_detail.json"
+"$PY" - "$tmp/mesh_sec11.json" <<'PYEOF'
+import json
+import sys
+
+with open(sys.argv[1], encoding="utf-8") as f:
+    rec = json.load(f)
+assert rec["body"] == "lowered_bits", \
+    f"sec11 mesh smoke must resolve the packed body: {rec['body']}"
+assert rec["flips_per_s_per_chip"] > 0, rec
+print("mesh-check: sec11 record OK (body=lowered_bits, "
       f"per-chip {rec['flips_per_s_per_chip']:,.0f} flips/s)")
 PYEOF
 echo "mesh-check: OK"

@@ -251,8 +251,9 @@ def report_runs(runs, out):
 
 
 def report_paths(runs, out):
-    """Aggregate throughput per kernel path (lowered / bitboard / board
-    / general / pallas). The dispatch in kernel/board.py is silent —
+    """Aggregate throughput per kernel path (lowered_bits / lowered /
+    bitboard / board / general / pallas). The dispatch in
+    kernel/board.py is silent —
     this table is where a workload that regressed off its fast path
     shows up (e.g. a sec11 run reporting 'general' instead of
     'lowered')."""

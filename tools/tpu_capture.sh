@@ -24,6 +24,14 @@ commit_retry "bench_runs/${TS}_pallas_exact.json" "bench_runs/${TS}_pallas_exact
 # 5. Chain-count scaling (>=1e4-chain axis)
 run_bench c8192 1200 --chains 8192
 run_bench c16384 1800 --chains 16384
+# 5b. Lowered-family headlines (round 8): sec11/frank race the packed
+#     lowered_bits body against the int8 lowered body ("body" in the
+#     record says which won), and the sec11 C=16384 row measures whether
+#     bit-packing reclaimed the HBM-bound falloff PROFILE.md pinned on
+#     int-plane traffic
+run_bench sec11 900 --graph sec11
+run_bench frank 900 --graph frank
+run_bench sec11_c16384 1800 --graph sec11 --chains 16384
 # 6. General-path record refresh (round-2's 0.30x was this path)
 run_bench general 900 --general
 # 7. ESS with thinning (record_every ~ IAT)
