@@ -3,7 +3,8 @@
 
 PYTHON ?= python
 
-.PHONY: test obs-check mesh-check chaos-check bitpack-check lint
+.PHONY: test obs-check mesh-check chaos-check bitpack-check \
+	service-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -31,6 +32,12 @@ chaos-check:
 # full parity matrix is tests/test_bitboard_lowered.py)
 bitpack-check:
 	PYTHON=$(PYTHON) tools/bitpack_check.sh
+
+# sweep-service gate: two coalescible tenants + one poison config must
+# yield one coalesced batch (one compile_cache_miss), a quarantined
+# poison job, and a valid merged event stream + namespaced heartbeats
+service-check:
+	PYTHON=$(PYTHON) tools/service_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

@@ -132,6 +132,21 @@ def main():
                     help="history thinning for the --ess recorded pass "
                          "(device-side stride; cuts the history readback "
                          "by the factor at large chain counts)")
+    ap.add_argument("--service", action="store_true",
+                    help="measure the sweep service instead of a raw "
+                         "kernel: --tenants coalescible jobs drained as "
+                         "one batch vs a solo tenant, reported as a "
+                         "'tenant_efficiency' record (per-tenant "
+                         "end-to-end throughput ratio, compile "
+                         "included — the coalescing win is one compile "
+                         "serving every tenant). Incompatible with the "
+                         "kernel-path flags; --chains means chains PER "
+                         "TENANT (default 2) and --graph picks the "
+                         "tenant family (sec11/frank; square maps to "
+                         "frank)")
+    ap.add_argument("--tenants", type=int, default=4,
+                    help="--service: coalescible tenants sharing the "
+                         "device")
     ap.add_argument("--ess-host", action="store_true",
                     help="force the host-copy f64 ESS estimator for the "
                          "--ess recorded pass (streams the history to "
@@ -140,6 +155,18 @@ def main():
                          "(chains, steps) x 4-key f32 history would not "
                          "fit HBM)")
     args = ap.parse_args()
+    if args.service:
+        for flag, name in ((args.pallas, "--pallas"),
+                           (args.general, "--general"),
+                           (args.ess, "--ess"),
+                           (args.mesh is not None, "--mesh"),
+                           (args.body is not None, "--body")):
+            if flag:
+                ap.error(f"{name} is incompatible with --service (the "
+                         "service benchmark drives whole sweep jobs, "
+                         "not one kernel path)")
+        _service_bench(args)
+        return
     if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
             or args.warmup - 1 < args.chunk):
         ap.error(f"--chunk {args.chunk} must divide steps-1 "
@@ -707,6 +734,45 @@ def _mesh_bench(args, cpu_fallback, g, plan, spec, rec):
         headline["degraded"] = True
         headline["degradations"] = degradations
     print(json.dumps(headline))
+
+
+def _service_bench(args):
+    """--service: the sweep-service tenant-efficiency record.
+
+    Delegates to service.__main__.run_simulation — N coalescible
+    tenants drained as ONE device batch vs a solo tenant, each cold for
+    its own batch shape, so the ratio prices exactly what a tenant
+    experiences: end-to-end turnaround including the XLA compile the
+    service pays on their behalf. The record is a plain
+    {"metric", "value"} dict, so tools/bench_compare.py gates it like
+    any flips/s headline (higher is better; the service block in
+    BASELINE.json sets the floor)."""
+    import tempfile
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from flipcomplexityempirical_tpu.obs import from_spec
+    from flipcomplexityempirical_tpu.service.__main__ import run_simulation
+
+    family = args.graph if args.graph in ("sec11", "frank") else "frank"
+    chains = args.chains or 2
+    outdir = tempfile.mkdtemp(prefix="bench_service_")
+    with from_spec(args.events) as rec:
+        record = run_simulation(tenants=args.tenants, chains=chains,
+                                steps=args.steps, family=family,
+                                outdir=outdir, recorder=rec)
+    import jax
+    meta = {
+        "mode": "service",
+        "outdir": outdir,
+        "device": str(jax.devices()[0]),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    if record["device"] == "cpu":
+        record["cpu_fallback"] = True
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

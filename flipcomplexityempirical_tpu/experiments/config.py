@@ -11,7 +11,9 @@ mu -> "263".
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
+import json
 from typing import Iterator
 
 MU = 2.63815853  # Z^2 SAW connective constant (grid_chain_sec11.py:33)
@@ -75,6 +77,43 @@ class ExperimentConfig:
             return (f"{self.family}-{core}"
                     f"R{len(self.betas)}S{self.swap_every}")
         return f"{self.family}-{core}"
+
+    def fingerprint(self) -> str:
+        """Content hash over the KERNEL-RELEVANT statics: two configs
+        with equal fingerprints build the same graph, the same Spec, and
+        the same run shape (steps, thinning), so the service scheduler
+        may coalesce them into one device batch and the compile cache
+        may key on it (service/cache.py).
+
+        Deliberately EXCLUDED — everything that varies per tenant
+        without changing the compiled kernel: ``alignment`` (initial
+        plan only), ``base``/``pop_tol`` (per-chain StepParams leaves),
+        ``seed`` (per-chain PRNG state; except the dual family, whose
+        geometry generation consumes it), ``n_chains`` (the batch axis
+        being coalesced), ``checkpoint_every`` (host-side segmenting).
+        The tag encodes exactly alignment/base/pop_tol, so tag changes
+        never move the fingerprint. Hashed as sorted canonical JSON —
+        independent of field ordering."""
+        payload = {
+            "family": self.family,
+            "backend": self.backend,
+            "contiguity": self.contiguity,
+            "accept": self.accept,
+            "propose_parallel": self.propose_parallel,
+            "n_districts": self.n_districts,
+            "grid": self.grid,
+            "lattice": [self.lattice_m, self.lattice_n],
+            "betas": [float(b) for b in self.betas],
+            "swap_every": self.swap_every,
+            "dual": [self.dual_nx, self.dual_ny, self.dual_source],
+            "total_steps": self.total_steps,
+            "record_every": self.record_every,
+        }
+        if self.family == "dual":
+            payload["seed"] = self.seed
+        blob = json.dumps(payload, sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
 
     @property
     def plot_node_size(self) -> int:

@@ -236,7 +236,6 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
 
     rec = obs.resolve_recorder(recorder)
     spec = spec_for(cfg)
-    labels = _labels_for(cfg)
     use_board = kboard.supports(g, spec) and not _force_general
     if use_board:
         handle, states, params = init_board(
@@ -317,6 +316,18 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     else:
         history = {k: np.concatenate(v, axis=1)
                    for k, v in hist_parts.items()}
+    return assemble_run_data(cfg, g, handle, use_board, states, history,
+                             waits_total)
+
+
+def assemble_run_data(cfg: ExperimentConfig, g, handle, use_board: bool,
+                      states, history: dict, waits_total) -> dict:
+    """The run epilogue shared by ``_run_jax`` and the sweep service's
+    batched executor (service.scheduler slices one tenant's chain rows
+    out of a coalesced batch and assembles them here): host readback,
+    canvas -> node conversion on the board path, and the reference's
+    final-accumulator bookkeeping (finalize_host)."""
+    labels = _labels_for(cfg)
     s = jax.tree.map(np.asarray, states)
     t_final = cfg.total_steps  # reference t after the loop (line 402)
     c0 = type(s)(**{f: (np.asarray(v)[0] if (v := getattr(s, f))
@@ -1072,7 +1083,24 @@ def write_heartbeat(path: Optional[str], recorder=None, **payload):
             rec.emit("heartbeat_error", message=msg, path=path)
 
 
-def install_live_hooks(rec, heartbeat, cfg, progress: dict):
+def heartbeat_path_for(path: Optional[str], tag: str):
+    """Per-job heartbeat file for one config under a shared base path:
+    ``heartbeat.json`` + tag ``2B30P10`` -> ``heartbeat.2B30P10.json``.
+    One-shot sweeps run configs strictly in sequence, so a single file
+    is unambiguous there; the sweep SERVICE runs jobs interleaved
+    (coalesced batches, retries) and concurrent refreshes of one file
+    would clobber each other's ``current``/``diag`` payloads — each job
+    gets its own file and the service maintains a merged summary at the
+    base path (see service.scheduler; obs_report --heartbeat probes
+    both shapes)."""
+    if not path:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.{tag}{ext or '.json'}"
+
+
+def install_live_hooks(rec, heartbeat, cfg, progress: dict,
+                       namespace: bool = False):
     """Wire the recorder's live-observer hooks for one in-flight config:
     ChainMonitor calls ``rec.diag_hook`` / ``rec.anomaly_hook``, the
     runners' MetricsRegistry.notify calls ``rec.metrics_hook``; each
@@ -1083,8 +1111,15 @@ def install_live_hooks(rec, heartbeat, cfg, progress: dict):
     classifier reads ``hb_state["anomalies"]`` to tell a config that
     failed while frozen/collapsed (deterministic) from a machinery
     hiccup (transient). Shared by run_sweep and
-    resilience.supervisor.run_supervised_sweep."""
+    resilience.supervisor.run_supervised_sweep.
+
+    ``namespace=True`` (the sweep service) redirects the refreshes to
+    the config's own ``heartbeat_path_for(heartbeat, cfg.tag)`` file so
+    concurrent in-flight jobs never clobber one shared file; the
+    one-shot sweeps keep the single-file behavior unchanged."""
     hb_state = {"diag": None, "metrics": None, "anomalies": {}}
+    if namespace:
+        heartbeat = heartbeat_path_for(heartbeat, cfg.tag)
 
     def _uninstall():
         if rec:

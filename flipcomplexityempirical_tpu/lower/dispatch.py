@@ -12,6 +12,8 @@ slower. Kernel imports happen lazily inside the functions to keep
 
 from __future__ import annotations
 
+import hashlib
+
 from ..graphs.lattice import LatticeGraph
 from .stencil import stencil_for
 
@@ -55,3 +57,24 @@ def kernel_path_for(graph: LatticeGraph, spec) -> str:
     bits_ok = (bitboard.supported_pair(st, spec)
                if spec.proposal == "pair" else bitboard.supported(st, spec))
     return "bitboard" if bits_ok else "board"
+
+
+def lowering_signature(graph: LatticeGraph, spec) -> str:
+    """Stable content key for 'these workloads compile to the same
+    kernel': the resolved dispatch-ladder path, the graph's topology
+    (node/edge counts plus a hash of the edge list — graph NAMES are
+    labels, not identity), and the full Spec statics (its frozen
+    dataclass repr lists every field deterministically). Two (graph,
+    spec) pairs with equal signatures trace to the same jaxpr modulo
+    batch shape, so the service's compile cache keys on
+    ``(lowering_signature, chain count, chunking)``. Returned as a
+    short hex digest — a filename- and JSON-safe opaque token."""
+    import numpy as np
+
+    edges = np.ascontiguousarray(np.asarray(graph.edges, dtype=np.int64))
+    h = hashlib.sha256()
+    h.update(edges.tobytes())
+    h.update(repr(edges.shape).encode())
+    blob = (f"{kernel_path_for(graph, spec)}|n{graph.n_nodes}"
+            f"|e{graph.n_edges}|{h.hexdigest()[:16]}|{spec!r}")
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]

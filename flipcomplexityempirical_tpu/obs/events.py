@@ -85,6 +85,26 @@ Core field semantics:
 - ``heartbeat_error``: a heartbeat write failed (full disk, missing
   dir); the run continued — heartbeats are liveness telemetry, never
   load-bearing.
+- ``job_submitted``: the sweep service accepted an ``ExperimentConfig``
+  submission (service.queue). ``job_id`` is the service-local handle,
+  ``tag`` the config tag; extras carry the config fingerprint the
+  scheduler coalesces on.
+- ``job_batched``: the scheduler coalesced a group of compatible jobs
+  (same ``ExperimentConfig.fingerprint()``) into one device batch along
+  the chain axis. ``jobs`` lists the member job ids, ``chains`` the
+  total batched chain count. Singleton batches emit it too (``jobs``
+  of length 1), so the stream records every device dispatch decision.
+- ``job_done``: terminal state of one job: ``status`` is ``done`` /
+  ``failed`` / ``quarantined`` (the latter two mirror the supervisor's
+  ``config_failed`` / ``config_quarantined`` taxonomy, which the
+  service also emits per job).
+- ``compile_cache_hit`` / ``compile_cache_miss``: the service's
+  compile-cache probe before a batch dispatch. ``key`` is the stable
+  cache key (``lower.dispatch.lowering_signature`` + batch shape),
+  ``kernel_path`` the dispatch-ladder rung it resolves to. A miss means
+  this (kernel, batch shape) pays XLA compilation in this process (and
+  seeds the persistent on-disk cache when ``--compile-cache`` is set);
+  a hit means the jit/persistent cache serves it.
 
 Adding a new event *type* (as ``diag``/``anomaly`` were added) does NOT
 bump SCHEMA_VERSION: readers fold by type and validation rejects only
@@ -185,6 +205,29 @@ EVENT_REGISTRY = {
     "heartbeat_error": {
         "fields": ("message",),
         "doc": "heartbeat write failed; run continues (non-fatal)",
+    },
+    "job_submitted": {
+        "fields": ("job_id", "tag"),
+        "doc": "sweep service accepted a config submission",
+    },
+    "job_batched": {
+        "fields": ("batch_id", "jobs", "chains"),
+        "doc": "scheduler coalesced compatible jobs into one device "
+               "batch along the chain axis",
+    },
+    "job_done": {
+        "fields": ("job_id", "tag", "status"),
+        "doc": "terminal job state: done / failed / quarantined",
+    },
+    "compile_cache_hit": {
+        "fields": ("key", "kernel_path"),
+        "doc": "batch signature already compiled (jit or persistent "
+               "cache serves it)",
+    },
+    "compile_cache_miss": {
+        "fields": ("key", "kernel_path"),
+        "doc": "new batch signature: this dispatch pays XLA "
+               "compilation and seeds the persistent cache",
     },
 }
 

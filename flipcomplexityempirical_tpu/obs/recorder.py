@@ -46,6 +46,7 @@ class NullRecorder:
     diag_hook = None
     anomaly_hook = None
     metrics_hook = None
+    run_meta: dict = {}
 
     def __bool__(self):
         return False
@@ -105,6 +106,11 @@ class Recorder:
         if path is None and stream is None:
             raise ValueError("Recorder needs a path and/or a stream "
                              "(use obs.NULL for the no-op recorder)")
+        # Process-level context merged into every run_start event (a
+        # CLI sets e.g. run_meta["compile_cache_dir"] once; every
+        # runner's run_start then carries it without the runners
+        # knowing). Explicit emit kwargs win on collision.
+        self.run_meta: dict = {}
         self.path = path
         if path:
             # the sweep CLI defaults the stream into its --out directory,
@@ -144,6 +150,8 @@ class Recorder:
         obj = {"v": SCHEMA_VERSION,
                "ts": time.time() if ts is None else float(ts),
                "event": event}
+        if event == "run_start" and self.run_meta:
+            obj.update(self.run_meta)
         obj.update(fields)
         line = json.dumps(obj, separators=(",", ":"), default=_jsonable)
         if self._file is not None:

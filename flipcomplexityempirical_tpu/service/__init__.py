@@ -1,0 +1,42 @@
+"""Sweep-as-a-service: coalesced multi-tenant batching over one device.
+
+The one-shot drivers (``experiments.driver.run_sweep`` and the
+supervised variant) own the hardware for one sweep and pay a full XLA
+compile per config shape — fine for the replication grids, wasteful for
+the real demand shape of many small heterogeneous sweep REQUESTS
+(ISSUE 9): the chip idles between invocations and every tenant
+recompiles kernels a neighbor just built.
+
+This package turns that loop inside out:
+
+- ``queue.JobQueue``    — accepts ``ExperimentConfig`` submissions as
+  ``Job`` records (``job_submitted`` events).
+- ``scheduler.SweepService`` — groups compatible jobs (equal
+  ``ExperimentConfig.fingerprint()`` => same graph, Spec, and run
+  shape) and runs each group as ONE device batch along the chain axis,
+  slicing per-tenant results back out (``job_batched`` /
+  ``job_done``). Chains are independent by construction (per-chain
+  PRNG keys live in the state), so a tenant's sliced rows are
+  bit-identical to a solo run. Failures reuse the PR 7 machinery:
+  ``resilience.supervisor.classify_error`` + ``RetryPolicy`` backoff,
+  quarantine for poison configs, per-segment checkpoints per tenant.
+- ``cache.CompileCache`` — probe keyed on
+  ``lower.dispatch.lowering_signature`` + batch shape
+  (``compile_cache_hit`` / ``compile_cache_miss`` events), optionally
+  persisted next to JAX's on-disk compilation cache
+  (``enable_persistent_cache``) so service restarts skip XLA compiles.
+
+``python -m flipcomplexityempirical_tpu.service --simulate`` is the
+hardware-free proof: N tenants coalesced on one device vs one tenant
+solo, reported as ``tenant_efficiency`` (also ``bench.py --service``).
+"""
+
+from .cache import CompileCache, enable_persistent_cache
+from .queue import Job, JobQueue
+from .scheduler import SweepService, concat_params, concat_states
+
+__all__ = [
+    "CompileCache", "enable_persistent_cache",
+    "Job", "JobQueue",
+    "SweepService", "concat_params", "concat_states",
+]

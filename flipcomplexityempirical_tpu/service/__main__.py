@@ -1,0 +1,218 @@
+"""CLI: python -m flipcomplexityempirical_tpu.service
+         --simulate --out /tmp/svc [--tenants 4] [--chains 2]
+         [--compile-cache DIR] [--events PATH]
+     or: ... --family frank --out plots/frank-svc [--steps N]
+
+``--simulate`` is the hardware-free proof of the sweep service
+(ISSUE 9): N coalescible tenants are submitted against one device and
+drained as ONE batch, a solo tenant is measured for reference, and the
+per-tenant end-to-end throughput ratio is printed as a bench-style
+``tenant_efficiency`` record (also reachable as ``bench.py --service``).
+The efficiency is measured on COLD turnarounds — submit-to-result
+including the XLA compile the service pays on the tenant's behalf —
+because compile amortization is precisely what coalescing buys: one
+compile serves every tenant in the batch where serial solo service
+would pay it N times.
+
+Without ``--simulate``, a reference sweep family is submitted through
+the service instead of the one-shot driver: fingerprint-equal configs
+coalesce, failures retry/quarantine per the supervisor taxonomy, and
+the exit code is nonzero when any job ends failed/quarantined (same
+contract as the supervised experiments CLI).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ..obs import from_spec
+from ..resilience import faults as rfaults
+from ..resilience.supervisor import RetryPolicy
+from ..experiments.config import SWEEPS, ExperimentConfig
+from .cache import CompileCache, enable_persistent_cache
+from .scheduler import SweepService
+
+# families whose (alignment, base) grid gives coalescible-but-distinct
+# tenants: alignment varies the initial plan, base the per-chain params
+# — neither moves ExperimentConfig.fingerprint(), both move the tag
+_SIM_FAMILIES = ("frank", "sec11")
+
+
+def tenant_configs(tenants: int, chains: int, steps: int,
+                   family: str = "frank", seed: int = 3,
+                   record_every: int = 1) -> list:
+    """N fingerprint-equal tenant configs with distinct tags and seeds —
+    the service coalesces them into one device batch."""
+    if family not in _SIM_FAMILIES:
+        raise ValueError(f"simulation families are {_SIM_FAMILIES}, "
+                         f"got {family!r}")
+    return [ExperimentConfig(family=family, alignment=(2, 1, 0)[i % 3],
+                             base=0.3 + 0.01 * i, pop_tol=0.1,
+                             total_steps=steps, n_chains=chains,
+                             seed=seed + 13 * i,
+                             record_every=record_every)
+            for i in range(tenants)]
+
+
+def _drain_cold(configs, outdir: str, recorder=None, heartbeat=None,
+                compile_cache=None, policy=None) -> tuple:
+    """Submit ``configs`` to a fresh service and drain; returns
+    (turnaround_s, service). Cold for its batch shape: jit caches key on
+    the chain count, so the solo and coalesced rounds each pay their own
+    compile — exactly what a tenant experiences."""
+    svc = SweepService(outdir=outdir, recorder=recorder,
+                       heartbeat=heartbeat, compile_cache=compile_cache,
+                       policy=policy)
+    jobs = [svc.submit(c) for c in configs]
+    t0 = time.perf_counter()
+    svc.run_until_idle()
+    wall = time.perf_counter() - t0
+    bad = [(j.tag, j.status, j.error) for j in jobs if j.status != "done"]
+    if bad:
+        raise RuntimeError(f"simulation jobs did not complete: {bad}")
+    return wall, svc
+
+
+def run_simulation(tenants: int = 4, chains: int = 2, steps: int = 400,
+                   family: str = "frank", seed: int = 3,
+                   outdir: str = ".", recorder=None, heartbeat=None,
+                   compile_cache=None, policy=None) -> dict:
+    """The N-tenant coalescing measurement; returns the bench record.
+
+    The coalesced round runs FIRST so any process-global first-dispatch
+    warmup lands on the batch side — the reported efficiency is the
+    conservative one."""
+    import jax
+
+    cfgs = tenant_configs(tenants, chains, steps, family=family,
+                          seed=seed)
+    wall_batch, svc_b = _drain_cold(
+        cfgs, os.path.join(outdir, "tenants"), recorder=recorder,
+        heartbeat=heartbeat, compile_cache=compile_cache, policy=policy)
+    stats = svc_b.batch_stats
+    if len(stats) != 1 or len(stats[0].jobs) != tenants:
+        raise RuntimeError(
+            f"expected one coalesced batch of {tenants} tenants, got "
+            f"{[(s.batch_id, s.jobs) for s in stats]}")
+    wall_solo, svc_s = _drain_cold(
+        cfgs[:1], os.path.join(outdir, "solo"), recorder=recorder,
+        compile_cache=compile_cache, policy=policy)
+    eff = wall_solo / wall_batch
+    return {
+        "metric": "tenant_efficiency",
+        "value": round(eff, 4),
+        "unit": "ratio",
+        "tenants": tenants,
+        "chains_per_tenant": chains,
+        "steps": steps,
+        "family": family,
+        "kernel_path": stats[0].kernel_path,
+        "solo_turnaround_s": round(wall_solo, 3),
+        "batch_turnaround_s": round(wall_batch, 3),
+        # run-only occupancy view (excludes compile): how much slower
+        # the coalesced device pass is than a solo pass
+        "solo_run_s": round(svc_s.batch_stats[0].wall_s, 4),
+        "batch_run_s": round(stats[0].wall_s, 4),
+        "serial_service_s": round(tenants * wall_solo, 3),
+        "device": jax.devices()[0].platform,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--simulate", action="store_true",
+                    help="N-tenant coalescing measurement on this host "
+                         "(no hardware assumptions); prints a "
+                         "tenant_efficiency bench record")
+    ap.add_argument("--family", choices=sorted(SWEEPS), default="frank",
+                    help="sweep family to submit through the service "
+                         "(simulation mode: frank|sec11)")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--tenants", type=int, default=4,
+                    help="simulation: coalescible tenants sharing the "
+                         "device")
+    ap.add_argument("--chains", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--record-every", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--only", nargs="*", default=None,
+                    help="config tags to submit, e.g. 2B30P10")
+    ap.add_argument("--events", metavar="PATH", default=None,
+                    help="append obs JSONL (job_submitted/job_batched/"
+                         "compile_cache_* and all runner events) to "
+                         "PATH; '-' streams to stderr")
+    ap.add_argument("--heartbeat", metavar="PATH", default=None,
+                    help="merged service heartbeat JSON (per-job files "
+                         "appear as heartbeat.<tag>.json next to it); "
+                         "defaults to OUT/heartbeat.json")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--compile-cache", metavar="DIR", default=None,
+                    help="persistent compile cache directory: wires "
+                         "JAX's on-disk XLA cache AND the service's "
+                         "signature index, so restarts skip compiles "
+                         "and report hits; the directory is stamped "
+                         "into every run_start event")
+    ap.add_argument("--max-batch-chains", type=int, default=None,
+                    help="cap on total chains per coalesced batch "
+                         "(default: unbounded)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    ap.add_argument("--faults", metavar="SPEC", default=None,
+                    help="fault-injection plan (resilience/faults.py "
+                         "grammar); overrides GRAFT_FAULTS")
+    ap.add_argument("--retries", type=int, default=3)
+    ap.add_argument("--quarantine-after", type=int, default=2)
+    ap.add_argument("--deadline", type=float, default=None, metavar="S",
+                    help="per-batch wall budget in seconds")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if args.faults is not None:
+        rfaults.install_from_spec(args.faults)
+    else:
+        rfaults.install_from_env()
+    os.makedirs(args.out, exist_ok=True)
+    heartbeat = args.heartbeat or os.path.join(args.out,
+                                               "heartbeat.json")
+    policy = RetryPolicy(max_retries=args.retries,
+                         quarantine_after=args.quarantine_after,
+                         deadline_s=args.deadline, seed=args.seed)
+    compile_cache = None
+    with from_spec(args.events) as rec:
+        if args.compile_cache:
+            enable_persistent_cache(args.compile_cache)
+            compile_cache = CompileCache(args.compile_cache,
+                                         recorder=rec)
+            if rec:
+                rec.run_meta["compile_cache_dir"] = args.compile_cache
+        if args.simulate:
+            record = run_simulation(
+                tenants=args.tenants, chains=args.chains,
+                steps=args.steps, family=args.family, seed=args.seed,
+                outdir=args.out, recorder=rec, heartbeat=heartbeat,
+                compile_cache=compile_cache, policy=policy)
+            print(json.dumps(record))
+            return
+        sweep = SWEEPS[args.family]
+        configs = list(sweep(total_steps=args.steps,
+                             n_chains=args.chains, seed=args.seed,
+                             record_every=args.record_every))
+        if args.only:
+            configs = [c for c in configs if c.tag in set(args.only)]
+        svc = SweepService(outdir=args.out,
+                           checkpoint_dir=args.checkpoint_dir,
+                           recorder=rec, heartbeat=heartbeat,
+                           compile_cache=compile_cache, policy=policy,
+                           max_batch_chains=args.max_batch_chains,
+                           verbose=True)
+        for cfg in configs:
+            svc.submit(cfg)
+        svc.run_until_idle()
+        sys.exit(svc.exit_code)
+
+
+if __name__ == "__main__":
+    main()

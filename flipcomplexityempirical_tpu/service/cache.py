@@ -1,0 +1,125 @@
+"""Compile cache for the sweep service: signature-keyed AOT amortization.
+
+Two layers, both keyed on the stable lowering signature
+(``lower.dispatch.lowering_signature`` — kernel path + graph topology +
+Spec statics) plus the batch shape jit specializes on:
+
+- **In-process**: ``CompileCache.check`` records which keys this
+  process has already dispatched. A second tenant whose batch resolves
+  to a seen key emits ``compile_cache_hit`` and, because jax's own jit
+  cache holds the specialization, produces ZERO ``compile`` events —
+  the event-stream proof of amortization (ISSUE 9 acceptance).
+- **On disk**: ``enable_persistent_cache(dir)`` wires JAX's persistent
+  compilation cache (``jax_compilation_cache_dir``), and the index
+  JSON written next to it survives restarts, so a restarted service
+  knows a key's XLA work is served from disk (the ~30-60s/config
+  compile becomes a deserialization).
+
+The probe is bookkeeping, not a gate: the runners' jit cache is the
+actual mechanism; this records and events the decision so reports and
+smokes can assert on it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from .. import obs
+
+INDEX_NAME = "service_compile_index.json"
+
+
+def enable_persistent_cache(cache_dir: str,
+                            min_compile_secs: float = 1.0) -> str:
+    """Point JAX's on-disk persistent compilation cache at
+    ``cache_dir`` (created if missing) so XLA compiles survive process
+    restarts. Returns the directory (for ``run_start`` meta — see
+    ``Recorder.run_meta``). Same knobs as the experiments CLI's
+    ``--jax-cache``."""
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    return cache_dir
+
+
+class CompileCache:
+    """Signature -> seen bookkeeping with hit/miss events.
+
+    ``cache_dir=None`` keeps the index in-process only (simulation
+    mode); with a directory the index is loaded at construction and
+    re-written (atomically) on every new key, so a restarted service
+    reports hits for work the persistent XLA cache will serve."""
+
+    def __init__(self, cache_dir: Optional[str] = None, recorder=None):
+        self.cache_dir = cache_dir
+        self._rec = obs.resolve_recorder(recorder)
+        self._seen: dict = {}
+        if cache_dir:
+            self._seen.update(self._load_index())
+
+    # -- persistence -------------------------------------------------
+
+    def _index_path(self) -> Optional[str]:
+        if not self.cache_dir:
+            return None
+        return os.path.join(self.cache_dir, INDEX_NAME)
+
+    def _load_index(self) -> dict:
+        path = self._index_path()
+        try:
+            with open(path, encoding="utf-8") as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        return d if isinstance(d, dict) else {}
+
+    def _save_index(self):
+        path = self._index_path()
+        if not path:
+            return
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self._seen, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError as e:
+            # the index is an optimization record, never load-bearing
+            print(f"[compile-cache] index write failed ({e}); "
+                  "continuing in-process only")
+
+    # -- the probe ---------------------------------------------------
+
+    @staticmethod
+    def key(signature: str, n_chains: int, total_steps: int,
+            segment: int) -> str:
+        """The cache key: lowering signature + everything the jitted
+        chunk kernels specialize on for a batch — total chain count
+        (the leading shape) and the segmenting that determines the
+        chunk-length set (``pick_chunk`` keys per length)."""
+        return (f"{signature}|chains={int(n_chains)}"
+                f"|steps={int(total_steps)}|seg={int(segment)}")
+
+    def check(self, key: str, kernel_path: str, **meta) -> bool:
+        """True on hit. Emits ``compile_cache_hit``/``_miss`` and, on a
+        miss with a cache_dir, persists the updated index."""
+        hit = key in self._seen
+        if self._rec:
+            fields = dict(key=key, kernel_path=kernel_path,
+                          persistent=bool(self.cache_dir), **meta)
+            if hit:
+                self._rec.emit("compile_cache_hit", **fields)
+            else:
+                self._rec.emit("compile_cache_miss", **fields)
+        if not hit:
+            self._seen[key] = {"kernel_path": kernel_path, **meta}
+            self._save_index()
+        return hit
+
+    def __len__(self) -> int:
+        return len(self._seen)

@@ -1,0 +1,87 @@
+"""Job queue for the sweep service: config submissions as host records.
+
+Deliberately boring — a list of ``Job`` dataclasses with submission-order
+iteration. The interesting scheduling decisions (which jobs coalesce,
+when to retry) live in ``scheduler.SweepService``; the queue only owns
+identity (monotonic job ids), lifecycle status, and the
+``job_submitted`` event. No threads: the service is a single host loop
+driving batched device dispatches, matching the runners'
+no-added-syncs contract (PROFILE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from .. import obs
+from ..experiments.config import ExperimentConfig
+
+# Job lifecycle. queued -> running -> done, with failed/quarantined as
+# the supervisor-taxonomy terminals (resilience.supervisor).
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+QUARANTINED = "quarantined"
+
+TERMINAL = (DONE, FAILED, QUARANTINED)
+
+
+@dataclasses.dataclass
+class Job:
+    """One submitted config and its service-side lifecycle."""
+
+    job_id: str
+    config: ExperimentConfig
+    submitted_ts: float
+    status: str = QUEUED
+    attempts: int = 0                 # execution attempts so far
+    det_failures: int = 0             # deterministic failures (quarantine)
+    solo: bool = False                # isolation flag: never coalesce
+    batch: Optional[str] = None       # last batch this job ran in
+    error: Optional[str] = None       # last failure message
+    result: Optional[dict] = None     # per-tenant data dict when DONE
+
+    @property
+    def tag(self) -> str:
+        return self.config.tag
+
+    @property
+    def fingerprint(self) -> str:
+        return self.config.fingerprint()
+
+
+class JobQueue:
+    """Submission-ordered job store. ``submit`` assigns ``j<K>`` ids and
+    emits ``job_submitted``; ``runnable`` yields non-terminal jobs in
+    submission order (the scheduler re-runs a retried job by flipping
+    its status back to QUEUED)."""
+
+    def __init__(self, recorder=None):
+        self._rec = obs.resolve_recorder(recorder)
+        self._jobs: list[Job] = []
+
+    def submit(self, config: ExperimentConfig) -> Job:
+        job = Job(job_id=f"j{len(self._jobs):04d}", config=config,
+                  submitted_ts=time.time())
+        self._jobs.append(job)
+        if self._rec:
+            self._rec.emit("job_submitted", job_id=job.job_id,
+                           tag=job.tag, family=config.family,
+                           fingerprint=job.fingerprint,
+                           n_chains=config.n_chains)
+        return job
+
+    def jobs(self) -> list[Job]:
+        return list(self._jobs)
+
+    def runnable(self) -> list[Job]:
+        return [j for j in self._jobs if j.status == QUEUED]
+
+    def active(self) -> list[Job]:
+        return [j for j in self._jobs if j.status not in TERMINAL]
+
+    def __len__(self) -> int:
+        return len(self._jobs)

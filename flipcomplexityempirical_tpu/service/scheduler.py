@@ -1,0 +1,567 @@
+"""Coalescing scheduler: compatible jobs share one device batch.
+
+The batching contract rests on two repo invariants:
+
+1. Chains are independent: every per-chain PRNG key lives in the chain
+   state (``runner.init_batch`` vmaps ``init_state`` over split keys),
+   every kernel body is vmapped over the leading chain axis, and
+   per-chain StepParams leaves (``log_base``/``beta``/``pop_lo``/
+   ``pop_hi``) are ``(C,)`` arrays. Concatenating two tenants' states
+   and params along axis 0, running one batched segment, and slicing
+   the rows back out is therefore BIT-identical to running each tenant
+   alone (tests/test_service.py proves it on both the lowered-bits and
+   general paths).
+2. Compile keys are shapes + statics: jobs with equal
+   ``ExperimentConfig.fingerprint()`` build the same graph and Spec, so
+   one coalesced dispatch compiles ONE kernel where N solo runs would
+   compile N (and a later tenant with the same signature and batch
+   shape compiles zero — ``service.cache``).
+
+Failure handling reuses the PR 7 supervisor taxonomy per job
+(``classify_error`` + ``RetryPolicy`` backoff + quarantine); a job that
+fails inside a batch is retried SOLO (isolation first, so a poison
+tenant cannot re-poison its neighbors), and jobs with an existing
+checkpoint run solo from their resume point (coalescing assumes a
+common step 0). Everything here is host-side between segments — no
+added device syncs (PROFILE.md guard-rail).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from types import SimpleNamespace
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from ..experiments import driver as drv
+from ..experiments.config import ExperimentConfig
+from ..kernel import board as kboard
+from ..lower.dispatch import kernel_path_for, lowering_signature
+from ..resilience import faults as rfaults
+from ..resilience.supervisor import (DETERMINISTIC, RetryPolicy,
+                                     check_deadline, classify_error,
+                                     clear_deadline, set_deadline)
+from ..sampling import init_batch, init_board, run_chains
+from ..sampling.board_runner import finalize_board_run, run_board_segment
+from .cache import CompileCache
+from . import queue as q
+
+
+def concat_states(states_list):
+    """Stack tenant chain states along the chain axis. Every non-None
+    leaf of ChainState/BoardState is per-chain (leading axis C) by
+    construction — see state/chain_state.py — so a plain tree-concat is
+    exact."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(states_list) == 1:
+        return states_list[0]
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0),
+                        *states_list)
+
+
+def concat_params(params_list):
+    """Stack StepParams along the chain axis: leaves ``vmap_axes``
+    marks with axis 0 (log_base/beta/pop_lo/pop_hi — so coalesced
+    tenants may differ in base/pop_tol) are concatenated, shared leaves
+    (label_values, anneal schedule) are taken from the first tenant
+    (equal within a fingerprint group by construction)."""
+    import jax.numpy as jnp
+
+    if len(params_list) == 1:
+        return params_list[0]
+    p0 = params_list[0]
+    axes = type(p0).vmap_axes()
+    fields = {}
+    for f in p0.__dataclass_fields__:
+        vals = [getattr(p, f) for p in params_list]
+        if getattr(axes, f, None) == 0:
+            fields[f] = jnp.concatenate(vals, axis=0)
+        else:
+            fields[f] = vals[0]
+    return type(p0)(**fields)
+
+
+def _slice_chains(tree, lo: int, hi: int):
+    import jax
+
+    return jax.tree.map(lambda x: x[lo:hi], tree)
+
+
+@dataclasses.dataclass
+class _Prepared:
+    """One tenant initialized and ready to join a batch."""
+
+    job: q.Job
+    g: object
+    plan: object
+    spec: object
+    use_board: bool
+    handle: object
+    states: object
+    params: object
+    n_parts: int = 0
+
+
+@dataclasses.dataclass
+class BatchStats:
+    """Host-side record of one executed batch (bench.py --service and
+    the simulation mode read these for tenant-efficiency math)."""
+
+    batch_id: str
+    jobs: list
+    chains: int
+    steps: int
+    wall_s: float
+    kernel_path: str
+    cache_hit: bool
+
+
+class SweepService:
+    """The sweep-as-a-service loop: submit ExperimentConfigs, then
+    ``run_until_idle`` drains the queue — coalescing fingerprint-equal
+    fresh jobs into shared device batches, running checkpointed /
+    solo-flagged / temper jobs through the one-shot driver paths, and
+    retrying/quarantining failures per the supervisor taxonomy."""
+
+    def __init__(self, outdir: str,
+                 checkpoint_dir: Optional[str] = None,
+                 recorder=None,
+                 heartbeat: Optional[str] = None,
+                 compile_cache: Optional[CompileCache] = None,
+                 policy: Optional[RetryPolicy] = None,
+                 max_batch_chains: Optional[int] = None,
+                 verbose: bool = False):
+        self.outdir = outdir
+        self.checkpoint_dir = checkpoint_dir
+        self._rec = obs.resolve_recorder(recorder)
+        self.heartbeat = heartbeat
+        self.policy = policy or RetryPolicy()
+        self._rng = random.Random(self.policy.seed)
+        self.cache = compile_cache or CompileCache(recorder=self._rec)
+        self.max_batch_chains = max_batch_chains
+        self.verbose = verbose
+        self.queue = q.JobQueue(recorder=self._rec)
+        self.batch_stats: list[BatchStats] = []
+        self._batch_seq = 0
+        os.makedirs(outdir, exist_ok=True)
+
+    # -- submission --------------------------------------------------
+
+    def submit(self, config: ExperimentConfig) -> q.Job:
+        job = self.queue.submit(config)
+        self._write_summary()
+        return job
+
+    # -- grouping ----------------------------------------------------
+
+    def _has_checkpoint(self, cfg: ExperimentConfig) -> bool:
+        if not self.checkpoint_dir:
+            return False
+        return any(os.path.exists(os.path.join(self.checkpoint_dir, f))
+                   for f in (cfg.tag + ".npz",
+                             cfg.tag + ".manifest.json"))
+
+    def _solo_only(self, job: q.Job) -> bool:
+        """Jobs the coalescer must not touch: isolation retries, the
+        temper family (run-global ladder swap state), and anything with
+        an existing checkpoint (resume points differ, coalescing
+        assumes a common step 0)."""
+        return (job.solo or job.config.family == "temper"
+                or self._has_checkpoint(job.config))
+
+    def _form_groups(self, jobs: list) -> list:
+        """Submission-ordered greedy grouping: fingerprint-equal
+        batchable jobs share a group (capped at ``max_batch_chains``
+        total chains), everything else is a singleton."""
+        groups: list[list] = []
+        by_key: dict = {}
+        for job in jobs:
+            if self._solo_only(job):
+                groups.append([job])
+                continue
+            key = job.fingerprint
+            grp = by_key.get(key)
+            if grp is not None and (
+                    self.max_batch_chains is None
+                    or sum(j.config.n_chains for j in grp)
+                    + job.config.n_chains <= self.max_batch_chains):
+                grp.append(job)
+            else:
+                grp = [job]
+                groups.append(grp)
+                by_key[key] = grp
+        return groups
+
+    # -- heartbeats --------------------------------------------------
+
+    def _job_counts(self) -> dict:
+        jobs = self.queue.jobs()
+        return {
+            "n_jobs": len(jobs),
+            "n_done": sum(j.status == q.DONE for j in jobs),
+            "n_failed": sum(j.status == q.FAILED for j in jobs),
+            "n_quarantined": sum(j.status == q.QUARANTINED
+                                 for j in jobs),
+            "n_queued": sum(j.status == q.QUEUED for j in jobs),
+        }
+
+    def _write_summary(self):
+        """The merged service-level heartbeat: one ``jobs`` map over
+        every submission (per-job liveness lives in the namespaced
+        ``heartbeat.<tag>.json`` / ``heartbeat.<batch>.json`` files —
+        obs_report --heartbeat probes both shapes)."""
+        if not self.heartbeat:
+            return
+        jobs = self.queue.jobs()
+        status = ("running" if any(j.status not in q.TERMINAL
+                                   for j in jobs)
+                  else "complete" if not any(
+                      j.status in (q.FAILED, q.QUARANTINED)
+                      for j in jobs)
+                  else "complete_with_failures")
+        drv.write_heartbeat(
+            self.heartbeat, recorder=self._rec, status=status,
+            service=True,
+            jobs={j.tag: {"job_id": j.job_id, "status": j.status,
+                          "attempts": j.attempts,
+                          **({"batch": j.batch} if j.batch else {})}
+                  for j in jobs},
+            **self._job_counts())
+
+    def _write_job_heartbeat(self, job: q.Job, status: str, **extra):
+        drv.write_heartbeat(
+            drv.heartbeat_path_for(self.heartbeat, job.tag),
+            recorder=self._rec, status=status, job_id=job.job_id,
+            tag=job.tag, attempts=job.attempts, **extra)
+
+    # -- the drain loop ----------------------------------------------
+
+    def run_until_idle(self) -> list:
+        """Process the queue to quiescence; returns all jobs (terminal
+        states set, ``result`` populated on DONE). Emits one
+        ``sweep_summary`` per drain so obs_report folds a service
+        stream like a supervised sweep."""
+        rec = self._rec
+        retried = 0
+        svc_span = obs.span(rec, "service",
+                            n_jobs=len(self.queue.runnable())).begin()
+        try:
+            while True:
+                runnable = self.queue.runnable()
+                if not runnable:
+                    break
+                for jobs in self._form_groups(runnable):
+                    retried += self._execute(jobs)
+        finally:
+            counts = self._job_counts()
+            svc_span.end(**counts)
+        jobs = self.queue.jobs()
+        quarantined = [j.tag for j in jobs
+                       if j.status == q.QUARANTINED]
+        failed = [j.tag for j in jobs if j.status == q.FAILED]
+        rec.emit("sweep_summary",
+                 completed=counts["n_done"], retried=retried,
+                 quarantined=len(quarantined), failed=len(failed),
+                 quarantined_tags=quarantined, failed_tags=failed,
+                 service=True)
+        self._write_summary()
+        return jobs
+
+    @property
+    def exit_code(self) -> int:
+        return 2 if any(j.status in (q.FAILED, q.QUARANTINED)
+                        for j in self.queue.jobs()) else 0
+
+    # -- execution ---------------------------------------------------
+
+    def _execute(self, jobs: list) -> int:
+        """Run one group (1..N jobs) as a single attempt per member.
+        Returns the number of jobs sent back for retry."""
+        rec = self._rec
+        batch_id = f"b{self._batch_seq:04d}"
+        self._batch_seq += 1
+        for job in jobs:
+            job.attempts += 1
+            job.status = q.RUNNING
+            job.batch = batch_id
+            self._write_job_heartbeat(job, "running", batch=batch_id)
+        self._write_summary()
+        span = obs.span(rec, "batch", batch_id=batch_id,
+                        n_jobs=len(jobs),
+                        tags=[j.tag for j in jobs]).begin()
+        hb_state, uninstall = drv.install_live_hooks(
+            rec, self.heartbeat, SimpleNamespace(tag=batch_id),
+            self._job_counts(), namespace=True)
+        set_deadline(self.policy.deadline_s, batch_id)
+        retried = 0
+        t0 = time.perf_counter()
+        try:
+            if len(jobs) == 1 and self._solo_only(jobs[0]):
+                results = [(jobs[0],
+                            self._run_solo(jobs[0], batch_id))]
+            else:
+                prepared = []
+                for job in jobs:
+                    try:
+                        prepared.append(self._prepare(job))
+                    except Exception as e:
+                        retried += self._fail(job, e, hb_state)
+                results = (self._run_batch(prepared, batch_id)
+                           if prepared else [])
+        except Exception as e:
+            for job in jobs:
+                if job.status == q.RUNNING:
+                    retried += self._fail(job, e, hb_state)
+            span.end(error=type(e).__name__)
+            return retried
+        finally:
+            clear_deadline()
+            uninstall()
+        wall = time.perf_counter() - t0
+        for job, data in results:
+            self._complete(job, data, batch_id, wall)
+        span.end(seconds=wall, n_done=len(results))
+        return retried
+
+    def _prepare(self, job: q.Job) -> _Prepared:
+        """Build graph/plan/spec and initialize this tenant's own
+        (states, params) — each tenant keeps its own seed-derived
+        per-chain PRNG keys, so coalescing changes nothing about any
+        chain's trajectory."""
+        cfg = job.config
+        if cfg.backend != "jax":
+            raise ValueError(
+                f"service batches run backend='jax' only, got "
+                f"{cfg.backend!r} ({job.tag})")
+        if (cfg.checkpoint_every and cfg.record_every > 1
+                and cfg.checkpoint_every % cfg.record_every):
+            raise ValueError(
+                f"checkpoint_every ({cfg.checkpoint_every}) must be a "
+                f"multiple of record_every ({cfg.record_every})")
+        with obs.span(self._rec, "build_graph", tag=cfg.tag,
+                      family=cfg.family):
+            g, plan, _geo = drv.build_graph_and_plan(cfg)
+        spec = drv.spec_for(cfg)
+        use_board = kboard.supports(g, spec)
+        if use_board:
+            handle, states, params = init_board(
+                g, plan, n_chains=cfg.n_chains, seed=cfg.seed,
+                spec=spec, base=cfg.base, pop_tol=cfg.pop_tol)
+        else:
+            handle, states, params = init_batch(
+                g, plan, n_chains=cfg.n_chains, seed=cfg.seed,
+                spec=spec, base=cfg.base, pop_tol=cfg.pop_tol)
+        return _Prepared(job=job, g=g, plan=plan, spec=spec,
+                         use_board=use_board, handle=handle,
+                         states=states, params=params)
+
+    def _probe_cache(self, g, spec, n_chains: int, total_steps: int,
+                     segment: int, batch_id: str) -> tuple:
+        path = kernel_path_for(g, spec)
+        key = CompileCache.key(lowering_signature(g, spec), n_chains,
+                               total_steps, segment)
+        hit = self.cache.check(key, kernel_path=path, batch=batch_id)
+        return path, hit
+
+    def _run_solo(self, job: q.Job, batch_id: str) -> dict:
+        """Singleton execution through the one-shot driver runners —
+        exactly the resume/degradation semantics of a supervised sweep
+        config, minus artifact rendering."""
+        cfg = job.config
+        if cfg.backend != "jax":
+            raise ValueError(
+                f"service runs backend='jax' configs only, got "
+                f"{cfg.backend!r} ({job.tag})")
+        g, plan, _geo = drv.build_graph_and_plan(cfg)
+        spec = drv.spec_for(cfg)
+        chains = cfg.n_chains * (len(cfg.betas)
+                                 if cfg.family == "temper" else 1)
+        path, hit = self._probe_cache(
+            g, spec, chains, cfg.total_steps,
+            cfg.checkpoint_every or cfg.total_steps, batch_id)
+        self._rec.emit("job_batched", batch_id=batch_id,
+                       jobs=[job.job_id], chains=chains,
+                       fingerprint=job.fingerprint, kernel_path=path)
+        t0 = time.perf_counter()
+        if cfg.family == "temper":
+            data = drv._run_temper(cfg, g, plan, self.checkpoint_dir,
+                                   recorder=self._rec)
+        else:
+            data = drv._run_jax(cfg, g, plan, self.checkpoint_dir,
+                                recorder=self._rec)
+        wall = time.perf_counter() - t0
+        data["seconds"] = wall
+        self.batch_stats.append(BatchStats(
+            batch_id=batch_id, jobs=[job.job_id], chains=chains,
+            steps=cfg.total_steps, wall_s=wall, kernel_path=path,
+            cache_hit=hit))
+        return data
+
+    def _run_batch(self, prepared: list, batch_id: str) -> list:
+        """The coalesced executor: mirror of driver._run_jax's segment
+        loop over the concatenated batch, with per-tenant checkpoints
+        (sliced host state per segment) and per-tenant result slicing
+        at the end. All members are fresh (step 0) with equal
+        fingerprints, so spec/graph/run-shape agree by construction."""
+        rec = self._rec
+        lead = prepared[0]
+        cfg0 = lead.job.config
+        spec, use_board, handle = lead.spec, lead.use_board, lead.handle
+        counts = [p.job.config.n_chains for p in prepared]
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(int)
+        c_total = int(offsets[-1])
+        states = concat_states([p.states for p in prepared])
+        params = concat_params([p.params for p in prepared])
+        every = min(c.checkpoint_every or c.total_steps
+                    for c in (p.job.config for p in prepared))
+        total = cfg0.total_steps - (1 if use_board else 0)
+        path, hit = self._probe_cache(lead.g, spec, c_total,
+                                      cfg0.total_steps, every, batch_id)
+        rec.emit("job_batched", batch_id=batch_id,
+                 jobs=[p.job.job_id for p in prepared], chains=c_total,
+                 fingerprint=lead.job.fingerprint, kernel_path=path)
+
+        t0 = time.perf_counter()
+        done = 0
+        hist_parts: dict = {}
+        waits_total = np.zeros(c_total, np.float64)
+        while done < total:
+            check_deadline()
+            rfaults.fault_point("segment.step", tag=batch_id, done=done)
+            n = min(every, total - done)
+            if use_board:
+                res = run_board_segment(handle, spec, params, states, n,
+                                        record_every=cfg0.record_every,
+                                        recorder=rec)
+            else:
+                res = run_chains(handle, spec, params, states,
+                                 n_steps=n, record_initial=(done == 0),
+                                 record_every=cfg0.record_every,
+                                 recorder=rec)
+            states = res.state
+            for k, v in res.history.items():
+                hist_parts.setdefault(k, []).append(v)
+            waits_total += res.waits_total
+            done += n
+            if self.checkpoint_dir:
+                host = res.host_state()
+                for i, p in enumerate(prepared):
+                    lo, hi = int(offsets[i]), int(offsets[i + 1])
+                    cfg = p.job.config
+                    with obs.span(rec, "checkpoint", tag=cfg.tag,
+                                  done=done):
+                        p.n_parts = drv.save_checkpoint(
+                            self.checkpoint_dir, cfg,
+                            _slice_chains(host, lo, hi), done=done,
+                            waits_total=waits_total[lo:hi],
+                            new_hist={k: np.asarray(v)[lo:hi]
+                                      for k, v in res.history.items()},
+                            part_idx=p.n_parts)
+        if use_board:
+            res = finalize_board_run(handle, spec, params, states,
+                                     hist_parts, waits_total, [], True,
+                                     cfg0.total_steps, cfg0.record_every,
+                                     recorder=rec)
+            states, history, waits_total = (res.state, res.history,
+                                            res.waits_total)
+        else:
+            history = {k: np.concatenate(v, axis=1)
+                       for k, v in hist_parts.items()}
+        wall = time.perf_counter() - t0
+        self.batch_stats.append(BatchStats(
+            batch_id=batch_id, jobs=[p.job.job_id for p in prepared],
+            chains=c_total, steps=cfg0.total_steps, wall_s=wall,
+            kernel_path=path, cache_hit=hit))
+
+        results = []
+        for i, p in enumerate(prepared):
+            lo, hi = int(offsets[i]), int(offsets[i + 1])
+            data = drv.assemble_run_data(
+                p.job.config, p.g, handle, use_board,
+                _slice_chains(states, lo, hi),
+                {k: np.asarray(v)[lo:hi] for k, v in history.items()},
+                waits_total[lo:hi].copy())
+            data["seconds"] = wall
+            data["batch"] = batch_id
+            data["batch_chains"] = c_total
+            results.append((p.job, data))
+        return results
+
+    # -- job terminals -----------------------------------------------
+
+    def _complete(self, job: q.Job, data: dict, batch_id: str,
+                  wall: float):
+        job.status = q.DONE
+        job.result = data
+        job.error = None
+        self._rec.emit("job_done", job_id=job.job_id, tag=job.tag,
+                       status="done", batch=batch_id,
+                       seconds=data.get("seconds", wall),
+                       attempts=job.attempts)
+        self._write_job_heartbeat(job, "done", batch=batch_id)
+        self._write_summary()
+        if self.verbose:
+            print(f"[done] {job.job_id} {job.tag} "
+                  f"({data.get('seconds', wall):.2f}s, {batch_id})")
+
+    def _fail(self, job: q.Job, exc: BaseException, hb_state) -> int:
+        """Supervisor-taxonomy failure handling for one job; returns 1
+        when the job was requeued for retry (solo — isolation first),
+        0 on a terminal failure."""
+        rec = self._rec
+        klass = classify_error(exc, anomalies=hb_state["anomalies"])
+        msg = f"{type(exc).__name__}: {exc}"
+        job.error = msg
+        rec.emit("error", message=msg, tag=job.tag, job_id=job.job_id,
+                 error_class=klass, attempt=job.attempts)
+        if klass == DETERMINISTIC:
+            job.det_failures += 1
+        if job.det_failures >= self.policy.quarantine_after:
+            job.status = q.QUARANTINED
+            rec.emit("config_quarantined", tag=job.tag,
+                     failures=job.det_failures)
+            rec.emit("job_done", job_id=job.job_id, tag=job.tag,
+                     status="quarantined", attempts=job.attempts)
+            self._write_job_heartbeat(job, "quarantined", error=msg)
+            self._write_summary()
+            if self.verbose:
+                print(f"[quarantine] {job.job_id} {job.tag} after "
+                      f"{job.det_failures} deterministic failures "
+                      f"({msg})")
+            return 0
+        if job.attempts > self.policy.max_retries:
+            job.status = q.FAILED
+            rec.emit("config_failed", tag=job.tag, error_class=klass,
+                     message=msg, attempts=job.attempts)
+            rec.emit("job_done", job_id=job.job_id, tag=job.tag,
+                     status="failed", attempts=job.attempts)
+            self._write_job_heartbeat(job, "failed", error=msg)
+            self._write_summary()
+            if self.verbose:
+                print(f"[failed] {job.job_id} {job.tag} after "
+                      f"{job.attempts} attempts ({msg})")
+            return 0
+        wait = self.policy.backoff(job.attempts, self._rng)
+        rec.emit("retry", tag=job.tag, attempt=job.attempts,
+                 error_class=klass, backoff_s=wait, message=msg,
+                 job_id=job.job_id)
+        if self.verbose:
+            print(f"[retry] {job.job_id} {job.tag} attempt "
+                  f"{job.attempts} failed ({klass}: {msg}); backing "
+                  f"off {wait:.2f}s")
+        with obs.span(rec, "backoff", tag=job.tag,
+                      attempt=job.attempts, backoff_s=wait,
+                      error_class=klass):
+            time.sleep(wait)
+        job.status = q.QUEUED
+        job.solo = True
+        self._write_job_heartbeat(job, "retrying", error=msg)
+        self._write_summary()
+        return 1

@@ -1,0 +1,350 @@
+"""Sweep service (ISSUE 9): coalesced multi-tenant batching + compile
+cache.
+
+The load-bearing claims, each tested here:
+- ``ExperimentConfig.fingerprint()`` moves with kernel-relevant statics
+  and ONLY those (tag fields never move it);
+- two tenants coalesced into one device batch produce per-tenant
+  streams byte-identical to their solo runs (board/lowered_bits AND
+  general paths — chains are independent because per-chain PRNG keys
+  live in the state);
+- a second submission with an identical lowering signature+shape emits
+  zero ``compile`` / ``compile_cache_miss`` events (amortization);
+- failures follow the supervisor taxonomy (solo retry, quarantine) and
+  heartbeats are namespaced per job with a probeable merged summary;
+- the simulation mode sustains the ISSUE's tenant-efficiency floor.
+"""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.experiments import driver as drv
+from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
+from flipcomplexityempirical_tpu.lower.dispatch import lowering_signature
+from flipcomplexityempirical_tpu.resilience.supervisor import RetryPolicy
+from flipcomplexityempirical_tpu.service import (CompileCache, SweepService)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    from flipcomplexityempirical_tpu.resilience import faults as rfaults
+    rfaults.install_plan(None)
+    yield
+    rfaults.install_plan(None)
+
+FRANK = dict(family="frank", base=0.3, pop_tol=0.1, total_steps=120,
+             n_chains=2, backend="jax")
+HEX = dict(family="hex", base=0.3, pop_tol=0.1, total_steps=120,
+           n_chains=2, backend="jax", lattice_m=4, lattice_n=6)
+
+
+def _cfg(**kw):
+    merged = {**FRANK, **kw}
+    merged.setdefault("alignment", 2)
+    return ExperimentConfig(**merged)
+
+
+def _solo(cfg):
+    g, plan, _ = drv.build_graph_and_plan(cfg)
+    return drv._run_jax(cfg, g, plan, None)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_ignores_tag_fields():
+    """alignment/base/pop_tol define the tag; none of them changes the
+    compiled kernel, so none may move the fingerprint."""
+    ref = _cfg().fingerprint()
+    assert _cfg(alignment=0).fingerprint() == ref
+    assert _cfg(base=2.5).fingerprint() == ref
+    assert _cfg(pop_tol=0.9).fingerprint() == ref
+    assert _cfg(seed=99).fingerprint() == ref
+    assert _cfg(n_chains=64).fingerprint() == ref
+    assert _cfg(checkpoint_every=50).fingerprint() == ref
+    # distinct tags, equal fingerprints: the coalescing precondition
+    assert _cfg().tag != _cfg(alignment=0).tag
+
+
+def test_fingerprint_moves_with_kernel_statics():
+    ref = _cfg().fingerprint()
+    assert _cfg(family="sec11").fingerprint() != ref
+    assert _cfg(total_steps=121).fingerprint() != ref
+    assert _cfg(record_every=2).fingerprint() != ref
+    assert _cfg(contiguity="exact").fingerprint() != ref
+    assert _cfg(accept="corrected").fingerprint() != ref
+    assert _cfg(propose_parallel=4).fingerprint() != ref
+    assert _cfg(backend="python").fingerprint() != ref
+
+
+def test_fingerprint_dual_seed_is_kernel_relevant():
+    """The dual family's geometry generation consumes the seed, so equal
+    seeds are required to share a graph there — and only there."""
+    mk = lambda s: ExperimentConfig(family="dual", alignment=0, base=2.6,
+                                    pop_tol=0.25, seed=s)
+    assert mk(1).fingerprint() != mk(2).fingerprint()
+
+
+def test_lowering_signature_stable_and_discriminating():
+    cfg = _cfg()
+    g, _, _ = drv.build_graph_and_plan(cfg)
+    spec = drv.spec_for(cfg)
+    assert lowering_signature(g, spec) == lowering_signature(g, spec)
+    g2, _, _ = drv.build_graph_and_plan(_cfg(family="sec11"))
+    assert lowering_signature(g2, drv.spec_for(_cfg(family="sec11"))) \
+        != lowering_signature(g, spec)
+
+
+# ---------------------------------------------------------------------------
+# batched == solo, bit for bit
+# ---------------------------------------------------------------------------
+
+def _assert_tenant_matches_solo(job, cfg):
+    ref = _solo(cfg)
+    got = job.result
+    for k in ("end_signed", "cut_times", "num_flips", "waits_all"):
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(ref[k]), err_msg=k)
+    assert set(got["history"]) == set(ref["history"])
+    for k in ref["history"]:
+        np.testing.assert_array_equal(np.asarray(got["history"][k]),
+                                      np.asarray(ref["history"][k]),
+                                      err_msg=f"history[{k}]")
+    np.testing.assert_array_equal(np.asarray(got["assignments"]),
+                                  np.asarray(ref["assignments"]))
+
+
+@pytest.mark.parametrize("base_kw,alignments,expect_path", [
+    (FRANK, (2, 1), "lowered_bits"),
+    (HEX, (0, 1), "general"),
+], ids=["board-lowered_bits", "general"])
+def test_coalesced_batch_bit_identical_to_solo(tmp_path, base_kw,
+                                               alignments, expect_path):
+    """Two tenants with equal fingerprints run as ONE batch; each
+    tenant's sliced rows must be byte-identical to its solo run on both
+    the bit-packed board path and the general gather path."""
+    cfgs = [ExperimentConfig(alignment=al, seed=3 + 4 * i, **base_kw)
+            for i, al in enumerate(alignments)]
+    svc = SweepService(outdir=str(tmp_path))
+    jobs = [svc.submit(c) for c in cfgs]
+    svc.run_until_idle()
+    assert [j.status for j in jobs] == ["done", "done"], \
+        [(j.tag, j.error) for j in jobs]
+    assert len(svc.batch_stats) == 1
+    stat = svc.batch_stats[0]
+    assert stat.kernel_path == expect_path
+    assert stat.chains == sum(c.n_chains for c in cfgs)
+    assert jobs[0].batch == jobs[1].batch
+    for job, cfg in zip(jobs, cfgs):
+        _assert_tenant_matches_solo(job, cfg)
+
+
+def test_batched_run_checkpoints_per_tenant(tmp_path):
+    """A coalesced batch writes each tenant its OWN checkpoint (sliced
+    chain rows), so a preempted service resumes per job — and a job
+    with an existing checkpoint is never coalesced again."""
+    ck = tmp_path / "ckpt"
+    cfgs = [ExperimentConfig(alignment=al, seed=3 + al, checkpoint_every=60,
+                             **HEX) for al in (0, 1)]
+    svc = SweepService(outdir=str(tmp_path), checkpoint_dir=str(ck))
+    jobs = [svc.submit(c) for c in cfgs]
+    svc.run_until_idle()
+    assert [j.status for j in jobs] == ["done", "done"]
+    for cfg in cfgs:
+        assert (ck / f"{cfg.tag}.npz").exists()
+    # with checkpoints on disk, a resubmission runs solo (fresh service)
+    svc2 = SweepService(outdir=str(tmp_path), checkpoint_dir=str(ck))
+    j2 = [svc2.submit(c) for c in cfgs]
+    svc2.run_until_idle()
+    assert [j.status for j in j2] == ["done", "done"]
+    assert len(svc2.batch_stats) == 2  # two solo singletons, no coalescing
+
+
+# ---------------------------------------------------------------------------
+# compile amortization
+# ---------------------------------------------------------------------------
+
+def test_second_identical_submission_compiles_nothing(tmp_path):
+    """The event-stream proof (ISSUE 9 acceptance): after a first batch
+    compiles, a later tenant with the same lowering signature and batch
+    shape produces ZERO compile and ZERO compile_cache_miss events."""
+    ev = tmp_path / "events.jsonl"
+    rec = obs.Recorder(str(ev))
+    svc = SweepService(outdir=str(tmp_path), recorder=rec)
+    first = svc.submit(ExperimentConfig(alignment=0, seed=3, **HEX))
+    svc.run_until_idle()
+    n_before = len(ev.read_text().splitlines())
+    second = svc.submit(ExperimentConfig(alignment=1, seed=9, **HEX))
+    svc.run_until_idle()
+    rec.close()
+    assert first.status == "done" and second.status == "done"
+    tail = [json.loads(line)
+            for line in ev.read_text().splitlines()[n_before:]]
+    kinds = [e["event"] for e in tail]
+    assert "compile_cache_hit" in kinds
+    assert "compile_cache_miss" not in kinds
+    assert "compile" not in kinds
+
+
+def test_compile_cache_index_survives_restart(tmp_path):
+    cache_dir = tmp_path / "cache"
+    c1 = CompileCache(str(cache_dir))
+    key = CompileCache.key("abc123", 8, 100, 50)
+    assert c1.check(key, kernel_path="lowered_bits") is False
+    assert c1.check(key, kernel_path="lowered_bits") is True
+    # a fresh process (new instance) loads the persisted index
+    c2 = CompileCache(str(cache_dir))
+    assert c2.check(key, kernel_path="lowered_bits") is True
+    assert len(c2) == 1
+    # in-memory-only caches forget across instances
+    c3 = CompileCache()
+    assert c3.check(key, kernel_path="lowered_bits") is False
+
+
+# ---------------------------------------------------------------------------
+# failure taxonomy + heartbeats
+# ---------------------------------------------------------------------------
+
+def test_poison_job_quarantined_batch_unharmed(tmp_path):
+    ev = tmp_path / "events.jsonl"
+    rec = obs.Recorder(str(ev))
+    svc = SweepService(outdir=str(tmp_path), recorder=rec,
+                       heartbeat=str(tmp_path / "heartbeat.json"),
+                       policy=RetryPolicy(backoff_base_s=0.01))
+    good = [svc.submit(ExperimentConfig(alignment=al, seed=3 + al, **HEX))
+            for al in (0, 1)]
+    # base=0.5 keeps the poison tag distinct from good[0]'s
+    poison = svc.submit(ExperimentConfig(
+        alignment=0, **{**HEX, "base": 0.5, "backend": "python"}))
+    svc.run_until_idle()
+    rec.close()
+    assert [j.status for j in good] == ["done", "done"]
+    assert poison.status == "quarantined"
+    assert poison.solo  # retried in isolation, not inside a batch
+    assert svc.exit_code != 0
+    kinds = [json.loads(line)["event"]
+             for line in ev.read_text().splitlines()]
+    assert kinds.count("compile_cache_miss") == 1
+    assert kinds.count("config_quarantined") == 1
+    assert kinds.count("retry") == 1
+
+
+def test_transient_fault_retries_solo_and_completes(tmp_path):
+    """An injected transient fault fails the batch attempt; both members
+    retry SOLO (isolation first) and complete."""
+    from flipcomplexityempirical_tpu.resilience import faults as rfaults
+
+    rfaults.install_from_spec("segment.step:once")
+    try:
+        svc = SweepService(outdir=str(tmp_path),
+                           policy=RetryPolicy(backoff_base_s=0.01))
+        jobs = [svc.submit(ExperimentConfig(alignment=al, seed=3 + al,
+                                            **HEX))
+                for al in (0, 1)]
+        svc.run_until_idle()
+    finally:
+        rfaults.install_plan(None)
+    assert [j.status for j in jobs] == ["done", "done"], \
+        [(j.tag, j.error) for j in jobs]
+    assert all(j.attempts == 2 and j.solo for j in jobs)
+    # the solo reruns are still bit-identical to clean solo runs
+    for job in jobs:
+        _assert_tenant_matches_solo(job, job.config)
+
+
+def test_namespaced_heartbeats_and_merged_summary(tmp_path):
+    hb = tmp_path / "heartbeat.json"
+    svc = SweepService(outdir=str(tmp_path), heartbeat=str(hb))
+    jobs = [svc.submit(ExperimentConfig(alignment=al, seed=3 + al, **HEX))
+            for al in (0, 1)]
+    svc.run_until_idle()
+    merged = json.loads(hb.read_text())
+    assert merged["status"] == "complete"
+    assert set(merged["jobs"]) == {j.tag for j in jobs}
+    for j in jobs:
+        entry = merged["jobs"][j.tag]
+        assert entry["status"] == "done"
+        assert entry["batch"] == j.batch
+        per_job = tmp_path / f"heartbeat.{j.tag}.json"
+        assert per_job.exists()
+        assert json.loads(per_job.read_text())["status"] == "done"
+
+
+def test_obs_report_probes_namespaced_heartbeats(tmp_path):
+    """The extended check_heartbeat follows a service summary's running
+    jobs into their per-batch files and applies the staleness rule
+    there."""
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from obs_report import check_heartbeat
+    finally:
+        sys.path.pop(0)
+    base = tmp_path / "heartbeat.json"
+    batch = tmp_path / "heartbeat.b0000.json"
+    base.write_text(json.dumps({
+        "status": "running",
+        "jobs": {"2B30P10": {"status": "running", "batch": "b0000"},
+                 "1B30P10": {"status": "done"}}}))
+    batch.write_text(json.dumps({"status": "running"}))
+    assert check_heartbeat(str(base), 300.0) is None
+    old = (os.path.getmtime(batch) - 10_000,) * 2
+    os.utime(batch, old)
+    err = check_heartbeat(str(base), 300.0)
+    assert err and "2B30P10" in err and "stale" in err
+    # completed summaries never probe (a finished service stops
+    # refreshing by design)
+    base.write_text(json.dumps({"status": "complete_with_failures",
+                                "jobs": {}}))
+    assert check_heartbeat(str(base), 300.0) is None
+
+
+# ---------------------------------------------------------------------------
+# simulation mode + CI gate
+# ---------------------------------------------------------------------------
+
+def test_simulation_tenant_efficiency_floor(tmp_path):
+    """The ISSUE 9 acceptance floor: 4 tenants sharing one device via
+    coalescing sustain >= 80% of a solo tenant's end-to-end throughput
+    (one compile serves the whole batch). Runs the CLI in a fresh
+    process: the efficiency prices each round's own compile, so the
+    pytest process's warm jit cache must not leak into the solo leg."""
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "flipcomplexityempirical_tpu.service",
+         "--simulate", "--out", str(tmp_path), "--steps", "120"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    record = json.loads(r.stdout.strip().splitlines()[-1])
+    assert record["metric"] == "tenant_efficiency"
+    assert record["tenants"] == 4
+    assert record["value"] >= 0.8, record
+    # the record is bench_compare-gateable as-is
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from bench_compare import extract_metrics
+    finally:
+        sys.path.pop(0)
+    metrics = extract_metrics(record)
+    assert metrics == {"tenant_efficiency[tenants=4]": record["value"]}
+
+
+def test_service_check_gate_passes():
+    """make service-check: the coalescing + quarantine + event-stream
+    smoke as one script, tier-1 so the service contract gates every
+    commit."""
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "service_check.sh")],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "service-check: OK" in r.stdout

@@ -62,7 +62,14 @@ def extract_metrics(doc, out: dict | None = None) -> dict:
         out = {}
     if isinstance(doc, dict):
         if "metric" in doc and isinstance(doc.get("value"), (int, float)):
-            out[str(doc["metric"])] = float(doc["value"])
+            name = str(doc["metric"])
+            if "tenants" in doc:
+                # sweep-service records (bench --service): a 4-tenant
+                # and an 8-tenant efficiency measure different
+                # coalescing shapes — qualify so they never gate
+                # against each other
+                name += f"[tenants={doc['tenants']}]"
+            out[name] = float(doc["value"])
             if isinstance(doc.get("flips_per_s_per_chip"), (int, float)):
                 # multi-chip headline: the per-chip figure is the one
                 # that gates across differing device counts

@@ -501,11 +501,27 @@ def report_resilience(events, out):
               file=out)
 
 
+def _namespaced_heartbeat_path(path: str, tag: str) -> str:
+    # mirror of experiments.driver.heartbeat_path_for (this tool must
+    # stay importable without jax): heartbeat.json + 2B30P10 ->
+    # heartbeat.2B30P10.json
+    root, ext = os.path.splitext(path)
+    return f"{root}.{tag}{ext or '.json'}"
+
+
 def check_heartbeat(path: str, interval_s: float):
     """Stale-heartbeat probe: returns an error string when the heartbeat
     file is missing, unparsable, or its mtime is older than 2x the
     expected refresh interval — unless its payload says the sweep
-    finished (a completed sweep stops refreshing by design)."""
+    finished (a completed sweep stops refreshing by design).
+
+    A sweep-SERVICE heartbeat (payload carries a ``jobs`` map — see
+    service.scheduler) is a merged summary refreshed at job
+    transitions, not on the segment cadence; real liveness lives in the
+    per-job/per-batch files next to it (``heartbeat.<tag>.json`` /
+    ``heartbeat.<batch>.json``). For each non-terminal job the probe
+    follows the namespaced sibling — preferring the batch file the job
+    is running in — and applies the same staleness rule there."""
     import time as _time
 
     try:
@@ -517,6 +533,32 @@ def check_heartbeat(path: str, interval_s: float):
     status = str(payload.get("status", ""))
     if status.startswith("complete"):
         return None
+    jobs = payload.get("jobs")
+    if isinstance(jobs, dict):
+        errors = []
+        running = False
+        for tag, entry in sorted(jobs.items()):
+            if not isinstance(entry, dict):
+                continue
+            if str(entry.get("status", "")) != "running":
+                # queued/retrying jobs have no refresh loop of their
+                # own; their liveness is the summary's (checked below)
+                continue
+            running = True
+            # the batch file carries the segment-cadence refreshes; the
+            # per-job file exists from dispatch (fallback when the
+            # batch has not produced a live-hook refresh yet)
+            names = [str(entry["batch"])] if entry.get("batch") else []
+            names.append(tag)
+            errs = [check_heartbeat(
+                        _namespaced_heartbeat_path(path, n), interval_s)
+                    for n in names]
+            if all(errs):
+                errors.append(f"job {tag}: {errs[0]}")
+        if errors:
+            return "; ".join(errors)
+        if running:
+            return None
     age = _time.time() - mtime
     if age > 2 * interval_s:
         return (f"heartbeat {path}: stale — last refreshed {age:.0f}s "

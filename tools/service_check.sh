@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Sweep-service CI gate (`make service-check`): three jobs — two
+# coalescible tenants and one poison config — must produce exactly one
+# coalesced batch (ONE compile_cache_miss for the pair: the second
+# tenant rides the first's compile), a quarantined poison job with the
+# survivors unharmed, a valid merged event stream (obs_report --check),
+# and a probeable namespaced heartbeat set (ISSUE 9). The full matrix —
+# bit-identity vs solo runs, retry/backoff taxonomy, simulation-mode
+# efficiency — lives in tests/test_service.py; this is the fast tier-1
+# smoke (<30s on CPU).
+#
+#   tools/service_check.sh
+#
+# Exercised by tests/test_service.py, so tier-1 fails when the gate rots.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+TD="$(mktemp -d)"
+trap 'rm -rf "$TD"' EXIT
+
+JAX_PLATFORMS=cpu "$PY" - "$TD" <<'PYEOF'
+import json
+import os
+import sys
+from collections import Counter
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
+from flipcomplexityempirical_tpu.service import SweepService
+
+td = sys.argv[1]
+ev = os.path.join(td, "events.jsonl")
+rec = obs.Recorder(ev)
+svc = SweepService(outdir=td, recorder=rec,
+                   heartbeat=os.path.join(td, "heartbeat.json"))
+# two coalescible tenants: same fingerprint (kernel statics), distinct
+# tags/plans/seeds; the poison job demands the python backend, which the
+# service rejects deterministically -> quarantine after the solo retry
+base = dict(family="frank", base=0.3, pop_tol=0.1, total_steps=120,
+            n_chains=2, backend="jax")
+a = svc.submit(ExperimentConfig(alignment=2, seed=3, **base))
+b = svc.submit(ExperimentConfig(alignment=1, seed=7, **base))
+p = svc.submit(ExperimentConfig(alignment=0,
+                                **{**base, "backend": "python"}))
+assert a.fingerprint == b.fingerprint, "pair must coalesce"
+assert p.fingerprint != a.fingerprint, "poison must not coalesce"
+svc.run_until_idle()
+rec.close()
+
+assert a.status == "done" and b.status == "done", (a.error, b.error)
+assert a.batch == b.batch, "pair did not share a batch"
+assert p.status == "quarantined", (p.status, p.error)
+assert svc.exit_code != 0, "quarantine must surface in the exit code"
+
+evs = [json.loads(line) for line in open(ev)]
+c = Counter(e["event"] for e in evs)
+assert c["job_submitted"] == 3 and c["job_done"] == 3, dict(c)
+# the amortization proof: ONE miss covers both tenants, and the poison
+# job dies before ever reaching the compile probe
+assert c["compile_cache_miss"] == 1, dict(c)
+assert c.get("compile_cache_hit", 0) == 0, dict(c)
+assert c["config_quarantined"] == 1 and c["retry"] == 1, dict(c)
+batched = [e for e in evs if e["event"] == "job_batched"]
+assert len(batched) == 1, batched
+assert sorted(batched[0]["jobs"]) == sorted([a.job_id, b.job_id]), batched
+assert batched[0]["chains"] == 4, batched
+
+hb = json.load(open(os.path.join(td, "heartbeat.json")))
+assert hb["status"] == "complete_with_failures", hb["status"]
+assert {j["status"] for j in hb["jobs"].values()} == \
+    {"done", "quarantined"}, hb["jobs"]
+per_job = sorted(f for f in os.listdir(td) if f.startswith("heartbeat."))
+assert f"heartbeat.{a.tag}.json" in per_job, per_job
+print("service-check: 1 batch, 1 compile_cache_miss, poison "
+      f"quarantined ({dict(c)})")
+PYEOF
+
+"$PY" tools/obs_report.py "$TD/events.jsonl" --check
+"$PY" tools/obs_report.py "$TD/events.jsonl" \
+    --heartbeat "$TD/heartbeat.json" >/dev/null
+echo "service-check: OK"

@@ -36,6 +36,11 @@ run_bench sec11_c16384 1800 --graph sec11 --chains 16384
 run_bench general 900 --general
 # 7. ESS with thinning (record_every ~ IAT)
 run_bench ess_thin 900 --ess --record-every 10
+# 8. Sweep-service tenant efficiency (round 9): 4 coalescible tenants
+#    drained as one batch vs a solo tenant, compile included — on-chip
+#    this prices both the compile amortization AND the device's real
+#    batch-occupancy headroom (CPU simulation can only show the former)
+run_bench service 900 --service --graph frank --steps 2001
 touch bench_runs/CAPTURED_${TS}
 commit_retry bench_runs/CAPTURED_${TS}
 echo "capture set complete: ${TS}"
