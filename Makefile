@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test obs-check mesh-check chaos-check bitpack-check \
-	service-check lint
+	service-check preempt-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -38,6 +38,13 @@ bitpack-check:
 # poison job, and a valid merged event stream + namespaced heartbeats
 service-check:
 	PYTHON=$(PYTHON) tools/service_check.sh
+
+# preemption gate: SIGTERM mid-batch must drain (exit 3), journal the
+# requeues, and a recovered process must finish with per-tenant results
+# byte-identical to uninterrupted runs — board AND general paths, plus
+# a torn-journal-tail detection/repair leg
+preempt-check:
+	PYTHON=$(PYTHON) JAX_PLATFORMS=cpu tools/preempt_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

@@ -44,7 +44,7 @@ from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
 from ..sampling.tempering import chain_rungs
-from .mesh import CHAINS_AXIS
+from .mesh import CHAINS_AXIS, make_mesh, shard_chain_batch
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
@@ -447,6 +447,122 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
                  devices=n_dev, swaps=swaps,
                  accept_rate=info["accept_rate"], metrics=snap)
         run_span.end(flips=flips, wall_s=wall_total)
+    return params, states, info
+
+
+def largest_pow2(n: int) -> int:
+    """Largest power of two <= n (>= 1)."""
+    if n < 1:
+        raise ValueError(f"need at least one device, got {n}")
+    return 1 << (int(n).bit_length() - 1)
+
+
+def reshard_down(states, mesh, lost: int = 1, axis: str = CHAINS_AXIS):
+    """Re-place a chain-state tree onto the surviving power-of-two
+    sub-mesh after ``lost`` devices dropped out of ``mesh``. Returns
+    ``(new_mesh, placed_states)``.
+
+    The collectives (all_gather ladders, psum telemetry) assume a
+    power-of-two device axis, and the chain count divides the original
+    (power-of-two) mesh — so it divides every power-of-two sub-mesh
+    too: shrinking never strands chains, it only deepens the per-device
+    ladder. Leaves are snapshotted to host first (their old placements
+    may reference the lost devices) and re-placed with the same
+    leading-axis discipline as the original sharding."""
+    n = _mesh_size(mesh)
+    target = largest_pow2(max(1, n - max(1, int(lost))))
+    if target >= n:
+        raise ValueError(
+            f"reshard_down: {n}-device mesh cannot shed {lost} "
+            f"device(s) into a smaller power-of-two sub-mesh")
+    new_mesh = make_mesh(target, axis=axis)
+    host = jax.tree.map(np.asarray, states)
+    return new_mesh, shard_chain_batch(new_mesh, host, axis)
+
+
+def run_sharded_elastic(make_step, mesh, params, states, *, rounds: int,
+                        inner_steps: int, key=None, recorder=None,
+                        segment_rounds: int | None = None):
+    """``run_sharded`` with elastic mesh recovery: when a segment fails
+    with a device-loss error (``resilience.degrade.is_device_loss`` —
+    injected ``compile`` faults stand in on CPU), the run reshards onto
+    the surviving power-of-two sub-mesh and REPLAYS that segment from
+    its host snapshot — the in-memory form of "resume the checkpoint on
+    the survivors". ``make_step(mesh) -> _ShardedStep`` rebuilds the
+    step for each mesh (telemetry re-tags itself: the resumed
+    ``run_start``/``run_end`` events carry the new device count).
+
+    Rounds run in segments of ``segment_rounds`` (default: one segment)
+    with a host snapshot of (params, states) taken at each segment
+    boundary — the snapshot is the recovery point, so at most one
+    segment of work replays. Per-segment keys are ``fold_in(key, seg)``:
+    a replayed segment reuses its own key, so the degraded run replays
+    the identical segment decisions on fewer devices.
+
+    Returns ``(params, states, info)`` where info aggregates the
+    segments; after any reshard it carries ``degraded: True`` plus a
+    ``mesh_degradations`` list — ``tools/bench_compare.py`` refuses to
+    gate records marked this way, exactly like kernel-path
+    degradations. A failure on a 1-device mesh (nothing left to shed)
+    re-raises."""
+    rec = obs.resolve_recorder(recorder)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    step = make_step(mesh)
+    seg_rounds = segment_rounds or rounds
+    bounds = [(r, min(seg_rounds, rounds - r))
+              for r in range(0, rounds, seg_rounds)]
+    total_info = {"accepts": 0, "swaps": 0, "flips": 0, "wall_s": 0.0}
+    degradations: list = []
+    seg = 0
+    while seg < len(bounds):
+        start, n_rounds = bounds[seg]
+        # host snapshot = the recovery point for this segment
+        snap_params = jax.tree.map(np.asarray, params)
+        snap_states = jax.tree.map(np.asarray, states)
+        seg_key = jax.random.fold_in(key, seg)  # graftlint: disable=G002(per-segment fold_in; a replayed segment must reuse its own key)
+        try:
+            params, states, info = run_sharded(
+                step, params, states, rounds=n_rounds,
+                inner_steps=inner_steps, key=seg_key, recorder=rec)
+        except Exception as e:
+            n_dev = _mesh_size(step.mesh)
+            if not rdegrade.is_device_loss(e) or n_dev <= 1:
+                raise
+            new_mesh, states = reshard_down(snap_states, step.mesh)
+            params = shard_chain_batch(new_mesh, snap_params)
+            to_dev = _mesh_size(new_mesh)
+            reason = rdegrade.describe_error(e)
+            degradations.append({"from_devices": n_dev,
+                                 "to_devices": to_dev,
+                                 "reason": reason, "segment": seg,
+                                 "round": start})
+            if rec:
+                rec.emit("mesh_degraded", from_devices=n_dev,
+                         to_devices=to_dev, reason=reason,
+                         segment=seg, round=start)
+            step = make_step(new_mesh)
+            continue            # replay the same segment, same key
+        total_info["accepts"] += info["accepts"]
+        total_info["swaps"] += info["swaps"]
+        total_info["flips"] += info["flips"]
+        total_info["wall_s"] += info["wall_s"]
+        seg += 1
+    n_dev = _mesh_size(step.mesh)
+    fps = total_info["flips"] / max(total_info["wall_s"], 1e-12)
+    info = {
+        **total_info,
+        "rounds": rounds,
+        "inner_steps": inner_steps,
+        "chains": int(states.accept_count.shape[0]),
+        "devices": n_dev,
+        "kernel_path": step.kernel_path,
+        "flips_per_s": fps,
+        "flips_per_s_per_chip": fps / max(n_dev, 1),
+    }
+    if degradations:
+        info["degraded"] = True
+        info["mesh_degradations"] = degradations
     return params, states, info
 
 

@@ -32,6 +32,16 @@ from ..resilience import degrade as rdegrade
 from ..resilience import faults as rfaults
 from ..resilience.errors import CheckpointIdentityError, KernelPathError
 from ..resilience.supervisor import check_deadline
+
+
+def _check_drain(tag: str) -> None:
+    """Cooperative drain point at the segment boundary, next to
+    check_deadline. Late import: service -> scheduler -> driver is the
+    existing import chain, so driver cannot import the service package
+    at module level (lifecycle itself only touches resilience/obs)."""
+    from ..service.lifecycle import check_drain
+
+    check_drain(tag)
 from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
                       square_grid, triangular_lattice, hex_lattice,
                       stripes_plan, from_geojson, synthetic_precincts,
@@ -267,6 +277,7 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     segments = 0
     while done < total:
         check_deadline()
+        _check_drain(cfg.tag)
         rfaults.fault_point("segment.step", tag=cfg.tag, done=done)
         n = min(every, total - done)
         if use_board:
@@ -497,6 +508,7 @@ def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
     res = None
     while done < total:
         check_deadline()
+        _check_drain(cfg.tag)
         rfaults.fault_point("segment.step", tag=cfg.tag, done=done)
         n = min(every, total - done)
         last = done + n >= total

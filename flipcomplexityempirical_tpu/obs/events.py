@@ -105,6 +105,28 @@ Core field semantics:
   this (kernel, batch shape) pays XLA compilation in this process (and
   seeds the persistent on-disk cache when ``--compile-cache`` is set);
   a hit means the jit/persistent cache serves it.
+- ``service_draining``: the service saw a drain request (SIGTERM/SIGINT
+  or an injected ``sigterm`` fault) and stopped at a segment boundary
+  after checkpointing the in-flight batch's tenants. ``reason`` names
+  the trigger. The process exits with the distinct drain code (3) so
+  an orchestrator restarts it with ``SweepService.recover``.
+- ``service_recovered``: ``SweepService.recover`` rebuilt a queue from
+  a journal: ``n_jobs`` total jobs reconstructed, ``n_requeued`` the
+  DONE-less jobs put back in the runnable queue.
+- ``journal_truncated``: the journal's tail failed integrity (torn
+  JSON line, SHA-256 mismatch, or a sequence-number gap). ``dropped``
+  records were discarded; recovery proceeded from the last intact
+  record.
+- ``dispatch_stalled``: the hung-dispatch watchdog saw a device call
+  exceed its timeout (``--dispatch-timeout``, or scaled from the p95
+  segment latency in the metrics registry). The batch is journaled as
+  poison-suspect, so on restart its jobs retry SOLO. ``--strict``
+  report mode fails on this event.
+- ``mesh_degraded``: a sharded run lost devices mid-run and resumed on
+  the surviving power-of-two sub-mesh (``from_devices`` ->
+  ``to_devices``). Bench records from such a run carry
+  ``degraded: true`` and are refused by ``tools/bench_compare.py``
+  gating.
 
 Adding a new event *type* (as ``diag``/``anomaly`` were added) does NOT
 bump SCHEMA_VERSION: readers fold by type and validation rejects only
@@ -228,6 +250,31 @@ EVENT_REGISTRY = {
         "fields": ("key", "kernel_path"),
         "doc": "new batch signature: this dispatch pays XLA "
                "compilation and seeds the persistent cache",
+    },
+    "service_draining": {
+        "fields": ("reason",),
+        "doc": "drain request honored at a segment boundary; in-flight "
+               "tenants checkpointed, process exits with the drain code",
+    },
+    "service_recovered": {
+        "fields": ("path", "n_jobs", "n_requeued"),
+        "doc": "SweepService.recover rebuilt the queue from a journal",
+    },
+    "journal_truncated": {
+        "fields": ("path", "dropped"),
+        "doc": "journal tail failed integrity (torn line / sha256 "
+               "mismatch / seq gap); dropped records discarded and "
+               "recovery proceeded from the last intact record",
+    },
+    "dispatch_stalled": {
+        "fields": ("batch_id", "timeout_s", "waited_s"),
+        "doc": "watchdog saw a device call exceed its timeout; batch "
+               "journaled poison-suspect so its jobs retry solo",
+    },
+    "mesh_degraded": {
+        "fields": ("from_devices", "to_devices", "reason"),
+        "doc": "sharded run resumed on the surviving power-of-two "
+               "sub-mesh; bench records marked degraded",
     },
 }
 

@@ -24,10 +24,11 @@ from .faults import (ENV_VAR, SITES, FaultPlan, FaultRule, InjectedFault,
                      active_plan, corrupt_file, fault_point,
                      install_from_env, install_from_spec, install_plan,
                      truncate_file)
-from .degrade import (DEGRADATIONS, is_kernel_error, next_board_body,
-                      record_degradation)
-from .supervisor import (DETERMINISTIC, RESOURCE, TRANSIENT, RetryPolicy,
-                         SweepReport, check_deadline, classify_error,
+from .degrade import (DEGRADATIONS, is_device_loss, is_kernel_error,
+                      next_board_body, record_degradation)
+from .supervisor import (DETERMINISTIC, RESOURCE, TRANSIENT,
+                         DeadlineScope, RetryPolicy, SweepReport,
+                         check_deadline, classify_error,
                          clear_deadline, run_supervised_sweep,
                          set_deadline)
 
@@ -37,9 +38,9 @@ __all__ = [
     "ENV_VAR", "SITES", "FaultPlan", "FaultRule", "InjectedFault",
     "active_plan", "corrupt_file", "fault_point", "install_from_env",
     "install_from_spec", "install_plan", "truncate_file",
-    "DEGRADATIONS", "is_kernel_error", "next_board_body",
-    "record_degradation",
-    "DETERMINISTIC", "RESOURCE", "TRANSIENT", "RetryPolicy",
-    "SweepReport", "check_deadline", "classify_error", "clear_deadline",
-    "run_supervised_sweep", "set_deadline",
+    "DEGRADATIONS", "is_device_loss", "is_kernel_error",
+    "next_board_body", "record_degradation",
+    "DETERMINISTIC", "RESOURCE", "TRANSIENT", "DeadlineScope",
+    "RetryPolicy", "SweepReport", "check_deadline", "classify_error",
+    "clear_deadline", "run_supervised_sweep", "set_deadline",
 ]

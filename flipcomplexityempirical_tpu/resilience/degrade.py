@@ -46,6 +46,26 @@ def is_kernel_error(exc: BaseException) -> bool:
                for k in type(exc).__mro__)
 
 
+# message markers for device loss: jax surfaces a dead/preempted chip as
+# runtime-error text (UNAVAILABLE / FAILED_PRECONDITION grpc statuses),
+# not a dedicated class.
+_DEVICE_LOSS_MARKERS = ("device", "unavailable", "failed precondition",
+                        "data loss", "connection reset", "socket closed")
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Does this exception mean devices dropped out from under a sharded
+    run (so the mesh itself must shrink, not just the kernel body)?
+    Every kernel error qualifies — a chip that can no longer execute the
+    body is indistinguishable from a lost chip at this layer, and
+    resharding onto the survivors is the recovery either way — plus the
+    runtime-error texts jax uses for dead/preempted devices."""
+    if is_kernel_error(exc):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
+
 def next_board_body(path: str):
     """The next body down *within the board family*, or None when the
     fall must leave the family (KernelPathError -> general rerun).

@@ -17,6 +17,17 @@ compile            sampling.board_runner / distribute.sharded, before
                    compile/runtime error to exercise degradation)
 recorder.emit      obs.recorder.Recorder.emit (telemetry sink I/O)
 heartbeat.write    driver.write_heartbeat (must be non-fatal)
+sigterm            service.lifecycle.check_drain (an armed rule stands
+                   in for a real SIGTERM: the drain flag is raised at
+                   that segment boundary, so preemption drains are
+                   byte-reproducible — ``sigterm:once@HIT`` picks the
+                   boundary)
+journal.append     service.journal.Journal.append (raise before the
+                   write; truncate rules tear the journal tail after
+                   it — the torn-tail detection path)
+dispatch.stall     service.lifecycle.DispatchWatchdog.stall_point (a
+                   firing rule holds the dispatch past the watchdog
+                   timeout, then surfaces as the killed hung call)
 =================  ====================================================
 
 Plan grammar (CLI ``--faults`` / env ``GRAFT_FAULTS``), comma-separated
@@ -54,7 +65,8 @@ from typing import Optional
 ENV_VAR = "GRAFT_FAULTS"
 
 SITES = ("checkpoint.write", "checkpoint.load", "segment.step",
-         "compile", "recorder.emit", "heartbeat.write")
+         "compile", "recorder.emit", "heartbeat.write",
+         "sigterm", "journal.append", "dispatch.stall")
 
 _RAISING_MODES = ("fail", "always", "p")
 

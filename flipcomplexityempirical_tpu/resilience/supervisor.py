@@ -21,11 +21,17 @@ Everything here is host-side between segments: backoff sleeps, deadline
 checks and event emission never touch the device, so the
 no-added-syncs guard-rail (PROFILE.md) is untouched.
 
-The wall-clock watchdog is cooperative: ``set_deadline`` arms a
-monotonic budget and the driver's segment loops call
-``check_deadline()`` between segments — a JAX dispatch cannot be
+The wall-clock watchdog is cooperative: a ``DeadlineScope`` arms a
+monotonic budget for ONE supervision and the driver's segment loops
+call ``check_deadline()`` between segments — a JAX dispatch cannot be
 interrupted mid-flight, but a segment is bounded (checkpoint_every
-steps), which bounds the overshoot.
+steps), which bounds the overshoot. Scopes are tracked by identity in
+a registry of *all* active supervisions, so two jobs supervised in the
+same process (the sweep service interleaves them) cannot clobber each
+other's budget — ending one scope never disarms another, and
+``check_deadline`` raises for whichever active scope expired.
+``set_deadline``/``clear_deadline`` remain as LIFO wrappers for
+call sites that own the whole process.
 """
 
 from __future__ import annotations
@@ -78,28 +84,73 @@ def classify_error(exc: BaseException, anomalies=()) -> str:
 
 
 # ---------------------------------------------------------------------
-# cooperative per-config deadline
+# cooperative per-supervision deadlines
+#
+# Every active supervision holds its own DeadlineScope; the registry
+# below tracks them by object identity. The historical single module
+# slot meant two interleaved supervisions clobbered each other (job B's
+# set_deadline(None) silently disarmed job A's budget) — with identity
+# tracking, ending one scope can only ever remove that scope.
 
-_deadline = None  # (monotonic end, budget_s, tag) or None
+_active_deadlines: list = []          # DeadlineScope objects, any order
+_legacy_deadlines: list = []          # scopes opened via set_deadline
+
+
+class DeadlineScope:
+    """One supervision's wall-clock budget. ``begin`` arms it on the
+    monotonic clock and registers it; ``end`` unregisters (idempotent).
+    A None/0 budget is a valid unarmed scope — it participates in the
+    begin/end pairing without ever expiring."""
+
+    def __init__(self, budget_s: Optional[float], tag: str = ""):
+        self.budget_s = float(budget_s) if budget_s else None
+        self.tag = tag
+        self._end = None
+
+    def begin(self) -> "DeadlineScope":
+        if self.budget_s is not None:
+            self._end = time.monotonic() + self.budget_s
+        _active_deadlines.append(self)
+        return self
+
+    def end(self) -> None:
+        try:
+            _active_deadlines.remove(self)
+        except ValueError:
+            pass
+
+    def expired(self) -> bool:
+        return self._end is not None and time.monotonic() > self._end
+
+    def __enter__(self) -> "DeadlineScope":
+        return self.begin()
+
+    def __exit__(self, *exc) -> None:
+        self.end()
 
 
 def set_deadline(budget_s: Optional[float], tag: str = ""):
-    global _deadline
-    _deadline = ((time.monotonic() + budget_s, float(budget_s), tag)
-                 if budget_s else None)
+    """LIFO wrapper over DeadlineScope for single-supervision callers
+    (CLI paths, tests). Interleaved supervisions must hold their own
+    scope objects instead. Returns the opened scope."""
+    scope = DeadlineScope(budget_s, tag).begin()
+    _legacy_deadlines.append(scope)
+    return scope
 
 
 def clear_deadline():
-    set_deadline(None)
+    """Close the most recent set_deadline scope (no-op when none is
+    open, so historical double-clear call sites stay harmless)."""
+    if _legacy_deadlines:
+        _legacy_deadlines.pop().end()
 
 
 def check_deadline():
-    """Called by the driver's segment loops between segments."""
-    if _deadline is None:
-        return
-    end, budget_s, tag = _deadline
-    if time.monotonic() > end:
-        raise ConfigDeadlineExceeded(tag, budget_s)
+    """Called by the driver's segment loops between segments: raises
+    for whichever active supervision's budget expired."""
+    for scope in list(_active_deadlines):
+        if scope.expired():
+            raise ConfigDeadlineExceeded(scope.tag, scope.budget_s)
 
 
 # ---------------------------------------------------------------------
@@ -176,6 +227,7 @@ def run_supervised_sweep(configs, outdir: str,
     sweep_span = obs.span(rec, "sweep", n_configs=n_configs,
                           supervised=True)
     sweep_span.begin()
+    deadline = None
     try:
         for i, cfg in enumerate(configs):
             if drv.is_done(cfg, outdir):
@@ -211,12 +263,13 @@ def run_supervised_sweep(configs, outdir: str,
                                     attempt=attempts).begin()
                 hb_state, uninstall = drv.install_live_hooks(
                     rec, heartbeat, cfg, _progress())
-                set_deadline(policy.deadline_s, cfg.tag)
+                deadline = DeadlineScope(policy.deadline_s,
+                                         cfg.tag).begin()
                 try:
                     data = drv.run_config(cfg, outdir, checkpoint_dir,
                                           recorder=rec)
                 except Exception as e:
-                    clear_deadline()
+                    deadline.end()
                     uninstall()
                     klass = classify_error(
                         e, anomalies=hb_state["anomalies"])
@@ -270,7 +323,7 @@ def run_supervised_sweep(configs, outdir: str,
                         time.sleep(wait)
                     continue
                 else:
-                    clear_deadline()
+                    deadline.end()
                     uninstall()
                     report.completed.append(cfg.tag)
                     report.results.append((cfg, data))
@@ -292,7 +345,8 @@ def run_supervised_sweep(configs, outdir: str,
                                  if attempts > 1 else "") + ")")
                     break
     finally:
-        clear_deadline()
+        if deadline is not None:
+            deadline.end()   # idempotent: covers an escape mid-attempt
         sweep_span.end(n_done=len(report.completed),
                        n_skipped=len(report.skipped),
                        n_quarantined=len(report.quarantined),

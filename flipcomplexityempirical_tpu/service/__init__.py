@@ -26,17 +26,37 @@ This package turns that loop inside out:
   persisted next to JAX's on-disk compilation cache
   (``enable_persistent_cache``) so service restarts skip XLA compiles.
 
+Preemption-proofing (ISSUE 11) makes the loop durable:
+
+- ``journal.Journal`` — fsync'd write-ahead JSONL of every job/batch
+  transition (seq + per-record SHA-256; torn tails detected and
+  dropped with ``journal_truncated``), consumed by
+  ``SweepService.recover(outdir)`` to rebuild the queue after a crash.
+- ``lifecycle`` — graceful drain (SIGTERM/SIGINT -> cooperative flag
+  -> ``DrainRequested`` at segment boundaries, distinct exit code
+  ``EXIT_DRAINED``) and the ``DispatchWatchdog`` thread that journals
+  hung device dispatches as poison-suspect so a restart retries those
+  jobs solo.
+
 ``python -m flipcomplexityempirical_tpu.service --simulate`` is the
 hardware-free proof: N tenants coalesced on one device vs one tenant
 solo, reported as ``tenant_efficiency`` (also ``bench.py --service``).
 """
 
 from .cache import CompileCache, enable_persistent_cache
+from .journal import Journal
+from .lifecycle import (DispatchWatchdog, DrainController,
+                        DrainRequested, EXIT_DRAINED, check_drain,
+                        clear_drain, drain_requested, request_drain)
 from .queue import Job, JobQueue
 from .scheduler import SweepService, concat_params, concat_states
 
 __all__ = [
     "CompileCache", "enable_persistent_cache",
+    "Journal",
+    "DispatchWatchdog", "DrainController", "DrainRequested",
+    "EXIT_DRAINED", "check_drain", "clear_drain", "drain_requested",
+    "request_drain",
     "Job", "JobQueue",
     "SweepService", "concat_params", "concat_states",
 ]

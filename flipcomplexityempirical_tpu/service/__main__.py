@@ -19,6 +19,15 @@ the service instead of the one-shot driver: fingerprint-equal configs
 coalesce, failures retry/quarantine per the supervisor taxonomy, and
 the exit code is nonzero when any job ends failed/quarantined (same
 contract as the supervised experiments CLI).
+
+Preemption contract (ISSUE 11): SIGTERM/SIGINT request a graceful
+drain — in-flight work checkpoints per tenant at the next segment
+boundary, running jobs requeue, and the process exits with code 3
+(``service.EXIT_DRAINED``). ``--recover`` restarts from OUT's
+``journal.jsonl`` instead of resubmitting: done jobs stay done,
+requeued jobs resume from their last checkpoint bit-identically.
+``--dispatch-timeout`` arms the hung-dispatch watchdog explicitly
+(otherwise it scales itself from observed p95 segment latency).
 """
 
 import argparse
@@ -32,6 +41,7 @@ from ..resilience import faults as rfaults
 from ..resilience.supervisor import RetryPolicy
 from ..experiments.config import SWEEPS, ExperimentConfig
 from .cache import CompileCache, enable_persistent_cache
+from .lifecycle import DrainController
 from .scheduler import SweepService
 
 # families whose (alignment, base) grid gives coalescible-but-distinct
@@ -166,6 +176,16 @@ def main():
     ap.add_argument("--quarantine-after", type=int, default=2)
     ap.add_argument("--deadline", type=float, default=None, metavar="S",
                     help="per-batch wall budget in seconds")
+    ap.add_argument("--dispatch-timeout", type=float, default=None,
+                    metavar="S",
+                    help="hung-dispatch watchdog budget per device "
+                         "dispatch; default scales from observed p95 "
+                         "segment latency (unarmed until one exists)")
+    ap.add_argument("--recover", action="store_true",
+                    help="rebuild the queue from OUT/journal.jsonl "
+                         "instead of submitting --family configs: done "
+                         "jobs stay done, interrupted jobs resume from "
+                         "their last checkpoint")
     args = ap.parse_args()
     if args.cpu:
         import jax
@@ -196,21 +216,26 @@ def main():
                 compile_cache=compile_cache, policy=policy)
             print(json.dumps(record))
             return
-        sweep = SWEEPS[args.family]
-        configs = list(sweep(total_steps=args.steps,
-                             n_chains=args.chains, seed=args.seed,
-                             record_every=args.record_every))
-        if args.only:
-            configs = [c for c in configs if c.tag in set(args.only)]
-        svc = SweepService(outdir=args.out,
-                           checkpoint_dir=args.checkpoint_dir,
-                           recorder=rec, heartbeat=heartbeat,
-                           compile_cache=compile_cache, policy=policy,
-                           max_batch_chains=args.max_batch_chains,
-                           verbose=True)
-        for cfg in configs:
-            svc.submit(cfg)
-        svc.run_until_idle()
+        svc_kwargs = dict(checkpoint_dir=args.checkpoint_dir,
+                          recorder=rec, heartbeat=heartbeat,
+                          compile_cache=compile_cache, policy=policy,
+                          max_batch_chains=args.max_batch_chains,
+                          dispatch_timeout=args.dispatch_timeout,
+                          verbose=True)
+        if args.recover:
+            svc = SweepService.recover(args.out, **svc_kwargs)
+        else:
+            sweep = SWEEPS[args.family]
+            configs = list(sweep(total_steps=args.steps,
+                                 n_chains=args.chains, seed=args.seed,
+                                 record_every=args.record_every))
+            if args.only:
+                configs = [c for c in configs if c.tag in set(args.only)]
+            svc = SweepService(outdir=args.out, **svc_kwargs)
+            for cfg in configs:
+                svc.submit(cfg)
+        with DrainController():
+            svc.run_until_idle()
         sys.exit(svc.exit_code)
 
 

@@ -1,0 +1,243 @@
+"""Write-ahead job journal: the service's queue, made crash-durable.
+
+PR 9's ``JobQueue`` is an in-memory list — a preemption forgets every
+submission and every terminal verdict. The journal records each
+job/batch state transition as one JSONL record *before* the transition
+takes effect (write-ahead), so ``SweepService.recover`` can rebuild the
+queue after a crash: DONE jobs stay done, RUNNING jobs requeue from
+their last checkpoint, poison-suspect batches requeue SOLO.
+
+Durability discipline matches PR 7's checkpoints: every append is
+flushed and fsync'd before the mutation it describes proceeds, and the
+record carries integrity metadata so a torn tail (the write the
+preemption interrupted) is *detected*, not misread:
+
+- ``seq``: contiguous 0-based sequence number — a gap means records
+  were lost in the middle, which invalidates everything after it;
+- ``sha256``: hex digest of the record's canonical JSON (sorted keys,
+  compact separators, digest field excluded) — a torn or bit-rotted
+  line fails this before it can corrupt recovery.
+
+``Journal.read`` returns the longest intact prefix plus a truncation
+flag; recovery drops the tail and emits ``journal_truncated``. The
+``journal.append`` fault site raises before the write (a crash *before*
+journaling) and its truncate rules tear the file after it (a crash
+*during* journaling) — both halves of the torn-tail story are
+chaos-testable on CPU.
+
+Record kinds (the scheduler is the only writer):
+
+==================   ==================================================
+kind                 meaning
+==================   ==================================================
+job_submitted        queue accepted a config; carries the full
+                     ExperimentConfig dict so recovery can rebuild the
+                     Job without the caller resubmitting
+batch_started        a coalesced batch began executing; members are
+                     RUNNING until a terminal/requeue record follows
+job_done             terminal: completed
+job_failed           terminal: retry budget exhausted
+job_quarantined      terminal: poison config isolated
+job_requeued         back to QUEUED (retry backoff or drain); carries
+                     the solo flag and det_failures so recovery
+                     preserves the supervisor taxonomy state
+batch_poison_suspect the watchdog marked this batch's dispatch as hung;
+                     on recovery its jobs retry SOLO
+service_draining     drain request honored; RUNNING members of any
+                     open batch were checkpointed and requeued
+==================   ==================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ..experiments.config import ExperimentConfig
+from ..resilience import faults as rfaults
+
+JOURNAL_NAME = "journal.jsonl"
+
+_CANONICAL = dict(sort_keys=True, separators=(",", ":"))
+
+
+def _record_digest(record: dict) -> str:
+    body = {k: v for k, v in record.items() if k != "sha256"}
+    payload = json.dumps(body, **_CANONICAL).encode("utf-8")
+    return hashlib.sha256(payload).hexdigest()
+
+
+def config_to_doc(cfg: ExperimentConfig) -> dict:
+    return dataclasses.asdict(cfg)
+
+
+def config_from_doc(doc: dict) -> ExperimentConfig:
+    # JSON has no tuples; restore the fields the dataclass types as one.
+    doc = dict(doc)
+    if "betas" in doc:
+        doc["betas"] = tuple(doc["betas"])
+    return ExperimentConfig(**doc)
+
+
+class Journal:
+    """Append-only JSONL journal with fsync'd writes and per-record
+    integrity. One instance per service; ``append`` is thread-safe (the
+    watchdog thread journals poison-suspect markers concurrently with
+    the scheduler)."""
+
+    def __init__(self, path: str, clock=time.time):
+        self.path = path
+        self._clock = clock
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # Continue an existing journal's sequence (recover appends to
+        # the same file it read, so one journal tells the whole story
+        # across restarts).
+        records, truncated = Journal.read(path)
+        self.recovered_records = records
+        self.dropped = 0
+        if truncated:
+            # Drop the torn tail ON DISK too: appending after garbage
+            # would strand every later record behind the integrity
+            # break. Rewrite the intact prefix atomically (tmp + fsync
+            # + rename, the checkpoint discipline).
+            with open(path, "r", encoding="utf-8") as f:
+                n_lines = sum(1 for ln in f if ln.strip())
+            self.dropped = max(1, n_lines - len(records))
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for record in records:
+                    f.write(json.dumps(record, **_CANONICAL) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        self._seq = (records[-1]["seq"] + 1) if records else 0
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    def append(self, kind: str, **fields) -> dict:
+        """Journal one transition: build, hash, append, flush, fsync.
+        Returns the written record. The caller performs the transition
+        only after this returns (write-ahead)."""
+        with self._lock:
+            rfaults.fault_point("journal.append", kind=kind)
+            record = {"seq": self._seq, "ts": self._clock(),
+                      "kind": kind}
+            record.update(fields)
+            record["sha256"] = _record_digest(record)
+            line = json.dumps(record, **_CANONICAL) + "\n"
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+            self._seq += 1
+            # Truncate rules tear the tail AFTER a successful write —
+            # the mid-write preemption recovery must detect.
+            rfaults.corrupt_file("journal.append", self.path)
+            return record
+
+    @staticmethod
+    def read(path: str):
+        """``(records, truncated)``: the longest intact prefix of the
+        journal at ``path``. A record is intact when its line parses,
+        its sha256 matches the canonical body, and its seq continues
+        the prefix. The first broken record invalidates itself and
+        everything after it (a torn write means later appends never
+        happened — the file is append-only)."""
+        records: list = []
+        truncated = False
+        if not os.path.exists(path):
+            return records, truncated
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                truncated = True
+                break
+            if not isinstance(record, dict):
+                truncated = True
+                break
+            if record.get("sha256") != _record_digest(record):
+                truncated = True
+                break
+            if record.get("seq") != len(records):
+                truncated = True
+                break
+            records.append(record)
+        return records, truncated
+
+
+def replay(records) -> dict:
+    """Fold journal records into per-job recovery state. Returns::
+
+        {job_id: {"config": dict, "status": str, "solo": bool,
+                  "attempts": int, "det_failures": int,
+                  "error": str | None}}
+
+    in submission order (dicts preserve insertion order, and job ids
+    are assigned in submission order, so re-submitting in this order
+    reproduces the original ids). Statuses use service.queue's
+    vocabulary; RUNNING here means "was in flight at the crash" — the
+    caller requeues those."""
+    jobs: dict = {}
+    batches: dict = {}   # batch_id -> member job_ids
+    for record in records:
+        kind = record["kind"]
+        if kind == "job_submitted":
+            jobs[record["job_id"]] = {
+                "config": record["config"], "status": "queued",
+                "solo": False, "attempts": 0, "det_failures": 0,
+                "error": None,
+            }
+        elif kind == "batch_started":
+            batches[record["batch_id"]] = list(record["jobs"])
+            for jid in record["jobs"]:
+                if jid in jobs:
+                    jobs[jid]["status"] = "running"
+                    # attempts is exactly the number of batches the
+                    # job entered — no separate counter record needed.
+                    jobs[jid]["attempts"] += 1
+        elif kind == "job_done":
+            if record["job_id"] in jobs:
+                jobs[record["job_id"]]["status"] = "done"
+        elif kind == "job_failed":
+            if record["job_id"] in jobs:
+                jobs[record["job_id"]]["status"] = "failed"
+                jobs[record["job_id"]]["error"] = record.get("error")
+        elif kind == "job_quarantined":
+            if record["job_id"] in jobs:
+                jobs[record["job_id"]]["status"] = "quarantined"
+                jobs[record["job_id"]]["error"] = record.get("error")
+        elif kind == "job_requeued":
+            if record["job_id"] in jobs:
+                jobs[record["job_id"]]["status"] = "queued"
+                jobs[record["job_id"]]["solo"] = bool(
+                    record.get("solo", False))
+                jobs[record["job_id"]]["det_failures"] = int(
+                    record.get("det_failures", 0))
+        elif kind == "batch_poison_suspect":
+            for jid in batches.get(record["batch_id"],
+                                   record.get("jobs", ())):
+                if jid in jobs:
+                    jobs[jid]["solo"] = True
+        # service_draining carries no per-job state: its RUNNING
+        # members were individually journaled as job_requeued.
+    return jobs
+
+
+def journal_path_for(outdir: str) -> str:
+    return os.path.join(outdir, JOURNAL_NAME)

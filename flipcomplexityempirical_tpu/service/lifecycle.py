@@ -1,0 +1,292 @@
+"""Preemption lifecycle: graceful drain + hung-dispatch watchdog.
+
+TPU pods are preempted mid-batch as a matter of course; the sweeps this
+package serves run for hours, so preemption is the common case the
+service must survive, not an edge case. Two mechanisms live here:
+
+**Graceful drain.** SIGTERM/SIGINT must not kill the process mid-write:
+``DrainController`` installs handlers that only raise a cooperative
+flag; ``check_drain`` — called at the same segment boundaries as
+``check_deadline`` — turns the flag into a ``DrainRequested`` exception
+at the next safe point. The scheduler catches it, checkpoints and
+requeues the in-flight batch, journals ``service_draining``, and exits
+with the distinct drain code (``EXIT_DRAINED``) so an orchestrator
+knows to restart with ``SweepService.recover``. Because the flag is
+only *checked* at boundaries where every tenant has a consistent
+checkpoint, a drained-and-recovered run is bit-identical to an
+uninterrupted one (``make preempt-check`` gates this).
+
+The ``sigterm`` fault site stands in for a real signal: an armed rule
+(``sigterm:once@HIT``) raises the flag at exactly the HIT-th boundary,
+making preemption drains byte-reproducible in chaos tests.
+
+**Hung-dispatch watchdog.** A JAX dispatch cannot be interrupted from
+Python — a wedged device call would hang the drain forever and a
+cooperative deadline check never runs. ``DispatchWatchdog`` is a
+daemon thread that watches each armed dispatch window: when a dispatch
+exceeds its timeout (explicit ``--dispatch-timeout``, else scaled from
+the service's observed p95 segment latency), it emits
+``dispatch_stalled`` and journals the batch as poison-suspect — it
+cannot kill the dispatch, but after the orchestrator's hard kill and
+restart, recovery sees the marker and retries that batch's jobs SOLO
+under the supervisor taxonomy (a hung coalesced batch must not take
+its tenants down with it twice). The ``dispatch.stall`` fault site
+simulates the hang: ``stall_point`` holds the dispatch past the
+timeout so the watchdog demonstrably fires, then surfaces the fault as
+the killed call's error.
+
+Exit codes (the CLI contract, documented in README):
+
+=====  ================================================================
+code   meaning
+=====  ================================================================
+0      all jobs done
+2      failures/quarantines present (mirrors the driver's chaos code)
+3      drained on SIGTERM/SIGINT — restart with ``--recover``
+=====  ================================================================
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import Optional
+
+from ..resilience import faults as rfaults
+
+EXIT_DRAINED = 3
+
+_MONOTONIC = time.monotonic
+
+
+class DrainRequested(RuntimeError):
+    """Raised by ``check_drain`` at a segment boundary after a drain
+    request. NOT a failure: the scheduler requeues the in-flight jobs
+    without burning a retry."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(f"drain requested ({reason})")
+
+
+# Process-wide by design: a SIGTERM addresses the process, and every
+# segment loop in it must see the flag. Mutated only via the functions
+# below; tests reset with clear_drain().
+_DRAIN_LOCK = threading.Lock()
+_DRAIN_REASON: Optional[str] = None
+
+
+def request_drain(reason: str) -> None:
+    """Raise the cooperative stop flag (signal-handler safe: one
+    assignment, no I/O)."""
+    global _DRAIN_REASON
+    with _DRAIN_LOCK:
+        if _DRAIN_REASON is None:
+            _DRAIN_REASON = reason
+
+
+def drain_requested() -> Optional[str]:
+    """The drain reason, or None when no drain is pending."""
+    return _DRAIN_REASON
+
+
+def clear_drain() -> None:
+    global _DRAIN_REASON
+    with _DRAIN_LOCK:
+        _DRAIN_REASON = None
+
+
+def check_drain(tag: str = "") -> None:
+    """Cooperative drain point — call where a stop is safe (segment
+    boundaries, between batches). Consults the ``sigterm`` fault site
+    first so chaos plans can deliver a deterministic 'signal' at an
+    exact boundary, then raises DrainRequested if the flag is up."""
+    try:
+        rfaults.fault_point("sigterm", tag=tag)
+    except rfaults.InjectedFault as e:
+        request_drain(f"injected-sigterm@{e.hit}")
+    reason = _DRAIN_REASON
+    if reason is not None:
+        raise DrainRequested(reason)
+
+
+class DrainController:
+    """Installs SIGTERM/SIGINT handlers that request a drain (and
+    nothing else — all real work happens cooperatively at the next
+    ``check_drain``). ``uninstall`` restores the previous handlers.
+    Usable as a context manager."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self._previous: dict = {}
+
+    def install(self) -> "DrainController":
+        for sig in self.SIGNALS:
+            self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous = {}
+
+    @staticmethod
+    def _handler(signum, frame) -> None:
+        request_drain(signal.Signals(signum).name)
+
+    def __enter__(self) -> "DrainController":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+class DispatchWatchdog:
+    """Daemon thread detecting hung device dispatches.
+
+    The scheduler arms a window around each dispatch::
+
+        with watchdog.watch(batch_id, job_ids):
+            watchdog.stall_point(batch_id)   # chaos hook
+            ... run the segment ...
+
+    While a window is armed, the thread polls the monotonic clock; past
+    the timeout it fires ONCE for that window: emits
+    ``dispatch_stalled`` and journals ``batch_poison_suspect``. The
+    timeout is ``timeout_s`` when given, else ``scale`` x the p95 of
+    the ``segment_wall_s`` histogram in ``metrics`` (floored at
+    ``floor_s``); with neither, the window is unarmed — a fresh
+    service has no latency prior to scale from.
+    """
+
+    def __init__(self, recorder=None, journal=None,
+                 timeout_s: Optional[float] = None, metrics=None,
+                 floor_s: float = 30.0, scale: float = 10.0,
+                 poll_s: float = 0.05):
+        self.recorder = recorder
+        self.journal = journal
+        self.timeout_s = timeout_s
+        self.metrics = metrics
+        self.floor_s = float(floor_s)
+        self.scale = float(scale)
+        self.poll_s = float(poll_s)
+        self.stalled: list = []      # batch_ids that fired
+        self._lock = threading.Lock()
+        self._armed = None           # (batch_id, jobs, start, timeout)
+        self._fired_current = False
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- timeout resolution -------------------------------------------
+
+    def effective_timeout(self) -> Optional[float]:
+        if self.timeout_s is not None:
+            return float(self.timeout_s)
+        if self.metrics is None:
+            return None
+        hist = self.metrics.histogram("segment_wall_s")
+        if hist is None or hist.count == 0:
+            return None
+        return max(self.floor_s, self.scale * hist.percentile(0.95))
+
+    # -- arming -------------------------------------------------------
+
+    def watch(self, batch_id: str, jobs):
+        """Context manager arming the watchdog for one dispatch."""
+        return _Watch(self, batch_id, list(jobs))
+
+    def _arm(self, batch_id, jobs):
+        timeout = self.effective_timeout()
+        if timeout is None:
+            return
+        self._ensure_thread()
+        with self._lock:
+            self._armed = (batch_id, jobs, _MONOTONIC(), timeout)
+            self._fired_current = False
+
+    def _disarm(self):
+        with self._lock:
+            self._armed = None
+            self._fired_current = False
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="dispatch-watchdog", daemon=True)
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- the thread ---------------------------------------------------
+
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                armed = self._armed
+                fired = self._fired_current
+            if armed is None or fired:
+                continue
+            batch_id, jobs, start, timeout = armed
+            waited = _MONOTONIC() - start
+            if waited <= timeout:
+                continue
+            with self._lock:
+                if self._fired_current or self._armed is not armed:
+                    continue
+                self._fired_current = True
+            self._fire(batch_id, jobs, timeout, waited)
+
+    def _fire(self, batch_id, jobs, timeout, waited):
+        self.stalled.append(batch_id)
+        if self.recorder is not None:
+            self.recorder.emit("dispatch_stalled", batch_id=batch_id,
+                               timeout_s=timeout,
+                               waited_s=round(waited, 6), jobs=jobs)
+        if self.journal is not None:
+            try:
+                self.journal.append("batch_poison_suspect",
+                                    batch_id=batch_id, jobs=jobs,
+                                    timeout_s=timeout)
+            except (OSError, rfaults.InjectedFault):
+                pass  # the marker is advisory; the stall event stands
+
+    def fired_for(self, batch_id: str) -> bool:
+        return batch_id in self.stalled
+
+    # -- chaos hook ---------------------------------------------------
+
+    def stall_point(self, batch_id: str) -> None:
+        """``dispatch.stall`` fault-site hook, called inside an armed
+        window: a firing rule holds the 'dispatch' until the watchdog
+        fires (bounded), then re-raises the fault as the hung call's
+        eventual error — the closest CPU-testable analogue of a wedged
+        device call that an orchestrator hard-kills."""
+        try:
+            rfaults.fault_point("dispatch.stall", batch_id=batch_id)
+        except rfaults.InjectedFault:
+            timeout = self.effective_timeout() or 0.0
+            deadline = _MONOTONIC() + timeout + 5.0
+            while (not self.fired_for(batch_id)
+                   and _MONOTONIC() < deadline):
+                time.sleep(self.poll_s)
+            raise
+
+
+class _Watch:
+    def __init__(self, watchdog, batch_id, jobs):
+        self._w = watchdog
+        self._batch_id = batch_id
+        self._jobs = jobs
+
+    def __enter__(self):
+        self._w._arm(self._batch_id, self._jobs)
+        return self
+
+    def __exit__(self, *exc):
+        self._w._disarm()

@@ -24,6 +24,22 @@ tenant cannot re-poison its neighbors), and jobs with an existing
 checkpoint run solo from their resume point (coalescing assumes a
 common step 0). Everything here is host-side between segments — no
 added device syncs (PROFILE.md guard-rail).
+
+Preemption-proofing (PR 11) rides the same segment boundaries:
+
+- every job/batch transition is journaled write-ahead
+  (``service.journal``) so ``SweepService.recover(outdir)`` rebuilds
+  the queue after a crash — DONE stays done, RUNNING requeues from its
+  last checkpoint (``_solo_only`` already forces checkpointed jobs
+  solo), poison-suspect batches requeue SOLO;
+- ``lifecycle.check_drain`` runs next to ``check_deadline`` in the
+  batch segment loop: a SIGTERM stops the service at the next boundary
+  with every tenant checkpointed, requeues the in-flight jobs without
+  burning a retry, and exits with the drain code;
+- each device dispatch runs under the ``lifecycle.DispatchWatchdog``
+  window (timeout: ``dispatch_timeout`` or scaled from the p95
+  ``segment_wall_s`` in the service's metrics registry), so a wedged
+  device call is journaled poison-suspect for the restart to isolate.
 """
 
 from __future__ import annotations
@@ -42,13 +58,16 @@ from ..experiments import driver as drv
 from ..experiments.config import ExperimentConfig
 from ..kernel import board as kboard
 from ..lower.dispatch import kernel_path_for, lowering_signature
+from ..obs.metrics import MetricsRegistry
 from ..resilience import faults as rfaults
-from ..resilience.supervisor import (DETERMINISTIC, RetryPolicy,
-                                     check_deadline, classify_error,
-                                     clear_deadline, set_deadline)
+from ..resilience.supervisor import (DETERMINISTIC, DeadlineScope,
+                                     RetryPolicy, check_deadline,
+                                     classify_error)
 from ..sampling import init_batch, init_board, run_chains
 from ..sampling.board_runner import finalize_board_run, run_board_segment
 from .cache import CompileCache
+from . import journal as jnl
+from . import lifecycle
 from . import queue as q
 
 
@@ -137,7 +156,10 @@ class SweepService:
                  compile_cache: Optional[CompileCache] = None,
                  policy: Optional[RetryPolicy] = None,
                  max_batch_chains: Optional[int] = None,
-                 verbose: bool = False):
+                 verbose: bool = False,
+                 journal=None,
+                 dispatch_timeout: Optional[float] = None,
+                 clock=time.time):
         self.outdir = outdir
         self.checkpoint_dir = checkpoint_dir
         self._rec = obs.resolve_recorder(recorder)
@@ -147,17 +169,94 @@ class SweepService:
         self.cache = compile_cache or CompileCache(recorder=self._rec)
         self.max_batch_chains = max_batch_chains
         self.verbose = verbose
-        self.queue = q.JobQueue(recorder=self._rec)
+        self.clock = clock
+        self.queue = q.JobQueue(recorder=self._rec, clock=clock)
         self.batch_stats: list[BatchStats] = []
         self._batch_seq = 0
         os.makedirs(outdir, exist_ok=True)
+        # Journal on by default (outdir/journal.jsonl): crash
+        # consistency is not opt-in. ``journal=False`` disables (pure
+        # in-memory simulation runs); a path or Journal overrides.
+        if journal is False:
+            self.journal = None
+        elif journal is None:
+            self.journal = jnl.Journal(jnl.journal_path_for(outdir),
+                                       clock=clock)
+        elif isinstance(journal, jnl.Journal):
+            self.journal = journal
+        else:
+            self.journal = jnl.Journal(str(journal), clock=clock)
+        if self.journal is not None and self.journal.dropped:
+            self._rec.emit("journal_truncated", path=self.journal.path,
+                           dropped=self.journal.dropped)
+        self.metrics = MetricsRegistry()
+        self.watchdog = lifecycle.DispatchWatchdog(
+            recorder=self._rec, journal=self.journal,
+            timeout_s=dispatch_timeout, metrics=self.metrics)
+        self.drained = False
+        self.drain_reason: Optional[str] = None
 
     # -- submission --------------------------------------------------
 
     def submit(self, config: ExperimentConfig) -> q.Job:
         job = self.queue.submit(config)
+        self._journal("job_submitted", job_id=job.job_id, tag=job.tag,
+                      config=jnl.config_to_doc(config))
         self._write_summary()
         return job
+
+    def _journal(self, kind: str, **fields):
+        """Append one transition record when journaling is on. Append
+        failures propagate: a WAL that cannot write must not let the
+        transition proceed silently."""
+        if self.journal is not None:
+            self.journal.append(kind, **fields)
+
+    # -- recovery ----------------------------------------------------
+
+    @classmethod
+    def recover(cls, outdir: str, **kwargs) -> "SweepService":
+        """Rebuild a service from ``outdir``'s journal after a crash or
+        drain. DONE/FAILED/QUARANTINED jobs keep their verdicts (DONE
+        results are not re-materialized — the journal records state,
+        not data); jobs that were RUNNING at the crash are requeued —
+        ``_solo_only`` routes them through their last checkpoint — and
+        members of a poison-suspect batch are forced SOLO. Opening the
+        journal repairs a torn tail (``journal_truncated`` is emitted);
+        the rebuilt service appends to the same journal, so one file
+        narrates the job history across every restart."""
+        svc = cls(outdir, **kwargs)
+        if svc.journal is None:
+            raise ValueError("recover() needs a journal "
+                             "(journal=False was passed)")
+        state = jnl.replay(svc.journal.recovered_records)
+        n_requeued = 0
+        for jid, st in state.items():
+            job = svc.queue.submit(jnl.config_from_doc(st["config"]))
+            job.attempts = st["attempts"]
+            job.det_failures = st["det_failures"]
+            job.solo = st["solo"]
+            job.error = st["error"]
+            if st["status"] == q.DONE:
+                job.status = q.DONE
+            elif st["status"] == q.FAILED:
+                job.status = q.FAILED
+            elif st["status"] == q.QUARANTINED:
+                job.status = q.QUARANTINED
+            else:
+                # queued at crash, or running (requeue: the resume
+                # point is the job's last checkpoint).
+                if st["status"] == q.RUNNING:
+                    svc._journal("job_requeued", job_id=job.job_id,
+                                 solo=job.solo,
+                                 det_failures=job.det_failures,
+                                 reason="recovery")
+                job.status = q.QUEUED
+                n_requeued += 1
+        svc._rec.emit("service_recovered", path=svc.journal.path,
+                      n_jobs=len(state), n_requeued=n_requeued)
+        svc._write_summary()
+        return svc
 
     # -- grouping ----------------------------------------------------
 
@@ -253,15 +352,27 @@ class SweepService:
         svc_span = obs.span(rec, "service",
                             n_jobs=len(self.queue.runnable())).begin()
         try:
-            while True:
+            while lifecycle.drain_requested() is None:
                 runnable = self.queue.runnable()
                 if not runnable:
                     break
                 for jobs in self._form_groups(runnable):
+                    if lifecycle.drain_requested() is not None:
+                        break   # no new dispatches once draining
                     retried += self._execute(jobs)
         finally:
+            reason = lifecycle.drain_requested()
+            if reason is not None and not self.drained:
+                self.drained = True
+                self.drain_reason = reason
+                self._journal("service_draining", reason=reason)
+                rec.emit("service_draining", reason=reason)
+                if self.verbose:
+                    print(f"[drain] stopping at segment boundary "
+                          f"({reason}); restart with recover()")
+            self.watchdog.stop()
             counts = self._job_counts()
-            svc_span.end(**counts)
+            svc_span.end(drained=self.drained, **counts)
         jobs = self.queue.jobs()
         quarantined = [j.tag for j in jobs
                        if j.status == q.QUARANTINED]
@@ -270,14 +381,20 @@ class SweepService:
                  completed=counts["n_done"], retried=retried,
                  quarantined=len(quarantined), failed=len(failed),
                  quarantined_tags=quarantined, failed_tags=failed,
-                 service=True)
+                 service=True, drained=self.drained)
         self._write_summary()
         return jobs
 
     @property
     def exit_code(self) -> int:
-        return 2 if any(j.status in (q.FAILED, q.QUARANTINED)
-                        for j in self.queue.jobs()) else 0
+        """0 done; 2 failures/quarantines; 3 drained (EXIT_DRAINED) —
+        the orchestrator contract: 3 means restart with recover()."""
+        if any(j.status in (q.FAILED, q.QUARANTINED)
+               for j in self.queue.jobs()):
+            return 2
+        if self.drained:
+            return lifecycle.EXIT_DRAINED
+        return 0
 
     # -- execution ---------------------------------------------------
 
@@ -287,6 +404,8 @@ class SweepService:
         rec = self._rec
         batch_id = f"b{self._batch_seq:04d}"
         self._batch_seq += 1
+        self._journal("batch_started", batch_id=batch_id,
+                      jobs=[j.job_id for j in jobs])
         for job in jobs:
             job.attempts += 1
             job.status = q.RUNNING
@@ -299,7 +418,8 @@ class SweepService:
         hb_state, uninstall = drv.install_live_hooks(
             rec, self.heartbeat, SimpleNamespace(tag=batch_id),
             self._job_counts(), namespace=True)
-        set_deadline(self.policy.deadline_s, batch_id)
+        deadline = DeadlineScope(self.policy.deadline_s,
+                                 batch_id).begin()
         retried = 0
         t0 = time.perf_counter()
         try:
@@ -315,6 +435,23 @@ class SweepService:
                         retried += self._fail(job, e, hb_state)
                 results = (self._run_batch(prepared, batch_id)
                            if prepared else [])
+        except lifecycle.DrainRequested:
+            # Not a failure: the in-flight tenants are checkpointed at
+            # the boundary that observed the drain. Requeue without
+            # burning a retry; run_until_idle stops dispatching.
+            for job in jobs:
+                if job.status == q.RUNNING:
+                    job.attempts -= 1
+                    self._journal("job_requeued", job_id=job.job_id,
+                                  solo=job.solo,
+                                  det_failures=job.det_failures,
+                                  reason="drain")
+                    job.status = q.QUEUED
+                    self._write_job_heartbeat(job, "draining",
+                                              batch=batch_id)
+            self._write_summary()
+            span.end(drained=True)
+            return retried
         except Exception as e:
             for job in jobs:
                 if job.status == q.RUNNING:
@@ -322,7 +459,7 @@ class SweepService:
             span.end(error=type(e).__name__)
             return retried
         finally:
-            clear_deadline()
+            deadline.end()
             uninstall()
         wall = time.perf_counter() - t0
         for job, data in results:
@@ -390,12 +527,18 @@ class SweepService:
                        jobs=[job.job_id], chains=chains,
                        fingerprint=job.fingerprint, kernel_path=path)
         t0 = time.perf_counter()
-        if cfg.family == "temper":
-            data = drv._run_temper(cfg, g, plan, self.checkpoint_dir,
-                                   recorder=self._rec)
-        else:
-            data = drv._run_jax(cfg, g, plan, self.checkpoint_dir,
-                                recorder=self._rec)
+        # One watchdog window for the whole solo run (the driver owns
+        # the segment loop; a solo run is one opaque dispatch span from
+        # the service's point of view).
+        with self.watchdog.watch(batch_id, [job.job_id]):
+            self.watchdog.stall_point(batch_id)
+            if cfg.family == "temper":
+                data = drv._run_temper(cfg, g, plan,
+                                       self.checkpoint_dir,
+                                       recorder=self._rec)
+            else:
+                data = drv._run_jax(cfg, g, plan, self.checkpoint_dir,
+                                    recorder=self._rec)
         wall = time.perf_counter() - t0
         data["seconds"] = wall
         self.batch_stats.append(BatchStats(
@@ -432,19 +575,27 @@ class SweepService:
         done = 0
         hist_parts: dict = {}
         waits_total = np.zeros(c_total, np.float64)
+        job_ids = [p.job.job_id for p in prepared]
         while done < total:
             check_deadline()
+            lifecycle.check_drain(batch_id)
             rfaults.fault_point("segment.step", tag=batch_id, done=done)
             n = min(every, total - done)
-            if use_board:
-                res = run_board_segment(handle, spec, params, states, n,
-                                        record_every=cfg0.record_every,
-                                        recorder=rec)
-            else:
-                res = run_chains(handle, spec, params, states,
-                                 n_steps=n, record_initial=(done == 0),
-                                 record_every=cfg0.record_every,
-                                 recorder=rec)
+            seg_t0 = time.perf_counter()
+            with self.watchdog.watch(batch_id, job_ids):
+                self.watchdog.stall_point(batch_id)
+                if use_board:
+                    res = run_board_segment(
+                        handle, spec, params, states, n,
+                        record_every=cfg0.record_every, recorder=rec)
+                else:
+                    res = run_chains(handle, spec, params, states,
+                                     n_steps=n,
+                                     record_initial=(done == 0),
+                                     record_every=cfg0.record_every,
+                                     recorder=rec)
+            self.metrics.observe("segment_wall_s",
+                                 time.perf_counter() - seg_t0)
             states = res.state
             for k, v in res.history.items():
                 hist_parts.setdefault(k, []).append(v)
@@ -498,6 +649,8 @@ class SweepService:
 
     def _complete(self, job: q.Job, data: dict, batch_id: str,
                   wall: float):
+        self._journal("job_done", job_id=job.job_id, tag=job.tag,
+                      batch_id=batch_id)
         job.status = q.DONE
         job.result = data
         job.error = None
@@ -524,6 +677,8 @@ class SweepService:
         if klass == DETERMINISTIC:
             job.det_failures += 1
         if job.det_failures >= self.policy.quarantine_after:
+            self._journal("job_quarantined", job_id=job.job_id,
+                          error=msg)
             job.status = q.QUARANTINED
             rec.emit("config_quarantined", tag=job.tag,
                      failures=job.det_failures)
@@ -537,6 +692,7 @@ class SweepService:
                       f"({msg})")
             return 0
         if job.attempts > self.policy.max_retries:
+            self._journal("job_failed", job_id=job.job_id, error=msg)
             job.status = q.FAILED
             rec.emit("config_failed", tag=job.tag, error_class=klass,
                      message=msg, attempts=job.attempts)
@@ -560,6 +716,8 @@ class SweepService:
                       attempt=job.attempts, backoff_s=wait,
                       error_class=klass):
             time.sleep(wait)
+        self._journal("job_requeued", job_id=job.job_id, solo=True,
+                      det_failures=job.det_failures, reason="retry")
         job.status = q.QUEUED
         job.solo = True
         self._write_job_heartbeat(job, "retrying", error=msg)

@@ -19,13 +19,20 @@ surface only in production sweeps:
   streams) unreproducible. Jitter must come from a seeded
   ``random.Random(seed)`` instance (RetryPolicy does this).
 
-Statically: in ``resilience/`` and ``experiments/`` modules, flag (a)
-any ExceptHandler whose type is missing / ``Exception`` /
-``BaseException`` and whose body is a single ``pass``; (b) any ``-``
-BinOp where an operand is a ``time.time()`` call or a name assigned
-from one; (c) any ``random.<fn>()`` call on the ``random`` MODULE
-(instantiating ``random.Random``/``SystemRandom`` is the fix, so those
-are exempt).
+Statically: in ``resilience/``, ``experiments/``, and ``service/``
+modules, flag (a) any ExceptHandler whose type is missing /
+``Exception`` / ``BaseException`` and whose body is a single ``pass``;
+(b) any ``-`` BinOp where an operand is a ``time.time()`` call or a
+name assigned from one; (c) any ``random.<fn>()`` call on the
+``random`` MODULE (instantiating ``random.Random``/``SystemRandom`` is
+the fix, so those are exempt).
+
+``service/`` additionally flags ANY bare ``time.time()`` call (ISSUE
+11): the service layer injects clocks (``JobQueue(clock=...)``,
+``Journal(clock=...)``) so drain/recovery tests can replay timestamp
+sequences deterministically — a direct wall-clock call bypasses the
+injection point. Passing ``time.time`` as a default (a reference, not
+a call) is the sanctioned spelling.
 """
 
 from __future__ import annotations
@@ -42,8 +49,13 @@ _SEEDED_FACTORIES = frozenset({"Random", "SystemRandom"})
 
 def applies(module) -> bool:
     in_scope = ("resilience/" in module.path
-                or "experiments/" in module.path)
+                or "experiments/" in module.path
+                or "service/" in module.path)
     return in_scope and not module.is_test
+
+
+def _is_service(module) -> bool:
+    return "service/" in module.path
 
 
 def _is_time_time(node) -> bool:
@@ -102,6 +114,14 @@ def check(module, config):
                         "timestamps only)"))
                     break
         elif isinstance(node, ast.Call):
+            if _is_service(module) and _is_time_time(node):
+                findings.append(module.finding(
+                    RULE_ID, node,
+                    "bare time.time() call in service/ — the service "
+                    "layer injects clocks (JobQueue/Journal clock= "
+                    "params) so recovery tests replay deterministically;"
+                    " thread the injected clock through instead"))
+                continue
             fn = node.func
             if (isinstance(fn, ast.Attribute)
                     and isinstance(fn.value, ast.Name)

@@ -29,13 +29,16 @@ prints the grandfathered-finding count from the committed
 ``graftlint_baseline.json`` so static-analysis debt is visible in the
 same report (target: 0). ``--strict`` additionally exits nonzero (after
 printing the report) when the stream carries any ``anomaly``,
-``config_quarantined``, or ``kernel_path_degraded`` events — the CI
-gate on chain and sweep HEALTH rather than stream shape — or when
-``--heartbeat PATH`` names a sweep heartbeat whose mtime is staler than
-2x ``--heartbeat-interval`` without a complete status. A Resilience
+``config_quarantined``, ``kernel_path_degraded``, or
+``dispatch_stalled`` events — the CI gate on chain and sweep HEALTH
+rather than stream shape — or when ``--heartbeat PATH`` names a sweep
+heartbeat whose mtime is staler than 2x ``--heartbeat-interval``
+without a complete status (service heartbeats report WHICH namespaced
+per-job/per-batch file went stale and by how much). A Resilience
 section summarizes retries by error class, quarantines, kernel-path
-degradations, corrupt checkpoint generations, and heartbeat write
-failures whenever the stream carries any. Stdlib-only: the
+degradations, hung dispatches, mesh degradations, corrupt checkpoint
+generations, and heartbeat write failures whenever the stream carries
+any. Stdlib-only: the
 schema module is loaded by file path, so neither gate needs jax (or any
 package import) at all. ``.jsonl.gz`` streams (obs.Recorder gzip sinks)
 are read transparently.
@@ -457,9 +460,11 @@ def report_resilience(events, out):
     degraded = [e for e in events if e["event"] == "kernel_path_degraded"]
     corrupt = [e for e in events if e["event"] == "checkpoint_corrupt"]
     hb_err = [e for e in events if e["event"] == "heartbeat_error"]
+    stalled = [e for e in events if e["event"] == "dispatch_stalled"]
+    meshdeg = [e for e in events if e["event"] == "mesh_degraded"]
     summary = [e for e in events if e["event"] == "sweep_summary"]
     if not (retries or quarantined or failed or degraded or corrupt
-            or hb_err or summary):
+            or hb_err or stalled or meshdeg or summary):
         return
 
     print("\n## Resilience", file=out)
@@ -495,6 +500,15 @@ def report_resilience(events, out):
     for e in corrupt:
         print(f"- CORRUPT CHECKPOINT [{e.get('tag', '?')}] "
               f"{e.get('path', '?')}: {e.get('reason', '?')}", file=out)
+    for e in stalled:
+        print(f"- DISPATCH STALLED [{e.get('batch_id', '?')}]: no "
+              f"progress for {e.get('waited_s', 0):.0f}s (timeout "
+              f"{e.get('timeout_s', 0):.0f}s); jobs journaled "
+              f"poison-suspect, restart retries them solo", file=out)
+    for e in meshdeg:
+        print(f"- MESH DEGRADED {e.get('from_devices', '?')} -> "
+              f"{e.get('to_devices', '?')} devices: "
+              f"{e.get('reason', '?')}", file=out)
     if hb_err:
         print(f"- heartbeat write failures: {len(hb_err)} "
               f"(non-fatal; last: {hb_err[-1].get('message', '?')})",
@@ -554,7 +568,10 @@ def check_heartbeat(path: str, interval_s: float):
                         _namespaced_heartbeat_path(path, n), interval_s)
                     for n in names]
             if all(errs):
-                errors.append(f"job {tag}: {errs[0]}")
+                # every probed file failed: name each namespaced file
+                # and how stale it is, so the operator sees WHICH job's
+                # refresh loop died (not just that something did)
+                errors.append(f"job {tag}: " + "; ".join(errs))
         if errors:
             return "; ".join(errors)
         if running:
@@ -648,7 +665,7 @@ def main(argv=None):
             print(f"\n{hb_error}", file=out)
     if args.strict:
         gated = {"anomaly": 0, "config_quarantined": 0,
-                 "kernel_path_degraded": 0}
+                 "kernel_path_degraded": 0, "dispatch_stalled": 0}
         for e in events:
             if e["event"] in gated:
                 gated[e["event"]] += 1

@@ -1,0 +1,206 @@
+#!/usr/bin/env bash
+# Preemption CI gate (`make preempt-check`, ISSUE 11): SIGTERM (the
+# injected `sigterm` fault site) lands mid-batch, the service drains —
+# checkpointing tenants, requeueing running jobs, journaling ONE
+# service_draining event — and exits with the distinct drain code 3.
+# A fresh process then `SweepService.recover`s from the journal and the
+# resumed per-tenant artifacts must be BYTE-IDENTICAL to uninterrupted
+# solo runs, on the board fast path (frank -> lowered_bits) AND the
+# general gather path (hex). A torn-tail leg truncates the journal
+# mid-record and recovery must detect it (SHA-256 mismatch), repair
+# from the previous record, and still converge to the same artifacts.
+# The full matrix (crash points x tail states, watchdog, elastic mesh)
+# lives in tests/test_preemption.py; this is the fast tier-1 smoke.
+#
+#   tools/preempt_check.sh                      # both families
+#   PREEMPT_FAMILIES=frank tools/preempt_check.sh
+#
+# PREEMPT_FAMILIES narrows the family loop; the tier-1 test runs the
+# frank-only subset (one cold XLA compile instead of two) so the gate
+# cannot rot, while `make preempt-check` always runs the full matrix.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+TD="$(mktemp -d)"
+trap 'rm -rf "$TD"' EXIT
+
+# one persistent XLA cache across every leg: a recovered process must
+# not re-pay the drained process's compiles (the PR 9 on-disk cache is
+# exactly the restart story this gate exercises), and it keeps the
+# 5-process gate inside the tier-1 time budget.
+export JAX_COMPILATION_CACHE_DIR="$TD/jax-cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+
+FAMILIES="${PREEMPT_FAMILIES:-frank hex}"
+
+for FAMILY in $FAMILIES; do
+  OUT="$TD/$FAMILY"
+  mkdir -p "$OUT"
+
+  # --- leg 1: drain. The injected SIGTERM fires at the 2nd segment
+  # boundary (mid-batch: both tenants are in flight); the process must
+  # exit with the drain code, not 0 and not a failure code.
+  set +e
+  JAX_PLATFORMS=cpu GRAFT_FAULTS="sigterm:once@2" \
+      "$PY" - "$OUT" "$FAMILY" <<'PYEOF'
+import os
+import sys
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
+from flipcomplexityempirical_tpu.resilience import faults as rfaults
+from flipcomplexityempirical_tpu.service import SweepService
+
+out, family = sys.argv[1], sys.argv[2]
+rfaults.install_from_env()
+extra = {} if family == "frank" else dict(lattice_m=4, lattice_n=6)
+als = (2, 1) if family == "frank" else (0, 1)
+cfgs = [ExperimentConfig(family=family, alignment=al, base=0.3,
+                         pop_tol=0.1, total_steps=120, n_chains=2,
+                         backend="jax", seed=3 + al,
+                         checkpoint_every=40, **extra)
+        for al in als]
+with obs.Recorder(os.path.join(out, "events.drain.jsonl")) as rec:
+    svc = SweepService(outdir=out, recorder=rec)
+    jobs = [svc.submit(c) for c in cfgs]
+    svc.run_until_idle()
+assert svc.drained, "injected sigterm did not drain the service"
+assert all(j.status == "queued" for j in jobs), \
+    [(j.tag, j.status) for j in jobs]
+sys.exit(svc.exit_code)
+PYEOF
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "preempt-check: $FAMILY drain leg exited $rc, want 3 (EXIT_DRAINED)"
+    exit 1
+  fi
+
+  # snapshot the drained state for the torn-tail leg (frank only)
+  # before recovery appends to the journal
+  if [ "$FAMILY" = frank ]; then
+    cp -r "$OUT" "$TD/frank-torn"
+  fi
+
+  # --- leg 2: recover from the journal, run to completion, compare the
+  # resumed artifacts bit-for-bit against uninterrupted solo runs.
+  JAX_PLATFORMS=cpu "$PY" - "$OUT" "$FAMILY" <<'PYEOF'
+import json
+import os
+import sys
+from collections import Counter
+
+import numpy as np
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.experiments import driver as drv
+from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
+from flipcomplexityempirical_tpu.service import SweepService
+
+out, family = sys.argv[1], sys.argv[2]
+with obs.Recorder(os.path.join(out, "events.recover.jsonl")) as rec:
+    svc = SweepService.recover(out, recorder=rec)
+    svc.run_until_idle()
+assert svc.exit_code == 0, [(j.tag, j.status, j.error)
+                            for j in svc.queue.jobs()]
+done = {j.tag: j for j in svc.queue.jobs()}
+assert len(done) == 2 and all(j.status == "done"
+                              for j in done.values()), done
+
+extra = {} if family == "frank" else dict(lattice_m=4, lattice_n=6)
+als = (2, 1) if family == "frank" else (0, 1)
+for al in als:
+    cfg = ExperimentConfig(family=family, alignment=al, base=0.3,
+                           pop_tol=0.1, total_steps=120, n_chains=2,
+                           backend="jax", seed=3 + al,
+                           checkpoint_every=40, **extra)
+    g, plan, _ = drv.build_graph_and_plan(cfg)
+    ref = drv._run_jax(cfg, g, plan, None)
+    got = done[cfg.tag].result
+    for k in ("end_signed", "cut_times", "num_flips", "waits_all"):
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(ref[k]), err_msg=k)
+    for k in ref["history"]:
+        np.testing.assert_array_equal(
+            np.asarray(got["history"][k]),
+            np.asarray(ref["history"][k]), err_msg=f"history[{k}]")
+    np.testing.assert_array_equal(np.asarray(got["assignments"]),
+                                  np.asarray(ref["assignments"]))
+
+# exactly one drain event in the drained run, one recovery event here
+drain_evs = Counter(json.loads(l)["event"]
+                    for l in open(os.path.join(out, "events.drain.jsonl")))
+rec_evs = Counter(json.loads(l)["event"]
+                  for l in open(os.path.join(out, "events.recover.jsonl")))
+assert drain_evs["service_draining"] == 1, dict(drain_evs)
+assert drain_evs.get("service_recovered", 0) == 0, dict(drain_evs)
+assert rec_evs["service_recovered"] == 1, dict(rec_evs)
+assert rec_evs.get("service_draining", 0) == 0, dict(rec_evs)
+
+# the journal narrates the whole story in one file: drain-requeues
+# from run 1, job_done records appended by the recovered run
+kinds = Counter(json.loads(l)["kind"]
+                for l in open(os.path.join(out, "journal.jsonl")))
+assert kinds["job_requeued"] >= 2 and kinds["job_done"] == 2, dict(kinds)
+print(f"preempt-check[{family}]: drained -> recovered bit-identical "
+      f"({dict(rec_evs)})")
+PYEOF
+
+  "$PY" tools/obs_report.py "$OUT/events.drain.jsonl" --check
+  "$PY" tools/obs_report.py "$OUT/events.recover.jsonl" --check
+done
+
+# --- leg 3: torn tail. Truncate the drained journal mid-record; the
+# recovering service must detect the torn tail (SHA-256 + seq), drop
+# it, emit journal_truncated, and still recover to identical artifacts.
+# (Needs the frank drain snapshot, so skipped when PREEMPT_FAMILIES
+# excludes frank.)
+if [ -d "$TD/frank-torn" ]; then
+JAX_PLATFORMS=cpu "$PY" - "$TD/frank-torn" <<'PYEOF'
+import json
+import os
+import sys
+
+import numpy as np
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.experiments import driver as drv
+from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
+from flipcomplexityempirical_tpu.service import SweepService
+
+out = sys.argv[1]
+jp = os.path.join(out, "journal.jsonl")
+blob = open(jp, "rb").read()
+open(jp, "wb").write(blob[:-17])  # tear the last record mid-line
+
+with obs.Recorder(os.path.join(out, "events.torn.jsonl")) as rec:
+    svc = SweepService.recover(out, recorder=rec)
+    n_dropped = svc.journal.dropped
+    svc.run_until_idle()
+assert n_dropped >= 1, "torn tail not detected"
+assert svc.exit_code == 0, [(j.tag, j.status, j.error)
+                            for j in svc.queue.jobs()]
+evs = [json.loads(l)
+       for l in open(os.path.join(out, "events.torn.jsonl"))]
+assert sum(e["event"] == "journal_truncated" for e in evs) == 1, \
+    "journal_truncated not emitted"
+
+done = {j.tag: j for j in svc.queue.jobs()}
+for al in (2, 1):
+    cfg = ExperimentConfig(family="frank", alignment=al, base=0.3,
+                           pop_tol=0.1, total_steps=120, n_chains=2,
+                           backend="jax", seed=3 + al,
+                           checkpoint_every=40)
+    g, plan, _ = drv.build_graph_and_plan(cfg)
+    ref = drv._run_jax(cfg, g, plan, None)
+    got = done[cfg.tag].result
+    for k in ("end_signed", "cut_times", "num_flips", "waits_all"):
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(ref[k]), err_msg=k)
+print("preempt-check[torn-tail]: detected, repaired, recovered "
+      "bit-identical")
+PYEOF
+fi
+
+echo "preempt-check: OK"
