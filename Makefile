@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test obs-check mesh-check chaos-check bitpack-check \
-	service-check preempt-check lint
+	service-check preempt-check control-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -45,6 +45,14 @@ service-check:
 # a torn-journal-tail detection/repair leg
 preempt-check:
 	PYTHON=$(PYTHON) JAX_PLATFORMS=cpu tools/preempt_check.sh
+
+# adaptive-control gate: G008 policy purity, a seeded CPU sweep where
+# the control loop beats the fixed schedule to the split-R-hat/ESS
+# targets (wall_clock_to_target_ess > 1.0x with journaled stops, valid
+# stream, bench_compare-qualified record), and a SIGTERM drain whose
+# recovery replays the journaled control_action sequence bit-identically
+control-check:
+	PYTHON=$(PYTHON) JAX_PLATFORMS=cpu tools/control_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

@@ -147,6 +147,23 @@ def main():
     ap.add_argument("--tenants", type=int, default=4,
                     help="--service: coalescible tenants sharing the "
                          "device")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="measure the adaptive control plane instead of "
+                         "a raw kernel: one small seeded sweep (two "
+                         "frank configs + one tempered) run twice — "
+                         "adaptive (control/ EarlyStop+Ladder policies, "
+                         "run FIRST so it pays the cold compiles) vs the "
+                         "fixed schedule — reported as a "
+                         "'wall_clock_to_target_ess' record (ratio of "
+                         "fixed to adaptive wall clock; > 1 means the "
+                         "control loop reached the diagnostic targets "
+                         "in strictly less wall clock). --steps is the "
+                         "fixed schedule length, --chains the chains "
+                         "per config")
+    ap.add_argument("--target-rhat", type=float, default=1.5,
+                    help="--adaptive: split R-hat early-stop target")
+    ap.add_argument("--target-ess", type=float, default=64.0,
+                    help="--adaptive: total-ESS early-stop target")
     ap.add_argument("--ess-host", action="store_true",
                     help="force the host-copy f64 ESS estimator for the "
                          "--ess recorded pass (streams the history to "
@@ -160,12 +177,27 @@ def main():
                            (args.general, "--general"),
                            (args.ess, "--ess"),
                            (args.mesh is not None, "--mesh"),
-                           (args.body is not None, "--body")):
+                           (args.body is not None, "--body"),
+                           (args.adaptive, "--adaptive")):
             if flag:
                 ap.error(f"{name} is incompatible with --service (the "
                          "service benchmark drives whole sweep jobs, "
                          "not one kernel path)")
         _service_bench(args)
+        return
+    if args.adaptive:
+        for flag, name in ((args.pallas, "--pallas"),
+                           (args.general, "--general"),
+                           (args.ess, "--ess"),
+                           (args.mesh is not None, "--mesh"),
+                           (args.body is not None, "--body"),
+                           (args.service, "--service")):
+            if flag:
+                ap.error(f"{name} is incompatible with --adaptive (the "
+                         "adaptive benchmark drives whole sweep jobs "
+                         "through the control loop, not one kernel "
+                         "path)")
+        _adaptive_bench(args)
         return
     if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
             or args.warmup - 1 < args.chunk):
@@ -771,6 +803,108 @@ def _service_bench(args):
     }
     print(json.dumps(meta), file=sys.stderr)
     if record["device"] == "cpu":
+        record["cpu_fallback"] = True
+    print(json.dumps(record))
+
+
+def _adaptive_bench(args):
+    """--adaptive: the control-plane wall-clock-to-target-ESS record.
+
+    One seeded sweep (two frank configs + one tempered ladder) is run
+    twice in this process: ADAPTIVE — a control.ControlLoop with the
+    EarlyStop and Ladder policies consulted at segment boundaries — and
+    FIXED (the full schedule, no control). An untimed warmup pass runs
+    the fixed schedule first so BOTH timed legs see a warm jit cache
+    and identical prebuilt graphs; the timed region is the segment loop
+    alone (rendering and graph build are identical per leg and
+    excluded). Value = fixed_wall / adaptive_wall; > 1 means the loop
+    reached the split-R-hat/ESS targets in strictly less wall clock
+    than the fixed schedule spent. bench_compare qualifies the record
+    per (family, policy)."""
+    import time as _time
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from flipcomplexityempirical_tpu.control import (ControlLoop,
+                                                    EarlyStopPolicy,
+                                                    LadderPolicy)
+    from flipcomplexityempirical_tpu.experiments import driver as drv
+    from flipcomplexityempirical_tpu.experiments.config import \
+        ExperimentConfig
+    from flipcomplexityempirical_tpu.obs import from_spec
+    import jax
+
+    steps = args.steps
+    chains = args.chains or 4
+    every = max(args.record_every,
+                (steps // 6 // args.record_every) * args.record_every)
+    shared = dict(pop_tol=0.1, total_steps=steps, n_chains=chains,
+                  checkpoint_every=every,
+                  record_every=args.record_every)
+    configs = [
+        ExperimentConfig(family="frank", alignment=2, base=1 / 0.3,
+                         seed=3, **shared),
+        ExperimentConfig(family="frank", alignment=1, base=1 / 0.3,
+                         seed=16, **shared),
+        ExperimentConfig(family="temper", alignment=0, base=1 / 0.3,
+                         betas=(1.0, 0.9, 0.8, 0.7),
+                         swap_every=max(every // 2, 10), seed=29,
+                         **shared),
+    ]
+    loop = ControlLoop(policies=[
+        EarlyStopPolicy(rhat_target=args.target_rhat,
+                        ess_target=args.target_ess, patience=1),
+        LadderPolicy(),
+    ])
+    with from_spec(args.events) as rec:
+        loop.attach(recorder=rec)
+        built = [(c,) + tuple(drv.build_graph_and_plan(c)[:2])
+                 for c in configs]
+
+        def _leg(control):
+            t0 = _time.perf_counter()
+            for c, g, plan in built:
+                if c.family == "temper":
+                    drv._run_temper(c, g, plan, None, recorder=rec,
+                                    control=control)
+                else:
+                    drv._run_jax(c, g, plan, None, recorder=rec,
+                                 control=control)
+            return _time.perf_counter() - t0
+
+        _leg(None)  # warmup: pays every compile, untimed
+        adaptive_wall = _leg(loop)
+        fixed_wall = _leg(None)
+
+    device = jax.devices()[0]
+    meta = {
+        "mode": "adaptive",
+        "device": str(device),
+        "n_devices": len(jax.devices()),
+        "configs": [c.tag for c in configs],
+        "checkpoint_every": every,
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    record = {
+        "metric": "wall_clock_to_target_ess",
+        "value": round(fixed_wall / adaptive_wall, 4),
+        "unit": "x",
+        "family": "frank+temper",
+        "policy": "early_stop+ladder",
+        "adaptive_wall_s": round(adaptive_wall, 4),
+        "fixed_wall_s": round(fixed_wall, 4),
+        "targets": {"rhat": args.target_rhat, "ess": args.target_ess},
+        "stops": [{"tag": a.tag, "step": a.step}
+                  for a in loop.actions if a.kind == "stop"],
+        "reshapes": [{"tag": a.tag, "step": a.step}
+                     for a in loop.actions
+                     if a.kind == "reshape_ladder"],
+        "chains": chains,
+        "steps": steps,
+        "device": device.platform,
+    }
+    if device.platform == "cpu":
         record["cpu_fallback"] = True
     print(json.dumps(record))
 

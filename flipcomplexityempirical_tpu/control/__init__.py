@@ -1,0 +1,23 @@
+"""control/ — monitor-driven adaptive sweep control (ROADMAP item 5).
+
+A deterministic, journal-replayable control plane over the obs/ stack:
+pure policies (policy.py) propose typed actions from observed history;
+the ControlLoop (loop.py) emits them as ``control_action`` events and
+journal records at the drivers' existing segment boundaries. See
+README "Adaptive control" for the quick-start.
+
+This package must stay importable without jax (policies run on numpy +
+stats oracles only) and late-importable from experiments.driver — it
+imports only obs/ and stats/.
+"""
+
+from .loop import ControlLoop
+from .policy import (ACTION_KINDS, AutotunePolicy, ControlAction,
+                     ControlPolicy, EarlyStopPolicy, LadderPolicy,
+                     ObservedState, default_policies)
+
+__all__ = [
+    "ACTION_KINDS", "AutotunePolicy", "ControlAction", "ControlLoop",
+    "ControlPolicy", "EarlyStopPolicy", "LadderPolicy", "ObservedState",
+    "default_policies",
+]

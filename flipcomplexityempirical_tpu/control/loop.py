@@ -1,0 +1,187 @@
+"""The ControlLoop: fold observations into journaled ControlActions.
+
+The loop is the single mutation point of the control plane. Policies
+(control/policy.py) are pure proposal functions; the loop builds their
+``ObservedState`` view at each segment boundary, filters proposals
+against what has already been taken (once-per-config stops, bounded
+ladder reshapes), and emits every accepted action twice: a
+``control_action`` registry event on the recorder (telemetry) and a
+``control_action`` record in the service journal (durability). The
+journal is the loop's durable memory: ``adopt`` re-seeds the dedup
+state from recovered records, so a recovered service never re-emits a
+decision it already journaled and honors prior stops at the exact
+boundary they were taken (``stop_step``).
+
+Journal field naming: the journal envelope already uses ``kind`` for
+the record type, so the ACTION's kind rides as ``action`` —
+``{"kind": "control_action", "action": "stop", tag, step, policy,
+detail}``. ``journal.replay`` ignores unknown record kinds, so control
+records coexist with the job-state fold.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from .policy import (ControlAction, ObservedState, default_policies,
+                     quantize_latency)
+
+
+class ControlLoop:
+    """Deterministic observe -> act fold for one sweep/service run.
+
+    ``consult`` is called by the drivers at segment boundaries (next to
+    ``_check_drain``); ``consult_stop`` is the early-stop convenience
+    the segment loops branch on; ``reallocate`` is called by the
+    scheduler when it hands an early-stopped tenant's chains back to
+    the batch's stragglers."""
+
+    def __init__(self, policies=None, recorder=None, journal=None,
+                 metrics=None):
+        self.policies = (list(policies) if policies is not None
+                         else default_policies())
+        self._rec = obs.resolve_recorder(recorder)
+        self.journal = journal
+        self.metrics = metrics
+        self.actions: list = []            # emitted by THIS process
+        self._taken: dict = {}             # (tag, kind) -> count
+        self._stop_steps: dict = {}        # tag -> step of the stop
+        self._anomalies: dict = {}         # tag -> [kind, ...]
+
+    # -- wiring ------------------------------------------------------
+
+    def attach(self, recorder=None, journal=None, metrics=None):
+        """Late wiring for components the owner creates after the loop
+        (the SweepService attaches its recorder/journal/metrics)."""
+        if recorder is not None and not self._rec:
+            self._rec = obs.resolve_recorder(recorder)
+        if journal is not None and self.journal is None:
+            self.journal = journal
+        if metrics is not None and self.metrics is None:
+            self.metrics = metrics
+        return self
+
+    # -- durable memory ----------------------------------------------
+
+    def adopt(self, records) -> int:
+        """Seed the dedup state from recovered journal records so a
+        recovered run REPLAYS prior decisions instead of re-deriving
+        (and re-journaling) them. Returns the number adopted."""
+        n = 0
+        for record in records:
+            if record.get("kind") != "control_action":
+                continue
+            action, tag = record.get("action"), record.get("tag")
+            if not action or tag is None:
+                continue
+            key = (tag, action)
+            self._taken[key] = self._taken.get(key, 0) + 1
+            if action == "stop" and tag not in self._stop_steps:
+                self._stop_steps[tag] = int(record.get("step", 0))
+            n += 1
+        return n
+
+    def observe_anomaly(self, tag: str, kind: str):
+        """Record an anomaly kind for ``tag`` (driver hooks forward
+        ChainMonitor anomaly events here; LadderPolicy consumes them)."""
+        kinds = self._anomalies.setdefault(tag, [])
+        if kind not in kinds:
+            kinds.append(kind)
+
+    def stopped(self, tag: str) -> bool:
+        return tag in self._stop_steps
+
+    def stop_step(self, tag: str) -> Optional[int]:
+        return self._stop_steps.get(tag)
+
+    def taken(self, tag: str) -> dict:
+        return {kind: count for (t, kind), count in self._taken.items()
+                if t == tag}
+
+    # -- the consult points ------------------------------------------
+
+    def _quantize_histograms(self) -> dict:
+        out = {}
+        if self.metrics is None:
+            return out
+        for name in ("segment_wall_s",):
+            h = self.metrics.histogram(name)
+            if h is None or not h.count:
+                continue
+            p95 = h.percentile(0.95)
+            if p95 is not None:
+                out[name] = (quantize_latency(p95), int(h.count))
+        return out
+
+    def consult(self, tag: str, *, family: str, done: int, total: int,
+                every: int, history=None, swap_attempts=None,
+                swap_accepts=None, betas=None) -> list:
+        """Evaluate every policy at one segment boundary; emit and
+        journal the accepted actions. Pure in the passed observations
+        plus the adopted journal state — NOT in any wall clock."""
+        if self.stopped(tag):
+            return []
+        view = ObservedState(
+            tag=tag, family=family, done=int(done), total=int(total),
+            every=int(every),
+            history=history,
+            swap_attempts=swap_attempts, swap_accepts=swap_accepts,
+            betas=(tuple(float(b) for b in np.asarray(betas).ravel())
+                   if betas is not None else None),
+            anomalies=tuple(self._anomalies.get(tag, ())),
+            taken=self.taken(tag),
+            p95_bucket=self._quantize_histograms())
+        accepted = []
+        for policy in self.policies:
+            for action in policy.propose(view):
+                if action.kind == "stop" and (
+                        view.taken.get("stop")
+                        or any(a.kind == "stop" for a in accepted)):
+                    continue
+                accepted.append(action)
+        for action in accepted:
+            self._emit(action)
+        return accepted
+
+    def consult_stop(self, tag: str, **kw) -> bool:
+        """The early-stop branch for the segment loops: True when this
+        boundary is where the config stops — either a fresh decision or
+        the replay of an adopted one at its original boundary."""
+        ss = self._stop_steps.get(tag)
+        if ss is not None:
+            return int(kw.get("done", 0)) >= ss
+        return any(a.kind == "stop" for a in self.consult(tag, **kw))
+
+    def reallocate(self, batch_tag: str, *, step: int, from_tag: str,
+                   to_tags, freed_chains: int):
+        """Journal the scheduler handing an early-stopped tenant's
+        device time to the batch's stragglers. Deterministic: a pure
+        consequence of a stop decision and the batch's membership."""
+        action = ControlAction(
+            kind="reallocate", tag=batch_tag, step=int(step),
+            policy="scheduler",
+            detail={"from": from_tag, "to": sorted(to_tags),
+                    "freed_chains": int(freed_chains)})
+        self._emit(action)
+        return action
+
+    # -- emission ----------------------------------------------------
+
+    def _emit(self, action: ControlAction):
+        key = (action.tag, action.kind)
+        self._taken[key] = self._taken.get(key, 0) + 1
+        if action.kind == "stop":
+            self._stop_steps.setdefault(action.tag, action.step)
+        self.actions.append(action)
+        if self._rec:
+            self._rec.emit("control_action", kind=action.kind,
+                           tag=action.tag, step=action.step,
+                           policy=action.policy, detail=action.detail)
+        if self.journal is not None:
+            self.journal.append("control_action", action=action.kind,
+                                tag=action.tag, step=action.step,
+                                policy=action.policy,
+                                detail=action.detail)

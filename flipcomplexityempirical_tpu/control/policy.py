@@ -1,0 +1,297 @@
+"""Control policies: pure observed-history -> proposed-action functions.
+
+Every policy sees one ``ObservedState`` — the accumulated, checkpoint-
+resumable view of a config at a segment boundary — and returns zero or
+more ``ControlAction`` proposals. PURITY IS THE CONTRACT (enforced by
+graftlint G008): no wall-clock reads, no unseeded RNG, no recorder or
+hook mutation. A policy that is pure in the observed history makes the
+whole control plane journal-replayable: a drained run and its recovery
+see bit-identical histories at the same segment boundaries (chain PRNG
+keys live in the checkpointed state), so they derive the identical
+action sequence — ``SweepService.recover`` replays decisions instead of
+re-litigating them.
+
+Built-ins:
+
+- ``EarlyStopPolicy``: stop a config once its split R-hat and total ESS
+  targets hold at K consecutive segment-grid points (with a min-steps
+  floor). Diagnostics are recomputed from the accumulated (C, T)
+  history via the stats oracles (f64, deterministic) rather than read
+  from ChainMonitor's process-lifetime buffers, which reset on recovery.
+- ``AutotunePolicy``: propose a segment-length retune from the metrics
+  registry's p95 ``segment_wall_s``, quantized to the histogram's own
+  1-2-5 bucket edges so the proposal is a pure function of which bucket
+  the latency landed in, not of the raw jittery wall-clock values. The
+  proposal is ADVISORY (surfaced in events/reports, never applied
+  mid-run): applying it would change segment shapes and break the
+  bit-identical-artifacts contract.
+- ``LadderPolicy``: map the tempered family's per-pair swap statistics
+  (plus acceptance_collapse / frozen_chain anomalies) into a geometric
+  beta-ladder reshape targeting a swap-rate band. The coldest rung
+  (beta max) is held exactly fixed so the physical chain — and the
+  driver's cold-row bookkeeping — survive the reshape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from bisect import bisect_left
+from typing import Optional, Protocol
+
+import numpy as np
+
+from ..obs.metrics import DEFAULT_EDGES
+from ..stats.diagnostics import ess, gelman_rubin
+
+ACTION_KINDS = ("stop", "retune", "reshape_ladder", "reallocate")
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlAction:
+    """One typed control decision. ``detail`` must be JSON-canonical
+    (plain dicts/lists/str/int/float/bool) — it rides the journal and
+    the event stream verbatim, and replay equality is judged on it."""
+
+    kind: str                 # one of ACTION_KINDS
+    tag: str                  # config (or batch) acted on
+    step: int                 # segment boundary (transitions done)
+    policy: str               # deciding policy's name
+    detail: dict = dataclasses.field(default_factory=dict)
+
+    def doc(self) -> dict:
+        return {"kind": self.kind, "tag": self.tag, "step": self.step,
+                "policy": self.policy, "detail": self.detail}
+
+
+@dataclasses.dataclass(frozen=True)
+class ObservedState:
+    """What a policy may see at one segment boundary: accumulated,
+    checkpoint-resumable observations only. Anything process-lifetime
+    (monitor buffers, wall clocks) is deliberately absent — it would
+    diverge between a run and its recovery."""
+
+    tag: str
+    family: str
+    done: int                         # transitions/yields advanced
+    total: int                        # the run's full schedule
+    every: int                        # segment length (boundary grid)
+    history: Optional[np.ndarray] = None   # (C, T) accumulated observable
+    swap_attempts: Optional[np.ndarray] = None  # (n_rungs-1,) temper
+    swap_accepts: Optional[np.ndarray] = None
+    betas: Optional[tuple] = None     # current ladder by rank, coldest 1st
+    anomalies: tuple = ()             # anomaly kinds observed for tag
+    taken: dict = dataclasses.field(default_factory=dict)  # kind -> count
+    p95_bucket: dict = dataclasses.field(default_factory=dict)
+    # metric -> (bucket upper edge, count): pre-quantized histogram
+    # reading (see ControlLoop._quantize) — the only latency view pure
+    # enough for a policy
+
+
+class ControlPolicy(Protocol):
+    """A policy proposes actions; the ControlLoop emits/journals them."""
+
+    name: str
+
+    def propose(self, view: ObservedState) -> list:  # list[ControlAction]
+        ...
+
+
+def quantize_latency(value: float) -> float:
+    """Snap a latency to the metrics registry's 1-2-5 bucket upper edge
+    (Histogram.percentile interpolates within buckets, so raw p95 values
+    carry wall-clock jitter; the bucket a latency falls in does not)."""
+    i = bisect_left(DEFAULT_EDGES, value)
+    return DEFAULT_EDGES[min(i, len(DEFAULT_EDGES) - 1)]
+
+
+class EarlyStopPolicy:
+    """Stop once split R-hat <= rhat_target AND total ESS >= ess_target
+    at ``patience`` consecutive segment-grid points, not before
+    ``min_steps`` transitions.
+
+    The grid points are derived purely from (done, every, T): column
+    T * g / done for each boundary g — so the SAME boundaries are judged
+    whether the history arrived in one run or across a drain/recovery.
+    Tempered configs are skipped: closing a temper run early would need
+    a final-yield segment mid-schedule (run_tempered's segment=False
+    epilogue), and the ladder's value is mixing the full horizon anyway.
+
+    ``tags``: optional whitelist — only listed configs may be stopped
+    (lets an operator, or a test, target one straggling tenant's peers).
+    """
+
+    def __init__(self, rhat_target: float = 1.05,
+                 ess_target: float = 200.0, patience: int = 2,
+                 min_steps: int = 0, min_columns: int = 8,
+                 tags: Optional[tuple] = None, name: str = "early_stop"):
+        self.rhat_target = float(rhat_target)
+        self.ess_target = float(ess_target)
+        self.patience = max(int(patience), 1)
+        self.min_steps = int(min_steps)
+        self.min_columns = max(int(min_columns), 4)
+        self.tags = tuple(tags) if tags is not None else None
+        self.name = name
+
+    def _passes(self, hist: np.ndarray, t_col: int) -> bool:
+        if t_col < self.min_columns:
+            return False
+        window = hist[:, :t_col]
+        try:
+            rhat = gelman_rubin(window)
+        except ValueError:
+            return False
+        if not np.isfinite(rhat) or rhat > self.rhat_target:
+            return False
+        _, total = ess(window)
+        return total >= self.ess_target
+
+    def propose(self, view: ObservedState) -> list:
+        if (view.family == "temper" or view.history is None
+                or view.taken.get("stop") or view.done >= view.total
+                or view.done < self.min_steps
+                or (self.tags is not None and view.tag not in self.tags)):
+            return []
+        hist = np.asarray(view.history, dtype=np.float64)
+        t = hist.shape[1]
+        grid = list(range(view.every, view.done + 1, view.every)) or \
+            [view.done]
+        points = grid[-self.patience:]
+        if len(points) < self.patience:
+            return []
+        cols = [max(1, (t * g) // view.done) for g in points]
+        if not all(self._passes(hist, tc) for tc in cols):
+            return []
+        rhat = gelman_rubin(hist[:, :cols[-1]])
+        _, ess_total = ess(hist[:, :cols[-1]])
+        return [ControlAction(
+            kind="stop", tag=view.tag, step=view.done, policy=self.name,
+            detail={"rhat": round(float(rhat), 6),
+                    "ess": round(float(ess_total), 3),
+                    "rhat_target": self.rhat_target,
+                    "ess_target": self.ess_target,
+                    "patience": self.patience,
+                    "total": view.total,
+                    "saved_steps": view.total - view.done})]
+
+
+class AutotunePolicy:
+    """Advisory segment-length retune from the quantized p95
+    ``segment_wall_s``: when a segment's p95 bucket sits above
+    ``target_wall_s``, propose halving the segment length toward the
+    target (and doubling when it sits far below, capped by the run
+    length). At most one proposal per config — the point is a concrete
+    number for the NEXT submission of this shape, not a stream of
+    nudges. Never applied mid-run (see module docstring)."""
+
+    def __init__(self, target_wall_s: float = 1.0,
+                 name: str = "autotune"):
+        self.target_wall_s = float(target_wall_s)
+        self.name = name
+
+    def propose(self, view: ObservedState) -> list:
+        reading = view.p95_bucket.get("segment_wall_s")
+        if reading is None or view.taken.get("retune"):
+            return []
+        bucket, count = reading
+        if count < 2:
+            return []
+        if bucket > self.target_wall_s:
+            factor = 1
+            while bucket > self.target_wall_s * factor and \
+                    view.every // (2 * factor) >= 1:
+                factor *= 2
+            proposal = max(view.every // factor, 1)
+        elif bucket <= self.target_wall_s / 4:
+            proposal = min(view.every * 2, max(view.total, view.every))
+        else:
+            return []
+        if proposal == view.every:
+            return []
+        return [ControlAction(
+            kind="retune", tag=view.tag, step=view.done, policy=self.name,
+            detail={"segment_steps": int(proposal),
+                    "current_segment_steps": int(view.every),
+                    "p95_bucket_s": bucket,
+                    "p95_count": count,
+                    "target_wall_s": self.target_wall_s,
+                    "advisory": True})]
+
+
+class LadderPolicy:
+    """Reshape a tempered beta ladder toward a swap-rate band.
+
+    Pure in (swap_attempts, swap_accepts, current betas, anomalies):
+    the mean accept rate is a ratio of integers, the reshape is a
+    closed-form geometric respacing. A rate below ``low`` (or an
+    acceptance_collapse / frozen_chain anomaly with the rate below
+    ``high``) means adjacent rungs are too far apart — the span
+    b_min/b_max contracts (sqrt); a rate above ``high`` means the
+    ladder wastes rungs on near-identical temperatures — the span
+    widens (squares, floored). beta_max is held EXACTLY fixed; the new
+    rungs are assigned by rank, so each chain keeps its rank and the
+    physical (coldest) chain is untouched."""
+
+    def __init__(self, low: float = 0.15, high: float = 0.60,
+                 min_attempts_per_pair: int = 4, max_reshapes: int = 1,
+                 min_span: float = 1e-3, name: str = "ladder"):
+        self.low = float(low)
+        self.high = float(high)
+        self.min_attempts_per_pair = int(min_attempts_per_pair)
+        self.max_reshapes = int(max_reshapes)
+        self.min_span = float(min_span)
+        self.name = name
+
+    def propose(self, view: ObservedState) -> list:
+        if (view.family != "temper" or view.betas is None
+                or view.swap_attempts is None or view.swap_accepts is None
+                or view.taken.get("reshape_ladder", 0)
+                >= self.max_reshapes or view.done >= view.total):
+            return []
+        attempts = np.asarray(view.swap_attempts, dtype=np.int64)
+        accepts = np.asarray(view.swap_accepts, dtype=np.int64)
+        n_pairs = attempts.shape[0]
+        if n_pairs < 1 or attempts.sum() < \
+                self.min_attempts_per_pair * n_pairs:
+            return []
+        rate = float(accepts.sum()) / float(max(int(attempts.sum()), 1))
+        anomalous = bool(set(view.anomalies)
+                         & {"acceptance_collapse", "frozen_chain"})
+        if rate < self.low or (anomalous and rate < self.high):
+            direction, exponent = "contract", 0.5
+        elif rate > self.high:
+            direction, exponent = "widen", 2.0
+        else:
+            return []
+        betas = np.asarray(view.betas, dtype=np.float64)
+        b_max, b_min = betas[0], betas[-1]
+        if not (b_max > 0 and b_min > 0 and b_max > b_min):
+            return []
+        span = max((b_min / b_max) ** exponent, self.min_span)
+        n = betas.shape[0]
+        new = b_max * span ** (np.arange(n) / max(n - 1, 1))
+        new32 = new.astype(np.float32)
+        new32[0] = np.float32(b_max)  # exactly fixed cold rung
+        if len(set(new32.tolist())) != n:
+            return []                 # degenerate in f32: keep the ladder
+        return [ControlAction(
+            kind="reshape_ladder", tag=view.tag, step=view.done,
+            policy=self.name,
+            detail={"betas": [float(b) for b in new32],
+                    "old_betas": [float(b) for b in
+                                  betas.astype(np.float32)],
+                    "mean_swap_rate": round(rate, 6),
+                    "band": [self.low, self.high],
+                    "direction": direction,
+                    "anomalous": anomalous})]
+
+
+def default_policies(rhat_target: float = 1.05,
+                     ess_target: float = 200.0, patience: int = 2,
+                     min_steps: int = 0) -> list:
+    """The standard adaptive-sweep trio (--adaptive flags thread the
+    early-stop targets; autotune and ladder run with their defaults)."""
+    return [EarlyStopPolicy(rhat_target=rhat_target,
+                            ess_target=ess_target, patience=patience,
+                            min_steps=min_steps),
+            AutotunePolicy(),
+            LadderPolicy()]

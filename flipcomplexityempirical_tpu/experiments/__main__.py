@@ -86,6 +86,18 @@ def main():
     ap.add_argument("--no-supervise", action="store_true",
                     help="bare fail-fast sweep loop (no retries, no "
                          "quarantine, first error aborts the process)")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="close the observe->act loop: consult the "
+                         "control/ policies (early stop on split "
+                         "R-hat + ESS targets, swap-rate ladder "
+                         "reshaping) at segment boundaries; decisions "
+                         "are emitted as control_action events "
+                         "(requires --checkpoint-every > 0 to create "
+                         "boundaries before config completion)")
+    ap.add_argument("--target-rhat", type=float, default=1.05,
+                    help="--adaptive: split R-hat early-stop target")
+    ap.add_argument("--target-ess", type=float, default=200.0,
+                    help="--adaptive: total-ESS early-stop target")
     args = ap.parse_args()
     if args.cpu:
         import jax
@@ -110,18 +122,25 @@ def main():
         rfaults.install_from_spec(args.faults)
     else:
         rfaults.install_from_env()
+    control = None
+    if args.adaptive:
+        from ..control import ControlLoop, default_policies
+        control = ControlLoop(policies=default_policies(
+            rhat_target=args.target_rhat, ess_target=args.target_ess))
     with from_spec(args.events) as rec:
         if args.no_supervise:
             run_sweep(configs, args.out,
                       checkpoint_dir=args.checkpoint_dir,
-                      recorder=rec, heartbeat=heartbeat)
+                      recorder=rec, heartbeat=heartbeat,
+                      control=control)
             return
         policy = RetryPolicy(max_retries=args.retries,
                              quarantine_after=args.quarantine_after,
                              deadline_s=args.deadline, seed=args.seed)
         report = run_supervised_sweep(
             configs, args.out, checkpoint_dir=args.checkpoint_dir,
-            recorder=rec, heartbeat=heartbeat, policy=policy)
+            recorder=rec, heartbeat=heartbeat, policy=policy,
+            control=control)
     sys.exit(report.exit_code)
 
 

@@ -139,9 +139,20 @@ def count_artifacts(cfg: ExperimentConfig, outdir: str) -> int:
                for k in artifact_kinds(cfg.family))
 
 
+def _control_history(hist_parts: dict, key: str = "cut_count"):
+    """The accumulated (C, T) observable the control loop judges from:
+    concatenated history parts, which resume restores in full — so a
+    recovered run sees the bit-identical history a continuous run saw
+    at the same boundary (the replay contract of control/policy.py)."""
+    parts = hist_parts.get(key)
+    if not parts:
+        return None
+    return np.concatenate([np.asarray(p) for p in parts], axis=1)
+
+
 def run_config(cfg: ExperimentConfig, outdir: str,
                checkpoint_dir: Optional[str] = None,
-               recorder=None) -> dict:
+               recorder=None, control=None) -> dict:
     os.makedirs(outdir, exist_ok=True)
     rec = obs.resolve_recorder(recorder)
     with obs.span(rec, "build_graph", tag=cfg.tag, family=cfg.family):
@@ -162,9 +173,10 @@ def run_config(cfg: ExperimentConfig, outdir: str,
         raise ValueError(f"backend {cfg.backend!r}")
     elif cfg.family == "temper":
         data = _run_temper(cfg, g, plan, checkpoint_dir,
-                           recorder=recorder)
+                           recorder=recorder, control=control)
     else:
-        data = _run_jax(cfg, g, plan, checkpoint_dir, recorder=recorder)
+        data = _run_jax(cfg, g, plan, checkpoint_dir, recorder=recorder,
+                        control=control)
     data["seconds"] = time.monotonic() - t0
     if cfg.n_districts == 2:
         with obs.span(rec, "partisan", tag=cfg.tag):
@@ -218,7 +230,8 @@ def run_config(cfg: ExperimentConfig, outdir: str,
 
 def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
              _stop_after_segments: Optional[int] = None,
-             recorder=None, _force_general: bool = False) -> dict:
+             recorder=None, _force_general: bool = False,
+             control=None) -> dict:
     """Batched run, in checkpoint segments when cfg.checkpoint_every > 0.
 
     A crash between segments loses at most ``checkpoint_every`` steps: the
@@ -241,7 +254,16 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     ladder): when every board-family body has failed, the config reruns
     here on the general gather kernel; a board-path checkpoint is then
     incompatible (different state pytree) and is deliberately ignored —
-    an honest fresh start beats resuming corrupt state."""
+    an honest fresh start beats resuming corrupt state.
+
+    ``control`` (a control.ControlLoop) is consulted at every segment
+    boundary with the accumulated observable history; a ``stop`` action
+    closes the run there — the board epilogue finalizes at the boundary
+    yield, the general path truncates t_final — and the returned data
+    carries ``early_stopped`` with the boundary step. The consult sits
+    NEXT TO ``_check_drain`` by design: a drain and a stop observe the
+    same boundaries, so a drained/recovered run re-derives the identical
+    decision from the checkpoint-restored history."""
     from ..sampling.board_runner import run_board_segment
 
     rec = obs.resolve_recorder(recorder)
@@ -275,7 +297,15 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
             f"boundaries would silently skew the recorded time grid")
     total = cfg.total_steps - (1 if use_board else 0)
     segments = 0
-    while done < total:
+    stopped_at: Optional[int] = None
+    if control is not None and done > 0:
+        # recovered run that already reached a journaled (adopted) stop
+        # boundary: close immediately instead of running an extra
+        # segment the reference run never ran
+        _ss = control.stop_step(cfg.tag)
+        if _ss is not None and done >= _ss:
+            stopped_at = done
+    while stopped_at is None and done < total:
         check_deadline()
         _check_drain(cfg.tag)
         rfaults.fault_point("segment.step", tag=cfg.tag, done=done)
@@ -295,7 +325,7 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
                                             tag=cfg.tag)
                 return _run_jax(cfg, g, plan, checkpoint_dir,
                                 _stop_after_segments, recorder=recorder,
-                                _force_general=True)
+                                _force_general=True, control=control)
         else:
             res = run_chains(handle, spec, params, states,
                              n_steps=n, record_initial=(done == 0),
@@ -307,6 +337,15 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         waits_total += res.waits_total
         done += n
         segments += 1
+        if (control is not None and done < total
+                and control.consult_stop(
+                    cfg.tag, family=cfg.family, done=done, total=total,
+                    every=every,
+                    history=_control_history(hist_parts))):
+            # the targets held: close the run at this boundary (the
+            # checkpoint write is skipped — the job completes here)
+            stopped_at = done
+            break
         if checkpoint_dir:
             with obs.span(rec, "checkpoint", tag=cfg.tag, done=done):
                 n_parts = save_checkpoint(
@@ -319,28 +358,39 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     if use_board:
         # the final yield (no trailing transition) + its wait bookkeeping
         from ..sampling.board_runner import finalize_board_run
+        t_close = (cfg.total_steps if stopped_at is None
+                   else stopped_at + 1)
         res = finalize_board_run(handle, spec, params, states, hist_parts,
-                                 waits_total, [], True, cfg.total_steps,
+                                 waits_total, [], True, t_close,
                                  cfg.record_every, recorder=recorder)
         states, history, waits_total = (res.state, res.history,
                                         res.waits_total)
     else:
         history = {k: np.concatenate(v, axis=1)
                    for k, v in hist_parts.items()}
-    return assemble_run_data(cfg, g, handle, use_board, states, history,
-                             waits_total)
+    data = assemble_run_data(
+        cfg, g, handle, use_board, states, history, waits_total,
+        t_final=(None if stopped_at is None
+                 else stopped_at + (1 if use_board else 0)))
+    if stopped_at is not None:
+        data["early_stopped"] = stopped_at
+    return data
 
 
 def assemble_run_data(cfg: ExperimentConfig, g, handle, use_board: bool,
-                      states, history: dict, waits_total) -> dict:
+                      states, history: dict, waits_total,
+                      t_final: Optional[int] = None) -> dict:
     """The run epilogue shared by ``_run_jax`` and the sweep service's
     batched executor (service.scheduler slices one tenant's chain rows
     out of a coalesced batch and assembles them here): host readback,
     canvas -> node conversion on the board path, and the reference's
-    final-accumulator bookkeeping (finalize_host)."""
+    final-accumulator bookkeeping (finalize_host). ``t_final`` defaults
+    to the full schedule; an early-stopped run (control loop) passes
+    the boundary it actually closed at."""
     labels = _labels_for(cfg)
     s = jax.tree.map(np.asarray, states)
-    t_final = cfg.total_steps  # reference t after the loop (line 402)
+    if t_final is None:
+        t_final = cfg.total_steps  # reference t after the loop (line 402)
     c0 = type(s)(**{f: (np.asarray(v)[0] if (v := getattr(s, f))
                         is not None else None)
                     for f in s.__dataclass_fields__})
@@ -379,7 +429,7 @@ def assemble_run_data(cfg: ExperimentConfig, g, handle, use_board: bool,
 def _run_temper(cfg: ExperimentConfig, g, plan,
                 checkpoint_dir: Optional[str] = None,
                 _stop_after_segments: Optional[int] = None,
-                recorder=None) -> dict:
+                recorder=None, control=None) -> dict:
     """The temper family: n_chains LADDERS of len(betas) rungs each (so
     the batch is n_chains * n_rungs chains), swap rounds every
     ``swap_every`` transitions. Artifacts follow the chain that ENDS
@@ -415,7 +465,7 @@ def _run_temper(cfg: ExperimentConfig, g, plan,
     else:
         res = _run_temper_segmented(cfg, handle, spec, params, states,
                                     checkpoint_dir, _stop_after_segments,
-                                    recorder=recorder)
+                                    recorder=recorder, control=control)
     s = res.host_state()
     # the PHYSICAL (beta = betas[0]) chain of each ladder: swaps permute
     # betas, so the cold chain's batch row differs per ladder at run end
@@ -474,14 +524,26 @@ def _run_temper(cfg: ExperimentConfig, g, plan,
 
 def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
                           states, checkpoint_dir,
-                          _stop_after_segments=None, recorder=None):
+                          _stop_after_segments=None, recorder=None,
+                          control=None):
     """Checkpointed temper run: whole-swap-round segments through
     run_tempered(segment=True), the between-segment ladder state in the
     checkpoint's extra_* arrays, the per-round beta assignment saved as a
     history part (transposed to the (C, T) part layout). Resumes
     bit-identically: chain PRNG keys live in the state, the swap key and
-    parity in the extras."""
-    from ..sampling.tempered import TemperResult
+    parity in the extras.
+
+    ``control`` is consulted between segments with the accumulated swap
+    statistics and the current ladder (by rank); a ``reshape_ladder``
+    action rewrites the per-chain betas rank-for-rank BEFORE the
+    checkpoint is saved, so a resumed run continues with the reshaped
+    ladder and the journal-adopted loop never re-derives the action.
+    The cold rung (beta max) is exactly preserved by LadderPolicy, so
+    _run_temper's cold-row bookkeeping and per_rung_history's
+    rank-matching both survive the reshape. Early STOP is deliberately
+    not offered to tempered runs (closing the run needs the mid-schedule
+    final-yield epilogue; EarlyStopPolicy skips family='temper')."""
+    from ..sampling.tempered import TemperResult, _host_rungs
 
     n_rungs = len(cfg.betas)
     c = cfg.n_chains * n_rungs
@@ -530,6 +592,22 @@ def _run_temper_segmented(cfg: ExperimentConfig, handle, spec, params,
         accepts += res.swap_accepts
         done += n
         segments += 1
+        if control is not None and done < total:
+            beta_now = np.asarray(params.beta)
+            ladder = np.sort(beta_now.reshape(-1, n_rungs)[0])[::-1]
+            for act in control.consult(
+                    cfg.tag, family=cfg.family, done=done, total=total,
+                    every=every, swap_attempts=attempts.copy(),
+                    swap_accepts=accepts.copy(), betas=ladder):
+                if act.kind != "reshape_ladder":
+                    continue
+                # rank-preserving rewrite: each chain keeps its rung
+                # (rank) and receives that rank's new beta
+                new_by_rank = np.asarray(act.detail["betas"],
+                                         np.float32)
+                rungs = _host_rungs(beta_now, n_rungs)
+                params = params.replace(
+                    beta=jax.numpy.asarray(new_by_rank[rungs]))
         if checkpoint_dir:
             with obs.span(obs.resolve_recorder(recorder), "checkpoint",
                           tag=cfg.tag, done=done):
@@ -1112,7 +1190,7 @@ def heartbeat_path_for(path: Optional[str], tag: str):
 
 
 def install_live_hooks(rec, heartbeat, cfg, progress: dict,
-                       namespace: bool = False):
+                       namespace: bool = False, control=None):
     """Wire the recorder's live-observer hooks for one in-flight config:
     ChainMonitor calls ``rec.diag_hook`` / ``rec.anomaly_hook``, the
     runners' MetricsRegistry.notify calls ``rec.metrics_hook``; each
@@ -1159,9 +1237,14 @@ def install_live_hooks(rec, heartbeat, cfg, progress: dict,
         _state["diag"] = diag
         _hb()
 
-    def _on_anomaly(anom, _state=hb_state, _hb=_hb_refresh):
+    def _on_anomaly(anom, _state=hb_state, _hb=_hb_refresh,
+                    _ctl=control, _tag=cfg.tag):
         kind = anom.get("kind", "unknown")
         _state["anomalies"][kind] = _state["anomalies"].get(kind, 0) + 1
+        if _ctl is not None:
+            # forward to the control loop (LadderPolicy widens its
+            # swap-rate band on acceptance_collapse / frozen_chain)
+            _ctl.observe_anomaly(anom.get("tag", _tag) or _tag, kind)
         _hb()
 
     def _on_metrics(snap, _state=hb_state, _hb=_hb_refresh):
@@ -1176,7 +1259,7 @@ def install_live_hooks(rec, heartbeat, cfg, progress: dict,
 
 def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
               verbose: bool = True, recorder=None,
-              heartbeat: Optional[str] = None) -> list:
+              heartbeat: Optional[str] = None, control=None) -> list:
     """Sweep with skip-if-done resume (per-config completion manifest).
 
     ``recorder``: an obs.Recorder receives one ``sweep_config`` event per
@@ -1193,8 +1276,14 @@ def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
     ``anomalies`` — a per-kind episode tally — / ``metrics`` — latest
     p50/p95/p99 chunk latency and flips/s), so the hang detector doubles
     as an in-flight health readout.
+    ``control``: a control.ControlLoop consulted at segment boundaries
+    (adaptive sweeps: early stop, ladder reshapes, advisory retunes);
+    it adopts the sweep's recorder so its ``control_action`` events
+    land in the same stream.
     """
     rec = obs.resolve_recorder(recorder)
+    if control is not None:
+        control.attach(recorder=rec)
     configs = list(configs)
     results = []
     n_done = n_skipped = 0
@@ -1231,10 +1320,10 @@ def run_sweep(configs, outdir: str, checkpoint_dir: Optional[str] = None,
             _, uninstall = install_live_hooks(
                 rec, heartbeat, cfg,
                 dict(n_done=n_done, n_skipped=n_skipped,
-                     n_configs=len(configs)))
+                     n_configs=len(configs)), control=control)
             try:
                 data = run_config(cfg, outdir, checkpoint_dir,
-                                  recorder=rec)
+                                  recorder=rec, control=control)
             except Exception as e:
                 rec.emit("error", message=f"{type(e).__name__}: {e}",
                          tag=cfg.tag, family=cfg.family)

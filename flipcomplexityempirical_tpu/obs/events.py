@@ -127,6 +127,19 @@ Core field semantics:
   ``to_devices``). Bench records from such a run carry
   ``degraded: true`` and are refused by ``tools/bench_compare.py``
   gating.
+- ``control_action``: the adaptive control loop (control/loop.py) took
+  a typed action at a segment boundary: ``kind`` in ``stop`` (config
+  reached its split-R-hat/ESS targets and was finished early) /
+  ``retune`` (advisory segment-length proposal from the p95 latency
+  histograms) / ``reshape_ladder`` (tempered beta ladder adjusted
+  toward the swap-rate band) / ``reallocate`` (an early-stopped
+  tenant's device time handed to the batch's stragglers). ``tag`` is
+  the acted-on config (or the batch for reallocations), ``step`` the
+  segment boundary, ``policy`` the deciding policy's name; a free
+  ``detail`` object carries the decision evidence. Actions are pure
+  functions of observed history, so a drained/recovered sweep replays
+  the identical sequence — ``obs_report --heartbeat`` treats a
+  ``kind=stop`` like ``job_done`` when probing namespaced heartbeats.
 
 Adding a new event *type* (as ``diag``/``anomaly`` were added) does NOT
 bump SCHEMA_VERSION: readers fold by type and validation rejects only
@@ -275,6 +288,12 @@ EVENT_REGISTRY = {
         "fields": ("from_devices", "to_devices", "reason"),
         "doc": "sharded run resumed on the surviving power-of-two "
                "sub-mesh; bench records marked degraded",
+    },
+    "control_action": {
+        "fields": ("kind", "tag", "step", "policy"),
+        "doc": "adaptive control decision at a segment boundary: "
+               "stop / retune / reshape_ladder / reallocate; pure in "
+               "observed history so recovery replays it bit-identically",
     },
 }
 

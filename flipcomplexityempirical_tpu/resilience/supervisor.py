@@ -202,8 +202,8 @@ def run_supervised_sweep(configs, outdir: str,
                          checkpoint_dir: Optional[str] = None,
                          verbose: bool = True, recorder=None,
                          heartbeat: Optional[str] = None,
-                         policy: Optional[RetryPolicy] = None
-                         ) -> SweepReport:
+                         policy: Optional[RetryPolicy] = None,
+                         control=None) -> SweepReport:
     """The fault-tolerant sweep. Same per-config telemetry contract as
     driver.run_sweep (sweep/config spans, sweep_config events, live
     heartbeat hooks) plus: ``retry`` events with ``backoff`` spans
@@ -216,6 +216,8 @@ def run_supervised_sweep(configs, outdir: str,
     policy = policy or RetryPolicy()
     rng = random.Random(policy.seed)
     rec = obs.resolve_recorder(recorder)
+    if control is not None:
+        control.attach(recorder=rec)
     configs = list(configs)
     report = SweepReport()
     n_configs = len(configs)
@@ -262,12 +264,16 @@ def run_supervised_sweep(configs, outdir: str,
                                     family=cfg.family,
                                     attempt=attempts).begin()
                 hb_state, uninstall = drv.install_live_hooks(
-                    rec, heartbeat, cfg, _progress())
+                    rec, heartbeat, cfg, _progress(), control=control)
                 deadline = DeadlineScope(policy.deadline_s,
                                          cfg.tag).begin()
+                # control is threaded only when armed: run_config
+                # stand-ins (tests, older callers) need not grow the
+                # kwarg to stay substitutable
+                _ctl = {} if control is None else {"control": control}
                 try:
                     data = drv.run_config(cfg, outdir, checkpoint_dir,
-                                          recorder=rec)
+                                          recorder=rec, **_ctl)
                 except Exception as e:
                     deadline.end()
                     uninstall()

@@ -352,8 +352,11 @@ def per_rung_history(res: TemperResult, name: str) -> np.ndarray:
     recorded at yield t by whichever of ladder l's chains held rung r
     then. Swaps exchange temperatures, so the physical rung-r chain hops
     between batch rows; this inverts the hop using ``beta_hist``.
-    Requires the ladder's betas to be pairwise distinct (they are matched
-    by exact f32 value: swaps permute betas, never recompute them).
+    Requires the ladder's betas to be pairwise distinct; rungs are
+    matched by RANK within each ladder column (rank 0 = largest beta),
+    which equals exact-value matching for a fixed ladder and stays
+    correct across a mid-run control reshape (control.LadderPolicy
+    rewrites beta VALUES but preserves every chain's rank).
     """
     beta32 = res.betas.astype(np.float32)
     if len(set(beta32.tolist())) != res.n_rungs:
@@ -376,9 +379,15 @@ def per_rung_history(res: TemperResult, name: str) -> np.ndarray:
 
     bh3 = res.beta_hist[rounds].reshape(t_rec, nl, res.n_rungs)
     h3 = h.reshape(nl, res.n_rungs, t_rec)
+    # rank of rung r within res.betas, and the position of each rank in
+    # each recorded ladder column: order[t, l, k] is the row holding the
+    # k-th largest beta of ladder l at column t
+    rank_of_rung = np.argsort(np.argsort(-beta32, kind="stable"),
+                              kind="stable")
+    order = np.argsort(-bh3, axis=2, kind="stable")         # (T', nl, R)
     out = np.empty((res.n_rungs, nl, t_rec), h.dtype)
     for r in range(res.n_rungs):
         # position of rung r inside each ladder, per recorded column
-        j = np.argmax(bh3 == beta32[r], axis=2)             # (T', nl)
+        j = order[:, :, rank_of_rung[r]]                    # (T', nl)
         out[r] = np.take_along_axis(h3, j.T[:, None, :], axis=1)[:, 0]
     return out

@@ -159,7 +159,8 @@ class SweepService:
                  verbose: bool = False,
                  journal=None,
                  dispatch_timeout: Optional[float] = None,
-                 clock=time.time):
+                 clock=time.time,
+                 control=None):
         self.outdir = outdir
         self.checkpoint_dir = checkpoint_dir
         self._rec = obs.resolve_recorder(recorder)
@@ -195,6 +196,14 @@ class SweepService:
             timeout_s=dispatch_timeout, metrics=self.metrics)
         self.drained = False
         self.drain_reason: Optional[str] = None
+        # Adaptive control (control.ControlLoop): consulted by the
+        # drivers at segment boundaries; its actions ride THIS journal,
+        # so recover() replays them instead of re-deriving.
+        self.control = control
+        if self.control is not None:
+            self.control.attach(recorder=self._rec,
+                                journal=self.journal,
+                                metrics=self.metrics)
 
     # -- submission --------------------------------------------------
 
@@ -229,6 +238,11 @@ class SweepService:
         if svc.journal is None:
             raise ValueError("recover() needs a journal "
                              "(journal=False was passed)")
+        if svc.control is not None:
+            # adopt journaled control decisions: a recovered run honors
+            # prior stops/reshapes at their original boundaries instead
+            # of re-deriving (and re-journaling) them
+            svc.control.adopt(svc.journal.recovered_records)
         state = jnl.replay(svc.journal.recovered_records)
         n_requeued = 0
         for jid, st in state.items():
@@ -535,10 +549,12 @@ class SweepService:
             if cfg.family == "temper":
                 data = drv._run_temper(cfg, g, plan,
                                        self.checkpoint_dir,
-                                       recorder=self._rec)
+                                       recorder=self._rec,
+                                       control=self.control)
             else:
                 data = drv._run_jax(cfg, g, plan, self.checkpoint_dir,
-                                    recorder=self._rec)
+                                    recorder=self._rec,
+                                    control=self.control)
         wall = time.perf_counter() - t0
         data["seconds"] = wall
         self.batch_stats.append(BatchStats(
@@ -576,7 +592,50 @@ class SweepService:
         hist_parts: dict = {}
         waits_total = np.zeros(c_total, np.float64)
         job_ids = [p.job.job_id for p in prepared]
-        while done < total:
+        ctl = self.control
+        # Active tenants by index into `prepared`. With control on, the
+        # loop may retire a tenant early (control stop) and re-pack the
+        # survivors so their chains keep the whole device; with control
+        # off, `active` never changes and the loop below is the original
+        # whole-batch path verbatim.
+        active = list(range(len(prepared)))
+        per_hist: list = [dict() for _ in prepared]  # control only
+        results = []
+
+        def _active_offsets():
+            cs = [prepared[i].job.config.n_chains for i in active]
+            return np.concatenate([[0], np.cumsum(cs)]).astype(int)
+
+        def _tenant_data(i, states_i, per_parts, waits_i, stop_at=None):
+            """Finalize one tenant's run from its sliced state/history
+            parts; `stop_at` is the early-stop boundary (None = ran the
+            full schedule)."""
+            p = prepared[i]
+            cfg = p.job.config
+            if use_board:
+                t_close = (cfg.total_steps if stop_at is None
+                           else stop_at + 1)
+                res_i = finalize_board_run(
+                    handle, spec, p.params, states_i, per_parts,
+                    waits_i, [], True, t_close, cfg.record_every,
+                    recorder=rec)
+                data = drv.assemble_run_data(
+                    cfg, p.g, handle, use_board, res_i.state,
+                    res_i.history, res_i.waits_total,
+                    t_final=(None if stop_at is None else stop_at + 1))
+            else:
+                history_i = {k: np.concatenate(v, axis=1)
+                             for k, v in per_parts.items()}
+                data = drv.assemble_run_data(
+                    cfg, p.g, handle, use_board, states_i, history_i,
+                    waits_i, t_final=stop_at)
+            if stop_at is not None:
+                data["early_stopped"] = stop_at
+            data["batch"] = batch_id
+            data["batch_chains"] = c_total
+            return data
+
+        while done < total and active:
             check_deadline()
             lifecycle.check_drain(batch_id)
             rfaults.fault_point("segment.step", tag=batch_id, done=done)
@@ -601,10 +660,17 @@ class SweepService:
                 hist_parts.setdefault(k, []).append(v)
             waits_total += res.waits_total
             done += n
+            if ctl is not None:
+                for pos, i in enumerate(active):
+                    lo, hi = int(offsets[pos]), int(offsets[pos + 1])
+                    for k, v in res.history.items():
+                        per_hist[i].setdefault(k, []).append(
+                            np.asarray(v)[lo:hi])
             if self.checkpoint_dir:
                 host = res.host_state()
-                for i, p in enumerate(prepared):
-                    lo, hi = int(offsets[i]), int(offsets[i + 1])
+                for pos, i in enumerate(active):
+                    p = prepared[i]
+                    lo, hi = int(offsets[pos]), int(offsets[pos + 1])
                     cfg = p.job.config
                     with obs.span(rec, "checkpoint", tag=cfg.tag,
                                   done=done):
@@ -615,34 +681,93 @@ class SweepService:
                             new_hist={k: np.asarray(v)[lo:hi]
                                       for k, v in res.history.items()},
                             part_idx=p.n_parts)
-        if use_board:
-            res = finalize_board_run(handle, spec, params, states,
-                                     hist_parts, waits_total, [], True,
-                                     cfg0.total_steps, cfg0.record_every,
-                                     recorder=rec)
-            states, history, waits_total = (res.state, res.history,
-                                            res.waits_total)
-        else:
-            history = {k: np.concatenate(v, axis=1)
-                       for k, v in hist_parts.items()}
-        wall = time.perf_counter() - t0
+            if ctl is not None and done < total:
+                stopped_now = []
+                for pos, i in enumerate(active):
+                    cfg = prepared[i].job.config
+                    if ctl.consult_stop(
+                            cfg.tag, family=cfg.family, done=done,
+                            total=total, every=every,
+                            history=drv._control_history(per_hist[i])):
+                        stopped_now.append((pos, i))
+                if stopped_now:
+                    stop_set = {i for _, i in stopped_now}
+                    for pos, i in stopped_now:
+                        lo, hi = int(offsets[pos]), int(offsets[pos + 1])
+                        results.append((prepared[i].job, _tenant_data(
+                            i, _slice_chains(states, lo, hi),
+                            per_hist[i], waits_total[lo:hi].copy(),
+                            stop_at=done)))
+                    remaining = [i for i in active if i not in stop_set]
+                    if remaining:
+                        keep = [(int(offsets[pos]), int(offsets[pos + 1]))
+                                for pos, i in enumerate(active)
+                                if i in remaining]
+                        states = concat_states(
+                            [_slice_chains(states, lo, hi)
+                             for lo, hi in keep])
+                        params = concat_params(
+                            [prepared[i].params for i in remaining])
+                        waits_total = np.concatenate(
+                            [waits_total[lo:hi] for lo, hi in keep])
+                        to_tags = [prepared[i].job.tag
+                                   for i in remaining]
+                        for _, i in stopped_now:
+                            ctl.reallocate(
+                                batch_id, step=done,
+                                from_tag=prepared[i].job.tag,
+                                to_tags=to_tags,
+                                freed_chains=(
+                                    prepared[i].job.config.n_chains))
+                    active = remaining
+                    offsets = _active_offsets()
+                    job_ids = [prepared[i].job.job_id for i in active]
+
+        wall = None
+        if active and not (ctl is not None and len(active)
+                           < len(prepared)):
+            # original whole-batch epilogue (control off, or control on
+            # with nothing stopped): finalize the full concat once and
+            # slice per tenant
+            if use_board:
+                res = finalize_board_run(handle, spec, params, states,
+                                         hist_parts, waits_total, [],
+                                         True, cfg0.total_steps,
+                                         cfg0.record_every, recorder=rec)
+                states, history, waits_total = (res.state, res.history,
+                                                res.waits_total)
+            else:
+                history = {k: np.concatenate(v, axis=1)
+                           for k, v in hist_parts.items()}
+            wall = time.perf_counter() - t0
+            for i, p in enumerate(prepared):
+                lo, hi = int(offsets[i]), int(offsets[i + 1])
+                data = drv.assemble_run_data(
+                    p.job.config, p.g, handle, use_board,
+                    _slice_chains(states, lo, hi),
+                    {k: np.asarray(v)[lo:hi] for k, v in history.items()},
+                    waits_total[lo:hi].copy())
+                data["batch"] = batch_id
+                data["batch_chains"] = c_total
+                results.append((p.job, data))
+        elif active:
+            # some tenants retired mid-run: the whole-batch history
+            # layout changed, so finalize the survivors per tenant
+            for pos, i in enumerate(active):
+                lo, hi = int(offsets[pos]), int(offsets[pos + 1])
+                results.append((prepared[i].job, _tenant_data(
+                    i, _slice_chains(states, lo, hi), per_hist[i],
+                    waits_total[lo:hi].copy())))
+        if wall is None:
+            wall = time.perf_counter() - t0
+        for _, data in results:
+            data["seconds"] = wall
         self.batch_stats.append(BatchStats(
             batch_id=batch_id, jobs=[p.job.job_id for p in prepared],
             chains=c_total, steps=cfg0.total_steps, wall_s=wall,
             kernel_path=path, cache_hit=hit))
-
-        results = []
-        for i, p in enumerate(prepared):
-            lo, hi = int(offsets[i]), int(offsets[i + 1])
-            data = drv.assemble_run_data(
-                p.job.config, p.g, handle, use_board,
-                _slice_chains(states, lo, hi),
-                {k: np.asarray(v)[lo:hi] for k, v in history.items()},
-                waits_total[lo:hi].copy())
-            data["seconds"] = wall
-            data["batch"] = batch_id
-            data["batch_chains"] = c_total
-            results.append((p.job, data))
+        results.sort(key=lambda r: [p.job.job_id
+                                    for p in prepared].index(r[0].job_id))
         return results
 
     # -- job terminals -----------------------------------------------

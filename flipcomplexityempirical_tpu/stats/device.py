@@ -36,6 +36,13 @@ def ess_device(x, c: float = 5.0):
     if x.ndim == 1:
         x = x[None, :]
     ch, t = x.shape
+    if t < 4:
+        # host-parity tiny-T guard (diagnostics.integrated_autocorr_time
+        # returns tau = 1 below any meaningful window): ess = T per
+        # chain. t is a static shape, so the Python branch is trace-safe
+        # — and it sidesteps the t=0/1 FFT division-by-zero entirely.
+        per = jnp.full((ch,), float(t), jnp.float32)
+        return per, per.sum()
     xc = x - x.mean(axis=1, keepdims=True)
     n_fft = 1
     while n_fft < 2 * t:

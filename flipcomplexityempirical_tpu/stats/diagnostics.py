@@ -53,6 +53,13 @@ def integrated_autocorr_time(x, c: float = 5.0) -> np.ndarray:
     tau ~= 1.
     """
     x = _chains(x)
+    if x.shape[1] < 4:
+        # shorter than any meaningful autocorrelation window (a short
+        # first segment under a small checkpoint_every): tau = 1, i.e.
+        # every sample counts — ess degrades gracefully to T per chain
+        # instead of dividing by a window the data cannot support
+        # (device twin: stats/device.ess_device, parity-tested at tiny T)
+        return np.ones(x.shape[0])
     rho = autocorrelation(x)
     # chain-averaged ACF gives a lower-variance window choice, but tau is
     # reported per chain from its own ACF with the shared window

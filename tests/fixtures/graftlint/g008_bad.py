@@ -1,0 +1,32 @@
+"""G008 positive fixture: every impurity class the rule must flag in a
+control/ policy module."""
+
+import random
+import time
+
+import numpy as np
+
+
+def decide_with_clock(history):
+    started = time.time()          # BAD: wall-clock read in control/
+    elapsed = time.monotonic()     # BAD: even monotonic timers
+    return started, elapsed, history
+
+
+def decide_with_rng(history):
+    if random.random() < 0.5:      # BAD: unseeded process RNG
+        return "stop"
+    jitter = np.random.uniform()   # BAD: numpy global RNG
+    return jitter
+
+
+class LeakyStopPolicy:
+    """A policy that emits and journals directly instead of proposing."""
+
+    def propose(self, view, recorder, journal):
+        actions = []
+        recorder.emit("control_action", kind="stop",  # BAD: policy emits
+                      tag=view.tag, step=view.done, policy="leaky")
+        journal.append("control_action",              # BAD: policy journals
+                       action="stop", tag=view.tag)
+        return actions

@@ -1,0 +1,30 @@
+"""G008 negative fixture: a pure control policy — deterministic in the
+observed history, no clocks, no RNG, no emission; plain list.append and
+numpy statistics stay legal."""
+
+import numpy as np
+
+
+class PureStopPolicy:
+    name = "pure_stop"
+
+    def __init__(self, target=1.05):
+        self.target = target
+
+    def propose(self, view):
+        actions = []
+        hist = view.history
+        if hist is None:
+            return actions
+        spread = float(np.asarray(hist).std())
+        if spread < self.target:
+            # proposing is fine — the ControlLoop emits/journals
+            actions.append(("stop", view.tag, view.done))
+        return actions
+
+
+def summarize(loop_actions):
+    parts = []
+    for act in loop_actions:
+        parts.append(str(act))  # plain list.append is not journaling
+    return ", ".join(parts)

@@ -1,0 +1,408 @@
+"""control/: monitor-driven adaptive sweep control.
+
+Covers the purity/determinism contract of the built-in policies, the
+ControlLoop's emit/journal/adopt round trip, the driver's early-stop
+path (bit-identical to a truncated fixed schedule), the tempered
+ladder reshape, the service's batch reallocate, and the tiny-history
+ESS guards (host <-> device parity below the autocorrelation window).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.control import (AutotunePolicy,
+                                                ControlLoop,
+                                                EarlyStopPolicy,
+                                                LadderPolicy)
+from flipcomplexityempirical_tpu.control.policy import (ObservedState,
+                                                        quantize_latency)
+from flipcomplexityempirical_tpu.experiments import driver as drv
+from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
+from flipcomplexityempirical_tpu.obs.metrics import (DEFAULT_EDGES,
+                                                     MetricsRegistry)
+from flipcomplexityempirical_tpu.service.journal import Journal
+from flipcomplexityempirical_tpu.service.scheduler import SweepService
+from flipcomplexityempirical_tpu.stats.diagnostics import ess
+from flipcomplexityempirical_tpu.stats.device import ess_device
+
+# same segmenting as tests/test_preemption.py (60 steps in 20-step
+# segments, 2 chains) so the jit specializations are shared across the
+# suite's modules
+FRANK = dict(family="frank", base=0.3, pop_tol=0.1, total_steps=60,
+             n_chains=2, checkpoint_every=20)
+
+# targets the 60-step histories comfortably meet at the first boundary
+# (split R-hat ~1.8-2.1, total ESS ~14-15 at T=21)
+LOOSE = dict(rhat_target=5.0, ess_target=4.0, patience=1, min_columns=4)
+
+
+def _solo(cfg, control=None, built=None):
+    """build + raw segmented driver run (no rendering: keeps the
+    equality tests inside the fast-tier budget)."""
+    g, plan = built if built is not None else \
+        drv.build_graph_and_plan(cfg)[:2]
+    return drv._run_jax(cfg, g, plan, None, control=control)
+
+
+def _view(**kw):
+    base = dict(tag="t", family="frank", done=40, total=100, every=20)
+    base.update(kw)
+    return ObservedState(**base)
+
+
+def _mixed_history(seed=0, c=4, t=64):
+    return np.random.default_rng(seed).normal(size=(c, t))
+
+
+# ---------------------------------------------------------------------------
+# policies: pure observed-history -> actions
+# ---------------------------------------------------------------------------
+
+def test_early_stop_policy_is_deterministic():
+    pol = EarlyStopPolicy(rhat_target=2.0, ess_target=1.0, patience=1,
+                          min_columns=4)
+    view = _view(history=_mixed_history())
+    first = pol.propose(view)
+    assert [a.kind for a in first] == ["stop"]
+    # pure: the identical view yields the identical action, detail and
+    # all (replay equality is judged on the JSON of the detail)
+    for _ in range(3):
+        again = pol.propose(view)
+        assert [json.dumps(a.doc(), sort_keys=True) for a in again] == \
+            [json.dumps(a.doc(), sort_keys=True) for a in first]
+
+
+def test_early_stop_policy_respects_gates():
+    pol = EarlyStopPolicy(rhat_target=2.0, ess_target=1.0, patience=1,
+                          min_steps=50, min_columns=4)
+    hist = _mixed_history()
+    assert pol.propose(_view(history=hist, done=40)) == []  # < min_steps
+    assert pol.propose(_view(history=hist, done=60)) != []
+    assert pol.propose(_view(history=hist, done=60,
+                             family="temper")) == []        # temper exempt
+    assert pol.propose(_view(history=None, done=60)) == []
+    assert pol.propose(_view(history=hist, done=100)) == []  # already done
+    assert pol.propose(_view(history=hist, done=60,
+                             taken={"stop": 1})) == []       # once only
+    whitelisted = EarlyStopPolicy(rhat_target=2.0, ess_target=1.0,
+                                  patience=1, min_columns=4,
+                                  tags=("other",))
+    assert whitelisted.propose(_view(history=hist, done=60)) == []
+
+
+def test_early_stop_patience_needs_enough_boundaries():
+    pol = EarlyStopPolicy(rhat_target=10.0, ess_target=1.0, patience=3,
+                          min_columns=4)
+    hist = _mixed_history(t=60)
+    # only 2 grid points (20, 40) exist at done=40: patience=3 unmet
+    assert pol.propose(_view(history=hist, done=40, every=20)) == []
+    assert pol.propose(_view(history=hist, done=60, every=20,
+                             total=200)) != []
+
+
+def test_ladder_policy_contracts_widens_and_pins_cold_rung():
+    pol = LadderPolicy(low=0.15, high=0.60)
+    betas = (1.0, 0.5, 0.25)
+    att = np.full(2, 20)
+
+    def propose(accepts, **kw):
+        return pol.propose(_view(
+            family="temper", swap_attempts=att,
+            swap_accepts=np.asarray(accepts), betas=betas, **kw))
+
+    low = propose([1, 1])
+    assert low[0].detail["direction"] == "contract"
+    high = propose([15, 15])
+    assert high[0].detail["direction"] == "widen"
+    mid = propose([8, 8])
+    assert mid == []
+    for acts in (low, high):
+        new = acts[0].detail["betas"]
+        assert new[0] == 1.0                       # cold rung exact
+        assert all(a > b for a, b in zip(new, new[1:]))
+    # anomaly pulls a mid-band rate into a contraction
+    anom = pol.propose(_view(
+        family="temper", swap_attempts=att,
+        swap_accepts=np.asarray([8, 8]), betas=betas,
+        anomalies=("acceptance_collapse",)))
+    assert anom[0].detail["direction"] == "contract"
+    # starved statistics: no decision yet
+    assert pol.propose(_view(
+        family="temper", swap_attempts=np.asarray([1, 1]),
+        swap_accepts=np.asarray([0, 0]), betas=betas)) == []
+    # bounded: a taken reshape blocks further ones
+    assert pol.propose(_view(
+        family="temper", swap_attempts=att,
+        swap_accepts=np.asarray([0, 0]), betas=betas,
+        taken={"reshape_ladder": 1})) == []
+
+
+def test_autotune_policy_reads_quantized_buckets_once():
+    pol = AutotunePolicy(target_wall_s=1.0)
+    slow = _view(every=64, p95_bucket={"segment_wall_s": (5.0, 4)})
+    acts = pol.propose(slow)
+    assert acts[0].kind == "retune"
+    assert acts[0].detail["advisory"] is True
+    assert acts[0].detail["segment_steps"] < 64
+    fast = _view(every=64, total=1000,
+                 p95_bucket={"segment_wall_s": (0.1, 4)})
+    assert pol.propose(fast)[0].detail["segment_steps"] == 128
+    in_band = _view(every=64, p95_bucket={"segment_wall_s": (1.0, 4)})
+    assert pol.propose(in_band) == []
+    assert pol.propose(_view(every=64)) == []                # no reading
+    assert pol.propose(dataclasses.replace(
+        slow, taken={"retune": 1})) == []                    # once only
+    assert pol.propose(dataclasses.replace(
+        slow, p95_bucket={"segment_wall_s": (5.0, 1)})) == []  # count < 2
+
+
+def test_quantize_latency_snaps_to_histogram_edges():
+    for v, want in ((0.0011, 0.002), (0.7, 1.0), (1.0, 1.0), (3.0, 5.0)):
+        assert quantize_latency(v) == want
+    assert quantize_latency(1e13) == DEFAULT_EDGES[-1]
+
+
+# ---------------------------------------------------------------------------
+# the loop: emit / journal / adopt
+# ---------------------------------------------------------------------------
+
+def test_loop_emits_event_and_journal_record(tmp_path):
+    ev = str(tmp_path / "events.jsonl")
+    j = Journal(str(tmp_path / "journal.jsonl"))
+    with obs.Recorder(ev) as rec:
+        loop = ControlLoop(
+            policies=[EarlyStopPolicy(rhat_target=2.0, ess_target=1.0,
+                                      patience=1, min_columns=4)],
+            recorder=rec, journal=j)
+        acts = loop.consult("t0", family="frank", done=40, total=100,
+                            every=20, history=_mixed_history())
+    assert [a.kind for a in acts] == ["stop"]
+    assert loop.stopped("t0") and loop.stop_step("t0") == 40
+    events = [json.loads(l) for l in open(ev)]
+    ctl = [e for e in events if e["event"] == "control_action"]
+    assert [(e["kind"], e["tag"], e["step"], e["policy"])
+            for e in ctl] == [("stop", "t0", 40, "early_stop")]
+    records, _ = Journal.read(j.path)
+    ctl_r = [r for r in records if r["kind"] == "control_action"]
+    assert [(r["action"], r["tag"], r["step"]) for r in ctl_r] == \
+        [("stop", "t0", 40)]
+    assert ctl_r[0]["detail"] == ctl[0]["detail"]
+
+
+def test_loop_adopt_replays_instead_of_rederiving(tmp_path):
+    j = Journal(str(tmp_path / "journal.jsonl"))
+    pol = EarlyStopPolicy(rhat_target=2.0, ess_target=1.0, patience=1,
+                          min_columns=4)
+    loop = ControlLoop(policies=[pol], journal=j)
+    hist = _mixed_history()
+    loop.consult("t0", family="frank", done=40, total=100, every=20,
+                 history=hist)
+    records, _ = Journal.read(j.path)
+
+    j2 = Journal(str(tmp_path / "journal2.jsonl"))
+    loop2 = ControlLoop(policies=[pol], journal=j2)
+    assert loop2.adopt(records) == 1
+    assert loop2.stopped("t0") and loop2.stop_step("t0") == 40
+    # the adopted stop replays at/after its boundary without re-emission
+    assert not loop2.consult_stop("t0", family="frank", done=20,
+                                  total=100, every=20, history=hist)
+    assert loop2.consult_stop("t0", family="frank", done=40, total=100,
+                              every=20, history=hist)
+    assert loop2.actions == []
+    assert Journal.read(j2.path)[0] == []
+
+
+def test_loop_dedups_stops_and_collects_anomalies():
+    pols = [EarlyStopPolicy(rhat_target=2.0, ess_target=1.0, patience=1,
+                            min_columns=4),
+            EarlyStopPolicy(rhat_target=3.0, ess_target=1.0, patience=1,
+                            min_columns=4, name="early_stop_b")]
+    loop = ControlLoop(policies=pols)
+    acts = loop.consult("t0", family="frank", done=40, total=100,
+                        every=20, history=_mixed_history())
+    assert [a.kind for a in acts] == ["stop"]       # second proposer deduped
+    assert loop.consult("t0", family="frank", done=60, total=100,
+                        every=20, history=_mixed_history()) == []
+    loop.observe_anomaly("tm", "acceptance_collapse")
+    loop.observe_anomaly("tm", "acceptance_collapse")
+    assert loop._anomalies["tm"] == ["acceptance_collapse"]
+
+
+def test_loop_quantizes_segment_histogram_for_policies():
+    metrics = MetricsRegistry()
+    for v in (0.9, 1.1, 4.0):
+        metrics.observe("segment_wall_s", v)
+    seen = {}
+
+    class Probe:
+        name = "probe"
+
+        def propose(self, view):
+            seen.update(view.p95_bucket)
+            return []
+
+    ControlLoop(policies=[Probe()], metrics=metrics).consult(
+        "t", family="frank", done=20, total=100, every=20)
+    bucket, count = seen["segment_wall_s"]
+    assert count == 3
+    assert bucket in DEFAULT_EDGES
+
+
+# ---------------------------------------------------------------------------
+# driver integration: the early-stopped run IS the truncated schedule
+# ---------------------------------------------------------------------------
+
+def test_driver_early_stop_matches_truncated_schedule():
+    cfg = ExperimentConfig(alignment=2, seed=3, **FRANK)
+    built = tuple(drv.build_graph_and_plan(cfg)[:2])
+    loop = ControlLoop(policies=[EarlyStopPolicy(**LOOSE)])
+    data = _solo(cfg, control=loop, built=built)
+    stop = data.get("early_stopped")
+    assert stop == 20
+    assert [(a.kind, a.tag, a.step) for a in loop.actions] == \
+        [("stop", cfg.tag, 20)]
+    # board family: the stop closes the run at boundary+1 yields, which
+    # must be bit-identical to a fresh FIXED schedule of that length
+    ref_cfg = dataclasses.replace(cfg, total_steps=stop + 1,
+                                  checkpoint_every=0)
+    ref = _solo(ref_cfg, built=built)
+    for k in data["history"]:
+        np.testing.assert_array_equal(
+            np.asarray(data["history"][k]),
+            np.asarray(ref["history"][k]), err_msg=f"history[{k}]")
+    np.testing.assert_array_equal(np.asarray(data["waits_all"]),
+                                  np.asarray(ref["waits_all"]))
+
+
+@pytest.mark.slow
+def test_driver_without_control_is_unchanged():
+    cfg = ExperimentConfig(alignment=2, seed=3, **FRANK)
+    built = tuple(drv.build_graph_and_plan(cfg)[:2])
+    a = _solo(cfg, built=built)
+    b = _solo(cfg, control=None, built=built)
+    assert "early_stopped" not in a
+    for k in a["history"]:
+        np.testing.assert_array_equal(np.asarray(a["history"][k]),
+                                      np.asarray(b["history"][k]))
+
+
+@pytest.mark.slow
+def test_temper_reshape_applies_and_checkpoints(tmp_path):
+    cfg = ExperimentConfig(family="temper", alignment=0, base=1 / 0.3,
+                           pop_tol=0.1, total_steps=60, n_chains=2,
+                           betas=(1.0, 0.9, 0.8, 0.7), swap_every=10,
+                           checkpoint_every=20, seed=29)
+    loop = ControlLoop(policies=[LadderPolicy(low=0.99, high=0.999,
+                                              min_attempts_per_pair=1)])
+    data = drv.run_config(cfg, str(tmp_path / "out"), control=loop)
+    reshapes = [a for a in loop.actions if a.kind == "reshape_ladder"]
+    assert len(reshapes) == 1          # max_reshapes bound holds
+    new = reshapes[0].detail["betas"]
+    assert new[0] == 1.0
+    assert all(a > b for a, b in zip(new, new[1:]))
+    assert "rung_cut" in data          # run completed its full schedule
+
+
+# ---------------------------------------------------------------------------
+# service integration: batch early stop frees chains to stragglers
+# ---------------------------------------------------------------------------
+
+def test_service_batch_reallocates_stopped_tenant(tmp_path):
+    cfgs = [ExperimentConfig(alignment=al, seed=seed, **FRANK)
+            for al, seed in ((2, 3), (1, 4))]
+    loop = ControlLoop(policies=[EarlyStopPolicy(
+        tags=(cfgs[0].tag,), **LOOSE)])
+    svc = SweepService(outdir=str(tmp_path), control=loop, verbose=False)
+    jobs = [svc.submit(c) for c in cfgs]
+    svc.run_until_idle()
+    assert [j.status for j in jobs] == ["done", "done"]
+
+    stops = [a for a in loop.actions if a.kind == "stop"]
+    reallocs = [a for a in loop.actions if a.kind == "reallocate"]
+    assert [(a.tag, a.step) for a in stops] == [(cfgs[0].tag, 20)]
+    assert len(reallocs) == 1
+    assert reallocs[0].detail["from"] == cfgs[0].tag
+    assert reallocs[0].detail["to"] == [cfgs[1].tag]
+    assert reallocs[0].detail["freed_chains"] == cfgs[0].n_chains
+
+    # the stopped tenant's artifacts are the truncated fixed schedule;
+    # the straggler's are its full solo run — both bit-identical
+    stop = stops[0].step
+    ref0 = _solo(dataclasses.replace(cfgs[0], total_steps=stop + 1,
+                                     checkpoint_every=0))
+    assert jobs[0].result["early_stopped"] == stop
+    for k in ref0["history"]:
+        np.testing.assert_array_equal(
+            np.asarray(jobs[0].result["history"][k]),
+            np.asarray(ref0["history"][k]), err_msg=f"stopped[{k}]")
+    ref1 = _solo(dataclasses.replace(cfgs[1], checkpoint_every=0))
+    for k in ref1["history"]:
+        np.testing.assert_array_equal(
+            np.asarray(jobs[1].result["history"][k]),
+            np.asarray(ref1["history"][k]), err_msg=f"straggler[{k}]")
+
+    # the decisions rode the service journal
+    records, _ = Journal.read(svc.journal.path)
+    kinds = [(r["action"], r["tag"]) for r in records
+             if r["kind"] == "control_action"]
+    assert kinds == [("stop", cfgs[0].tag), ("reallocate", "b0000")]
+
+
+@pytest.mark.slow
+def test_service_without_control_keeps_batch_path(tmp_path):
+    cfgs = [ExperimentConfig(alignment=al, seed=seed, **FRANK)
+            for al, seed in ((2, 3), (1, 4))]
+    svc = SweepService(outdir=str(tmp_path), verbose=False)
+    jobs = [svc.submit(c) for c in cfgs]
+    svc.run_until_idle()
+    assert all(j.status == "done" for j in jobs)
+    assert all("early_stopped" not in j.result for j in jobs)
+
+
+# ---------------------------------------------------------------------------
+# tiny-history diagnostics guards (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("t", [1, 2, 3])
+def test_tiny_history_ess_host_device_parity(t):
+    x = np.arange(2 * t, dtype=np.float64).reshape(2, t)
+    per_h, tot_h = ess(x)
+    np.testing.assert_allclose(per_h, np.full(2, float(t)))
+    assert tot_h == 2.0 * t
+    per_d, tot_d = ess_device(x)
+    np.testing.assert_allclose(np.asarray(per_d), per_h)
+    assert float(tot_d) == tot_h
+
+
+def test_tiny_history_ess_single_chain():
+    per, tot = ess(np.asarray([0.5, 1.5]))
+    np.testing.assert_allclose(per, [2.0])
+    assert tot == 2.0
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate itself
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_control_check_gate():
+    """The bench leg (adaptive must beat fixed to the ESS target) plus
+    the lint leg; the drain->replay story already runs in-process above
+    and in tests/test_preemption.py, so the gate's replay leg is left
+    to `make control-check`."""
+    proc = subprocess.run(
+        [os.path.join(REPO, "tools", "control_check.sh")],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "CONTROL_LEGS": "lint bench"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "control-check: OK" in proc.stdout
+    assert "control-check[bench]:" in proc.stdout

@@ -118,6 +118,25 @@ def test_g007_catches_each_hazard_kind():
     assert "random.uniform" in msgs
 
 
+def test_g008_catches_each_impurity_kind():
+    msgs = "\n".join(f.message for f in _lint_fixture("g008_bad.py", "G008"))
+    assert "time.time() reads a clock" in msgs
+    assert "time.monotonic() reads a clock" in msgs
+    assert "random.random() draws randomness" in msgs
+    assert "np.random.uniform() draws randomness" in msgs
+    assert "emit() from inside a Policy" in msgs
+    assert "journal.append() from inside a Policy" in msgs
+
+
+def test_g008_control_package_is_clean():
+    # the shipped control/ package must satisfy its own purity gate
+    import glob
+    cfg = LintConfig(root=REPO, rules=frozenset({"G008"}))
+    pkg = os.path.join(REPO, "flipcomplexityempirical_tpu", "control")
+    for path in sorted(glob.glob(os.path.join(pkg, "*.py"))):
+        assert lint_file(path, cfg) == [], path
+
+
 def test_g006_threshold_is_configurable():
     cfg = LintConfig(root=REPO, rules=frozenset({"G006"}),
                      max_test_steps=100000)

@@ -27,6 +27,7 @@ import numpy as np
 import pytest
 
 from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.control import ControlLoop, EarlyStopPolicy
 from flipcomplexityempirical_tpu.experiments import driver as drv
 from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
 from flipcomplexityempirical_tpu.obs.metrics import MetricsRegistry
@@ -343,6 +344,85 @@ def test_drain_requeue_does_not_burn_attempts(drained_scenario):
     state = jnl.replay(records[:_cut_index(records, "during_drain")])
     assert all(st["status"] == "queued" and st["attempts"] <= 1
                for st in state.values()), state
+
+
+# ---------------------------------------------------------------------------
+# adaptive control across a drain: the recovered service REPLAYS the
+# journaled decisions, bit-identically
+# ---------------------------------------------------------------------------
+
+# loose enough that the 60-step frank histories pass at the FIRST
+# 20-step boundary (split R-hat ~1.8-2.1, total ESS ~14-15 there), so
+# every job's story is: one segment, one journaled stop
+_LOOSE_STOP = dict(rhat_target=5.0, ess_target=4.0, patience=1,
+                   min_columns=4)
+
+
+def _control_action_key(r):
+    return (r["action"], r["tag"], r["step"], r["policy"],
+            json.dumps(r["detail"], sort_keys=True))
+
+
+def test_drain_recover_replays_identical_control_actions(tmp_path):
+    """SIGTERM-drain a controlled sweep mid-run, recover it, and demand
+    the journal's control_action sequence — and the artifacts — come out
+    identical to an uninterrupted run of the same submissions. The
+    recovered loop ADOPTS the journaled stop (it does not re-derive or
+    re-journal it), and jobs resumed at/past their stop boundary close
+    immediately."""
+    cfgs = [_cfg(alignment=2, seed=3), _cfg(alignment=1, seed=4)]
+
+    # reference: same submissions, no interruption
+    ref_dir = str(tmp_path / "ref")
+    ref_loop = ControlLoop(policies=[EarlyStopPolicy(**_LOOSE_STOP)])
+    ref_svc = SweepService(outdir=ref_dir, max_batch_chains=2,
+                           control=ref_loop)
+    ref_jobs = [ref_svc.submit(c) for c in cfgs]
+    ref_svc.run_until_idle()
+    assert [j.status for j in ref_jobs] == ["done", "done"]
+    assert all(j.result["early_stopped"] == 20 for j in ref_jobs)
+    ref_records, _ = Journal.read(jnl.journal_path_for(ref_dir))
+    ref_ctl = [_control_action_key(r) for r in ref_records
+               if r["kind"] == "control_action"]
+    assert [(k[0], k[2]) for k in ref_ctl] == [("stop", 20)] * 2
+
+    # drained run: job 1's stop consumes sigterm hit 1 (the stop breaks
+    # the segment loop), job 2's first boundary takes hit 2 -> drain
+    td = str(tmp_path / "drained")
+    rfaults.install_from_spec("sigterm:once@2")
+    loop = ControlLoop(policies=[EarlyStopPolicy(**_LOOSE_STOP)])
+    svc = SweepService(outdir=td, max_batch_chains=2, control=loop)
+    jobs = [svc.submit(c) for c in cfgs]
+    svc.run_until_idle()
+    rfaults.install_plan(None)
+    clear_drain()
+    assert svc.drained and svc.exit_code == EXIT_DRAINED
+
+    # recovery: a FRESH loop adopts the journaled decisions
+    loop2 = ControlLoop(policies=[EarlyStopPolicy(**_LOOSE_STOP)])
+    svc2 = SweepService.recover(td, max_batch_chains=2, control=loop2)
+    mid_records, _ = Journal.read(jnl.journal_path_for(td))
+    adopted = sum(r["kind"] == "control_action" for r in mid_records)
+    assert loop2.taken(cfgs[0].tag).get("stop", 0) + \
+        loop2.taken(cfgs[1].tag).get("stop", 0) == adopted >= 1
+    svc2.run_until_idle()
+    assert svc2.exit_code == 0
+    done = {j.tag: j for j in svc2.queue.jobs()}
+    assert all(done[c.tag].status == "done" for c in cfgs)
+
+    # the FULL journal (drained prefix + recovery) tells the identical
+    # control story, decision for decision, detail byte for byte
+    records, truncated = Journal.read(jnl.journal_path_for(td))
+    assert not truncated
+    ctl = [_control_action_key(r) for r in records
+           if r["kind"] == "control_action"]
+    assert ctl == ref_ctl
+
+    # and the artifacts match the uninterrupted run's
+    for c, ref_job in zip(cfgs, ref_jobs):
+        got = done[c.tag].result
+        if got is not None and ref_job.result is not None:
+            _assert_result_matches(got, ref_job.result)
 
 
 # ---------------------------------------------------------------------------

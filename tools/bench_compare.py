@@ -63,7 +63,13 @@ def extract_metrics(doc, out: dict | None = None) -> dict:
     if isinstance(doc, dict):
         if "metric" in doc and isinstance(doc.get("value"), (int, float)):
             name = str(doc["metric"])
-            if "tenants" in doc:
+            if "policy" in doc and "family" in doc:
+                # adaptive-control records (bench --adaptive): a
+                # wall-clock-to-target-ESS ratio is only comparable
+                # under the same workload family and policy stack
+                name += (f"[family={doc['family']},"
+                         f"policy={doc['policy']}]")
+            elif "tenants" in doc:
                 # sweep-service records (bench --service): a 4-tenant
                 # and an 8-tenant efficiency measure different
                 # coalescing shapes — qualify so they never gate

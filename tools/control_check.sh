@@ -1,0 +1,214 @@
+#!/usr/bin/env bash
+# Adaptive-control CI gate (`make control-check`, ISSUE 12): the
+# observe->act loop must PAY ITS WAY and survive preemption.
+#
+# - lint:   graftlint over control/ — G008 enforces policy purity (no
+#           clocks, no unseeded RNG, no recorder/journal mutation from
+#           inside a policy), which is what makes the control plane
+#           journal-replayable at all.
+# - bench:  a seeded CPU sweep (two frank configs + one tempered
+#           ladder) run adaptive and fixed from the same warm jit
+#           cache: the adaptive leg must reach the split-R-hat/ESS
+#           targets in strictly less wall clock (value > 1.0x), with at
+#           least one journaled early stop; the event stream must
+#           validate and the report must render its Control section;
+#           bench_compare must qualify the record per (family, policy).
+# - replay: SIGTERM-drain a controlled service mid-sweep (exit 3), then
+#           recover in a FRESH process whose ControlLoop adopts the
+#           journaled decisions — the full journal's control_action
+#           sequence must be bit-identical to an uninterrupted
+#           reference run's, and so must the per-tenant artifacts.
+#
+#   tools/control_check.sh                 # all legs
+#   CONTROL_LEGS="lint replay" tools/control_check.sh
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+TD="$(mktemp -d)"
+trap 'rm -rf "$TD"' EXIT
+
+# one persistent XLA cache across the legs' processes: the recovered
+# process must not re-pay the drained process's compiles
+export JAX_COMPILATION_CACHE_DIR="$TD/jax-cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+
+LEGS="${CONTROL_LEGS:-lint bench replay}"
+
+for LEG in $LEGS; do
+case "$LEG" in
+
+lint)
+  "$PY" -m tools.graftlint flipcomplexityempirical_tpu/control
+  echo "control-check[lint]: control/ is G008-clean"
+  ;;
+
+bench)
+  # steps=961 puts the early stops (~160/320) far enough from the full
+  # schedule that the adaptive margin is robust, not a timing coin flip
+  JAX_PLATFORMS=cpu "$PY" bench.py --adaptive --cpu \
+      --steps "${CONTROL_STEPS:-961}" --chains 4 --target-ess 32 \
+      --events "$TD/events.bench.jsonl" \
+      > "$TD/record.json" 2> "$TD/bench.meta"
+  "$PY" - "$TD/record.json" <<'PYEOF'
+import json
+import sys
+
+rec = json.load(open(sys.argv[1]))
+assert rec["metric"] == "wall_clock_to_target_ess", rec
+assert rec["value"] > 1.0, \
+    f"adaptive did not beat the fixed schedule: {rec['value']}x"
+assert rec["stops"], "no early stop fired — the loop did nothing"
+print(f"control-check[bench]: {rec['value']}x fixed/adaptive "
+      f"(stops at {[s['step'] for s in rec['stops']]}, "
+      f"reshapes at {[r['step'] for r in rec['reshapes']]})")
+PYEOF
+  "$PY" tools/obs_report.py "$TD/events.bench.jsonl" --check
+  "$PY" tools/obs_report.py "$TD/events.bench.jsonl" \
+      | grep -q "^## Control" \
+      || { echo "control-check: report is missing its Control section"; \
+           exit 1; }
+  # self-compare: the record must extract under its (family, policy)
+  # qualified metric name, not collide with other adaptive records
+  "$PY" tools/bench_compare.py "$TD/record.json" "$TD/record.json" \
+      | grep -q "wall_clock_to_target_ess\[family=frank+temper,policy=early_stop+ladder\]" \
+      || { echo "control-check: bench_compare did not qualify the record"; \
+           exit 1; }
+  ;;
+
+replay)
+  OUT="$TD/replay"
+  mkdir -p "$OUT/drained" "$OUT/ref"
+
+  # --- drain: job 1's early stop consumes sigterm hit 1 (the stop
+  # breaks its segment loop); job 2's first boundary takes hit 2 and
+  # the service drains with the distinct exit code 3.
+  set +e
+  JAX_PLATFORMS=cpu GRAFT_FAULTS="sigterm:once@2" \
+      "$PY" - "$OUT/drained" <<'PYEOF'
+import os
+import sys
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.control import ControlLoop, EarlyStopPolicy
+from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
+from flipcomplexityempirical_tpu.resilience import faults as rfaults
+from flipcomplexityempirical_tpu.service import SweepService
+
+out = sys.argv[1]
+rfaults.install_from_env()
+cfgs = [ExperimentConfig(family="frank", alignment=al, base=0.3,
+                         pop_tol=0.1, total_steps=60, n_chains=2,
+                         backend="jax", checkpoint_every=20, seed=seed)
+        for al, seed in ((2, 3), (1, 4))]
+loop = ControlLoop(policies=[EarlyStopPolicy(
+    rhat_target=5.0, ess_target=4.0, patience=1, min_columns=4)])
+with obs.Recorder(os.path.join(out, "events.drain.jsonl")) as rec:
+    svc = SweepService(outdir=out, recorder=rec, max_batch_chains=2,
+                       control=loop)
+    for c in cfgs:
+        svc.submit(c)
+    svc.run_until_idle()
+assert svc.drained, "injected sigterm did not drain the service"
+assert any(a.kind == "stop" for a in loop.actions), \
+    "the drained run journaled no stop to replay"
+sys.exit(svc.exit_code)
+PYEOF
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "control-check: drain leg exited $rc, want 3 (EXIT_DRAINED)"
+    exit 1
+  fi
+
+  # --- recover + reference: a fresh process adopts the journaled
+  # decisions; its FULL control_action sequence (drained prefix +
+  # recovery) must equal an uninterrupted run's, byte for byte.
+  JAX_PLATFORMS=cpu "$PY" - "$OUT/drained" "$OUT/ref" <<'PYEOF'
+import json
+import os
+import sys
+
+import numpy as np
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.control import ControlLoop, EarlyStopPolicy
+from flipcomplexityempirical_tpu.experiments.config import ExperimentConfig
+from flipcomplexityempirical_tpu.service import Journal, SweepService
+from flipcomplexityempirical_tpu.service import journal as jnl
+
+drained, ref_dir = sys.argv[1], sys.argv[2]
+cfgs = [ExperimentConfig(family="frank", alignment=al, base=0.3,
+                         pop_tol=0.1, total_steps=60, n_chains=2,
+                         backend="jax", checkpoint_every=20, seed=seed)
+        for al, seed in ((2, 3), (1, 4))]
+
+
+def policies():
+    return [EarlyStopPolicy(rhat_target=5.0, ess_target=4.0,
+                            patience=1, min_columns=4)]
+
+
+def control_story(outdir):
+    records, truncated = Journal.read(jnl.journal_path_for(outdir))
+    assert not truncated
+    return [(r["action"], r["tag"], r["step"], r["policy"],
+             json.dumps(r["detail"], sort_keys=True))
+            for r in records if r["kind"] == "control_action"]
+
+
+with obs.Recorder(os.path.join(drained, "events.recover.jsonl")) as rec:
+    svc = SweepService.recover(drained, recorder=rec, max_batch_chains=2,
+                               control=ControlLoop(policies=policies()))
+    svc.run_until_idle()
+assert svc.exit_code == 0, [(j.tag, j.status, j.error)
+                            for j in svc.queue.jobs()]
+got = {j.tag: j for j in svc.queue.jobs()}
+
+ref_svc = SweepService(outdir=ref_dir, max_batch_chains=2,
+                       control=ControlLoop(policies=policies()))
+ref_jobs = [ref_svc.submit(c) for c in cfgs]
+ref_svc.run_until_idle()
+assert ref_svc.exit_code == 0
+
+story, ref_story = control_story(drained), control_story(ref_dir)
+assert story == ref_story, (
+    "control_action replay diverged:\n"
+    f"  drained+recovered: {story}\n  reference:         {ref_story}")
+assert [k for (k, *_) in story] == ["stop", "stop"], story
+
+compared = 0
+for c, ref_job in zip(cfgs, ref_jobs):
+    assert got[c.tag].status == "done", (c.tag, got[c.tag].error)
+    a, b = got[c.tag].result, ref_job.result
+    if a is None or b is None:
+        # a job already done BEFORE the drain recovers as a journal
+        # verdict only (results live in artifacts, not the journal)
+        continue
+    compared += 1
+    assert a["early_stopped"] == b["early_stopped"] == 20
+    for k in ("end_signed", "cut_times", "num_flips", "waits_all"):
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+    for k in b["history"]:
+        np.testing.assert_array_equal(
+            np.asarray(a["history"][k]), np.asarray(b["history"][k]),
+            err_msg=f"history[{k}]")
+assert compared >= 1, "no re-run job left artifacts to compare"
+print(f"control-check[replay]: {len(story)} control actions replayed "
+      "bit-identically across the drain "
+      f"({compared} re-run job(s) artifact-compared)")
+PYEOF
+
+  "$PY" tools/obs_report.py "$OUT/drained/events.drain.jsonl" --check
+  "$PY" tools/obs_report.py "$OUT/drained/events.recover.jsonl" --check
+  ;;
+
+*)
+  echo "control-check: unknown leg '$LEG'"
+  exit 1
+  ;;
+esac
+done
+
+echo "control-check: OK"

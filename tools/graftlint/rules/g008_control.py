@@ -1,0 +1,105 @@
+"""G008: purity of the adaptive control plane.
+
+The control/ package is the one place where observation becomes action
+(early stops, segment retunes, ladder reshapes). The whole recovery
+story — SweepService.recover() replaying journaled ``control_action``
+records bit-identically — rests on control decisions being PURE
+functions of the observed history: same snapshots in, same actions out,
+on any host, at any wall-clock time, in any process. Three bug classes
+silently break that contract:
+
+- any ``time.*()`` clock read — a decision influenced by wall clock
+  (or even a monotonic timer) cannot replay; latency enters control
+  ONLY through the quantized ``segment_wall_s`` histogram snapshot the
+  loop hands to policies (ObservedState.p95_bucket).
+- any ``random.*`` / ``np.random.*`` call — there is no legitimate
+  randomness in a control decision; a stochastic policy would emit a
+  different action sequence on recovery than the journal recorded.
+- emission from inside a policy — policies PROPOSE actions and the
+  ControlLoop alone emits/journals them (``_emit``). A policy calling
+  ``.emit(...)`` or ``journal.append(...)`` bypasses the loop's
+  dedup/adopt bookkeeping, so replay double-counts its actions.
+
+Statically, in ``control/`` modules: flag (a) any call whose dotted
+name starts with ``time.`` or ``datetime.``; (b) any call on the
+``random`` module or dotted through ``np.random``/``numpy.random``;
+(c) inside any ClassDef whose name ends with ``Policy``, any
+``.emit(...)`` attribute call or any call whose dotted name ends with
+``journal.append``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted_name
+
+RULE_ID = "G008"
+
+
+def applies(module) -> bool:
+    return "control/" in module.path and not module.is_test
+
+
+def _clock_call(name: str) -> bool:
+    return name.startswith("time.") or name.startswith("datetime.")
+
+
+def _rng_call(name: str) -> bool:
+    return (name.startswith("random.")
+            or name.startswith("np.random.")
+            or name.startswith("numpy.random."))
+
+
+def _check_calls(nodes, module, findings, in_policy: bool):
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        if _clock_call(name):
+            findings.append(module.finding(
+                RULE_ID, node,
+                f"{name}() reads a clock inside control/ — decisions "
+                "must be pure in the observed history so recovery "
+                "replays them bit-identically; latency reaches "
+                "policies only via the quantized p95_bucket snapshot"))
+        elif _rng_call(name):
+            findings.append(module.finding(
+                RULE_ID, node,
+                f"{name}() draws randomness inside control/ — a "
+                "stochastic decision cannot replay; control actions "
+                "must be deterministic in the observed history"))
+        elif in_policy and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "emit":
+                findings.append(module.finding(
+                    RULE_ID, node,
+                    "emit() from inside a Policy class — policies "
+                    "propose ControlActions and the ControlLoop alone "
+                    "emits/journals them (its _emit keeps the "
+                    "dedup/adopt bookkeeping replay depends on)"))
+            elif name.endswith("journal.append"):
+                findings.append(module.finding(
+                    RULE_ID, node,
+                    "journal.append() from inside a Policy class — "
+                    "journaling is the ControlLoop's job; a policy "
+                    "writing records directly double-counts them on "
+                    "replay"))
+
+
+def check(module, config):
+    findings = []
+    tree = module.tree
+    policy_spans = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Policy")):
+            policy_spans.append(node)
+    policy_nodes = set()
+    for cls in policy_spans:
+        for sub in ast.walk(cls):
+            policy_nodes.add(id(sub))
+    in_policy = [n for n in ast.walk(tree) if id(n) in policy_nodes]
+    outside = [n for n in ast.walk(tree) if id(n) not in policy_nodes]
+    _check_calls(in_policy, module, findings, in_policy=True)
+    _check_calls(outside, module, findings, in_policy=False)
+    return findings

@@ -515,6 +515,33 @@ def report_resilience(events, out):
               file=out)
 
 
+def report_control(events, out):
+    """The adaptive-control section: every control_action the sweep's
+    ControlLoop emitted (stop / retune / reshape_ladder / reallocate),
+    in stream order, with the decision detail inline. Rendered only
+    when the stream carries control actions — a fixed-schedule sweep's
+    report stays byte-identical."""
+    actions = [e for e in events if e["event"] == "control_action"]
+    if not actions:
+        return
+    print("\n## Control", file=out)
+    by_kind: dict = {}
+    for e in actions:
+        by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
+    print(", ".join(f"{k}={v}" for k, v in sorted(by_kind.items())),
+          file=out)
+    print("\n| kind | tag | step | policy | detail |", file=out)
+    print("|---|---|---|---|---|", file=out)
+    for e in actions:
+        detail = e.get("detail") or {}
+        shown = ", ".join(
+            f"{k}={detail[k]}" for k in sorted(detail)
+            if not isinstance(detail[k], (list, dict)))
+        print(f"| {e.get('kind', '?')} | {e.get('tag', '?')} "
+              f"| {e.get('step', '?')} | {e.get('policy', '?')} "
+              f"| {shown or '-'} |", file=out)
+
+
 def _namespaced_heartbeat_path(path: str, tag: str) -> str:
     # mirror of experiments.driver.heartbeat_path_for (this tool must
     # stay importable without jax): heartbeat.json + 2B30P10 ->
@@ -523,7 +550,8 @@ def _namespaced_heartbeat_path(path: str, tag: str) -> str:
     return f"{root}.{tag}{ext or '.json'}"
 
 
-def check_heartbeat(path: str, interval_s: float):
+def check_heartbeat(path: str, interval_s: float,
+                    stopped_tags=frozenset()):
     """Stale-heartbeat probe: returns an error string when the heartbeat
     file is missing, unparsable, or its mtime is older than 2x the
     expected refresh interval — unless its payload says the sweep
@@ -535,7 +563,14 @@ def check_heartbeat(path: str, interval_s: float):
     per-job/per-batch files next to it (``heartbeat.<tag>.json`` /
     ``heartbeat.<batch>.json``). For each non-terminal job the probe
     follows the namespaced sibling — preferring the batch file the job
-    is running in — and applies the same staleness rule there."""
+    is running in — and applies the same staleness rule there.
+
+    ``stopped_tags`` names configs the control loop early-stopped
+    (``control_action`` ``kind=stop`` in the event stream): their
+    refresh loops stop at the stop boundary BY DESIGN, exactly like a
+    finished job's, so they are exempt from the staleness rule even if
+    a summary refresh has not yet flipped their status off
+    "running"."""
     import time as _time
 
     try:
@@ -557,6 +592,10 @@ def check_heartbeat(path: str, interval_s: float):
             if str(entry.get("status", "")) != "running":
                 # queued/retrying jobs have no refresh loop of their
                 # own; their liveness is the summary's (checked below)
+                continue
+            if tag in stopped_tags:
+                # early-stopped by the control loop: refreshes ended at
+                # the stop boundary by design (treated like job_done)
                 continue
             running = True
             # the batch file carries the segment-cadence refreshes; the
@@ -657,10 +696,17 @@ def main(argv=None):
     report_health(events, runs, out)
     report_timing(events, runs, out)
     report_resilience(events, out)
+    report_control(events, out)
     report_sweep(events, out)
     hb_error = None
     if args.heartbeat:
-        hb_error = check_heartbeat(args.heartbeat, args.heartbeat_interval)
+        stopped = frozenset(
+            e.get("tag") for e in events
+            if e["event"] == "control_action"
+            and e.get("kind") == "stop" and e.get("tag"))
+        hb_error = check_heartbeat(args.heartbeat,
+                                   args.heartbeat_interval,
+                                   stopped_tags=stopped)
         if hb_error:
             print(f"\n{hb_error}", file=out)
     if args.strict:
