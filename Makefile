@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test obs-check mesh-check chaos-check bitpack-check \
-	service-check preempt-check control-check lint
+	service-check preempt-check control-check workload-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -53,6 +53,14 @@ preempt-check:
 # recovery replays the journaled control_action sequence bit-identically
 control-check:
 	PYTHON=$(PYTHON) JAX_PLATFORMS=cpu tools/control_check.sh
+
+# workload-catalog gate: every catalog entry resolves on its declared
+# dispatch rung with stable distinct fingerprints, the dual-graph
+# fixture and ReCom chain family run end to end through the real CLI
+# with valid event streams, and the bench workload matrix emits
+# [workload=...]-qualified records so families never cross-gate
+workload-check:
+	PYTHON=$(PYTHON) JAX_PLATFORMS=cpu tools/workload_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

@@ -164,6 +164,20 @@ def main():
                     help="--adaptive: split R-hat early-stop target")
     ap.add_argument("--target-ess", type=float, default=64.0,
                     help="--adaptive: total-ESS early-stop target")
+    ap.add_argument("--workload-matrix", action="store_true",
+                    help="benchmark the workload catalog instead of one "
+                         "kernel: each named workload (workloads/"
+                         "catalog.py) runs its tuned shape through the "
+                         "driver — flip and ReCom chain families, "
+                         "dual-graph fixtures, proposal variants — and "
+                         "emits one per-family record qualified by "
+                         "workload name, so bench_compare gates "
+                         "[workload=...] metrics without cross-family "
+                         "interference")
+    ap.add_argument("--workloads", metavar="NAMES", default=None,
+                    help="--workload-matrix: comma-separated workload "
+                         "names to run (default: a tier-1-sized spread "
+                         "across the chain families and variants)")
     ap.add_argument("--ess-host", action="store_true",
                     help="force the host-copy f64 ESS estimator for the "
                          "--ess recorded pass (streams the history to "
@@ -178,7 +192,8 @@ def main():
                            (args.ess, "--ess"),
                            (args.mesh is not None, "--mesh"),
                            (args.body is not None, "--body"),
-                           (args.adaptive, "--adaptive")):
+                           (args.adaptive, "--adaptive"),
+                           (args.workload_matrix, "--workload-matrix")):
             if flag:
                 ap.error(f"{name} is incompatible with --service (the "
                          "service benchmark drives whole sweep jobs, "
@@ -191,13 +206,28 @@ def main():
                            (args.ess, "--ess"),
                            (args.mesh is not None, "--mesh"),
                            (args.body is not None, "--body"),
-                           (args.service, "--service")):
+                           (args.service, "--service"),
+                           (args.workload_matrix, "--workload-matrix")):
             if flag:
                 ap.error(f"{name} is incompatible with --adaptive (the "
                          "adaptive benchmark drives whole sweep jobs "
                          "through the control loop, not one kernel "
                          "path)")
         _adaptive_bench(args)
+        return
+    if args.workload_matrix:
+        for flag, name in ((args.pallas, "--pallas"),
+                           (args.general, "--general"),
+                           (args.ess, "--ess"),
+                           (args.mesh is not None, "--mesh"),
+                           (args.body is not None, "--body"),
+                           (args.service, "--service"),
+                           (args.adaptive, "--adaptive")):
+            if flag:
+                ap.error(f"{name} is incompatible with --workload-matrix "
+                         "(the matrix drives whole catalog workloads "
+                         "through the driver, not one kernel path)")
+        _workload_matrix_bench(args)
         return
     if ((args.steps - 1) % args.chunk or (args.warmup - 1) % args.chunk
             or args.warmup - 1 < args.chunk):
@@ -907,6 +937,80 @@ def _adaptive_bench(args):
     if device.platform == "cpu":
         record["cpu_fallback"] = True
     print(json.dumps(record))
+
+
+def _workload_matrix_bench(args):
+    """--workload-matrix: one throughput record per catalog workload.
+
+    Each workload resolves through the registry's single materialisation
+    path (the driver's own graph/spec builders) and runs its tuned shape
+    twice — an untimed warmup pass that pays every compile, then the
+    timed pass — so the record measures the steady-state segment loop.
+    Records carry the workload name, chain family, variant, resolved
+    dispatch rung, and both fingerprints; bench_compare names the metric
+    ``workload_steps_per_s[workload=...]``, so the flip grid never gates
+    against ReCom or a dual fixture. Stdout stays one JSON line
+    (``{"mode": "workload-matrix", "results": [...]}``); per-run meta
+    goes to stderr."""
+    import time as _time
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    from flipcomplexityempirical_tpu import workloads
+    from flipcomplexityempirical_tpu.experiments import driver as drv
+    from flipcomplexityempirical_tpu.obs import from_spec
+
+    names = (args.workloads.split(",") if args.workloads else
+             ["sec11", "grid-k4", "dual-fixture", "recom-grid",
+              "sec11-nobacktrack", "frank-lazy"])
+    device = jax.devices()[0]
+    results = []
+    with from_spec(args.events) as rec:
+        for name in names:
+            r = workloads.resolve(name)   # graph + plan built untimed
+            cfg = r.config
+
+            def _leg():
+                t0 = _time.perf_counter()
+                drv._run_jax(cfg, r.graph, r.plan, None, recorder=rec)
+                return _time.perf_counter() - t0
+
+            _leg()          # warmup: pays the compile, untimed
+            wall = _leg()
+            work = cfg.total_steps * cfg.n_chains
+            record = {
+                "metric": "workload_steps_per_s",
+                "value": round(work / wall, 2),
+                "unit": "steps/s",
+                "workload": name,
+                "family": cfg.family,
+                "chain": cfg.chain,
+                "variant": cfg.variant,
+                "kernel_path": r.kernel_path,
+                "workload_fingerprint": r.workload.fingerprint(),
+                "config_fingerprint": cfg.fingerprint(),
+                "wall_s": round(wall, 4),
+                "steps": cfg.total_steps,
+                "chains": cfg.n_chains,
+                "device": device.platform,
+            }
+            if device.platform == "cpu":
+                record["cpu_fallback"] = True
+            results.append(record)
+            print(json.dumps({"workload": name, "wall_s": record["wall_s"],
+                              "kernel_path": r.kernel_path}),
+                  file=sys.stderr)
+
+    meta = {
+        "mode": "workload-matrix",
+        "device": str(device),
+        "n_devices": len(jax.devices()),
+        "workloads": names,
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(json.dumps({"mode": "workload-matrix", "results": results}))
 
 
 if __name__ == "__main__":

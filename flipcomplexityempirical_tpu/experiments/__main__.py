@@ -1,8 +1,11 @@
 """CLI: python -m flipcomplexityempirical_tpu.experiments
          --family sec11 --out plots/sec11 [--steps N] [--backend jax]
          [--only 2B30P10 ...]
+     or: ... --workload dual-fixture --out plots/wl
+         (one named catalog scenario; --list-workloads enumerates)
 
-Runs the reference sweep grids with skip-if-done resume, emitting the
+Runs the reference sweep grids — or a single named workload from the
+catalog (workloads/catalog.py) — with skip-if-done resume, emitting the
 13-artifact set per config with reference-compatible filenames.
 
 Sweeps run SUPERVISED by default (resilience.supervisor): each config is
@@ -28,10 +31,25 @@ from .driver import run_sweep
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--family", choices=sorted(SWEEPS), required=True)
-    ap.add_argument("--out", required=True)
-    ap.add_argument("--steps", type=int, default=100_000)
-    ap.add_argument("--chains", type=int, default=8)
+    ap.add_argument("--family", choices=sorted(SWEEPS), default=None,
+                    help="run a full sweep grid (exactly one of --family "
+                         "/ --workload)")
+    ap.add_argument("--workload", metavar="NAME", default=None,
+                    help="run one named workload from the catalog "
+                         "(workloads/catalog.py): a fingerprintable "
+                         "scenario — graph, seed plan, chain family, "
+                         "proposal variant, tuned run shape; --steps/"
+                         "--chains override the tuned shape; "
+                         "--list-workloads enumerates")
+    ap.add_argument("--list-workloads", action="store_true",
+                    help="print the workload catalog and exit")
+    ap.add_argument("--out", required=False, default=None)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="total transitions per config (default 100000, "
+                         "or the workload's tuned value)")
+    ap.add_argument("--chains", type=int, default=None,
+                    help="batched chains per config (default 8, or the "
+                         "workload's tuned value)")
     ap.add_argument("--record-every", type=int, default=1,
                     help="history thinning through the runners (yields "
                          "0, k, 2k, ... recorded; accumulators exact)")
@@ -55,11 +73,14 @@ def main():
                     help="sweep progress heartbeat JSON (atomically "
                          "refreshed around every config); defaults to "
                          "OUT/heartbeat.json")
-    ap.add_argument("--dual-source", choices=["quads", "voronoi"],
+    ap.add_argument("--dual-source",
+                    choices=["quads", "voronoi", "fixture"],
                     default="quads",
-                    help="dual family geometry: jittered-quad lattice or "
-                         "irregular Voronoi cells (realistic topology); "
-                         "ignored by other families")
+                    help="dual family geometry: jittered-quad lattice, "
+                         "irregular Voronoi cells (realistic topology), "
+                         "or the committed precinct-style GeoJSON "
+                         "fixture (workloads/data/); ignored by other "
+                         "families")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (jax.config, which works "
                          "even where JAX_PLATFORMS env is pre-pinned)")
@@ -99,6 +120,18 @@ def main():
     ap.add_argument("--target-ess", type=float, default=200.0,
                     help="--adaptive: total-ESS early-stop target")
     args = ap.parse_args()
+    if args.list_workloads:
+        from .. import workloads
+        for n in workloads.names():
+            w = workloads.get(n)
+            print(f"{n:22s} {w.fingerprint()}  "
+                  f"[{w.chain}/{w.variant}/{w.kernel_path}] "
+                  f"{w.description}")
+        return
+    if (args.family is None) == (args.workload is None):
+        ap.error("exactly one of --family / --workload is required")
+    if args.out is None:
+        ap.error("--out is required")
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -107,14 +140,25 @@ def main():
         jax.config.update("jax_compilation_cache_dir", args.jax_cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    sweep = SWEEPS[args.family]
-    overrides = dict(total_steps=args.steps, n_chains=args.chains,
-                     backend=args.backend, contiguity=args.contiguity,
+    overrides = dict(backend=args.backend, contiguity=args.contiguity,
                      seed=args.seed, record_every=args.record_every,
                      checkpoint_every=args.checkpoint_every)
-    if args.family == "dual":
-        overrides["dual_source"] = args.dual_source
-    configs = list(sweep(**overrides))
+    if args.steps is not None:
+        overrides["total_steps"] = args.steps
+    if args.chains is not None:
+        overrides["n_chains"] = args.chains
+    if args.workload:
+        # a workload is a single named config; explicit CLI flags win
+        # over the catalog's tuned shape, catalog defaults otherwise
+        from .. import workloads
+        configs = [workloads.get(args.workload).to_config(**overrides)]
+    else:
+        sweep = SWEEPS[args.family]
+        overrides.setdefault("total_steps", 100_000)
+        overrides.setdefault("n_chains", 8)
+        if args.family == "dual":
+            overrides["dual_source"] = args.dual_source
+        configs = list(sweep(**overrides))
     if args.only:
         configs = [c for c in configs if c.tag in set(args.only)]
     heartbeat = args.heartbeat or os.path.join(args.out, "heartbeat.json")

@@ -159,7 +159,8 @@ FAMILY_ARTIFACTS = {
     "temper": ["start.png", "edges.png", "end.png", "rungs.png",
                "swapstats.json", "wait.txt"],
     "dual": ["start.png", "edges.png", "end.png", "flip.png",
-             "logflip.png", "compactness.json", "wait.txt"],
+             "logflip.png", "compactness.json", "partisan.json",
+             "wait.txt"],
 }
 
 

@@ -56,6 +56,11 @@ class ExperimentConfig:
     dual_source: str = "quads"  # dual: 'quads' (jittered lattice) |
                                 # 'voronoi' (irregular-degree cells)
     record_every: int = 1     # history thinning through the runners
+    chain: str = "flip"       # 'flip' (single-node flip walk) | 'recom'
+                              # (spanning-tree ReCom, sampling/recom.py)
+    variant: str = "none"     # proposal variant: 'none' | 'nobacktrack'
+                              # (arxiv 1204.4140) | 'lazy' (lazy-uniform
+                              # reweighting riding the geometric waits)
 
     @property
     def tag(self) -> str:
@@ -64,19 +69,27 @@ class ExperimentConfig:
         if self.family in ("sec11", "frank"):
             # reference families keep the reference's exact filename tag
             # (grid_chain_sec11.py:323)
-            return core
+            t = core
         # widened families prefix the family (artifact filenames and
         # checkpoint keys must not collide when sweeps share an output
         # or checkpoint directory) and their sweep-varying parameters
-        if self.family == "dual" and self.dual_source != "quads":
-            return (f"{self.family}-{self.dual_source[:3].upper()}-"
-                    f"K{self.n_districts}-{core}")
-        if self.family in ("kpair", "dual"):
-            return f"{self.family}-K{self.n_districts}-{core}"
-        if self.family == "temper":
-            return (f"{self.family}-{core}"
-                    f"R{len(self.betas)}S{self.swap_every}")
-        return f"{self.family}-{core}"
+        elif self.family == "dual" and self.dual_source != "quads":
+            t = (f"{self.family}-{self.dual_source[:3].upper()}-"
+                 f"K{self.n_districts}-{core}")
+        elif self.family in ("kpair", "dual"):
+            t = f"{self.family}-K{self.n_districts}-{core}"
+        elif self.family == "temper":
+            t = (f"{self.family}-{core}"
+                 f"R{len(self.betas)}S{self.swap_every}")
+        else:
+            t = f"{self.family}-{core}"
+        # non-default chain/variant wrap the tag so artifacts and
+        # checkpoint keys never collide with the flip walk's
+        if self.chain != "flip":
+            t = f"{self.chain}-{t}"
+        if self.variant != "none":
+            t = f"{t}-{self.variant[:4].upper()}"
+        return t
 
     def fingerprint(self) -> str:
         """Content hash over the KERNEL-RELEVANT statics: two configs
@@ -111,6 +124,12 @@ class ExperimentConfig:
         }
         if self.family == "dual":
             payload["seed"] = self.seed
+        # appended conditionally so every pre-existing config keeps its
+        # exact fingerprint (journal/cache compatibility)
+        if self.chain != "flip":
+            payload["chain"] = self.chain
+        if self.variant != "none":
+            payload["variant"] = self.variant
         blob = json.dumps(payload, sort_keys=True,
                           separators=(",", ":"))
         return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]

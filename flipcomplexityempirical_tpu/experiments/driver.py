@@ -16,6 +16,7 @@ everything).
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import json
 import os
@@ -45,11 +46,12 @@ def _check_drain(tag: str) -> None:
 from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
                       square_grid, triangular_lattice, hex_lattice,
                       stripes_plan, from_geojson, synthetic_precincts,
-                      voronoi_precincts, seed_votes, PARITY_LABELS)
+                      voronoi_precincts, seed_votes, validate_votes,
+                      PARITY_LABELS)
 from ..stats import partisan, polsby_popper
 from ..kernel import board as kboard
 from ..kernel.step import Spec, finalize_host
-from ..sampling import (init_batch, run_chains, init_board,
+from ..sampling import (init_batch, run_chains, run_recom, init_board,
                         init_tempered, run_tempered, per_rung_history)
 from .artifacts import (artifact_kinds, render_all, render_generic,
                         render_rungs, render_start)
@@ -83,6 +85,14 @@ def build_graph_and_plan(cfg: ExperimentConfig):
         elif cfg.dual_source == "quads":
             fc = synthetic_precincts(cfg.dual_nx, cfg.dual_ny,
                                      seed=cfg.seed)
+        elif cfg.dual_source == "fixture":
+            # the committed precinct-style fixture (workloads/data/):
+            # a deterministic GeoJSON FeatureCollection ingested through
+            # the SAME from_geojson path real shapefiles take
+            # (graphs/shapefile.py being the on-disk loader), so fixture
+            # runs exercise the production ingestion code end to end
+            from ..workloads.data import load_fixture
+            fc = load_fixture()
         else:
             raise ValueError(f"dual_source {cfg.dual_source!r}")
         g, geo = from_geojson(fc, pop_property="POP")
@@ -97,26 +107,43 @@ def spec_for(cfg: ExperimentConfig) -> Spec:
     reference's full metric set (wall-interface slopes need wall ids, so
     record_interface only exists there); kpair/dual route the k-district
     pair walk (slow_reversible_propose, grid_chain_sec11.py:117-130);
-    dual scores boundary LENGTH (weighted_cut) for compactness."""
+    dual scores boundary LENGTH (weighted_cut) for compactness.
+    ``cfg.variant`` maps onto the Spec's proposal-variant flags last, so
+    a variant config differs from its base by exactly that flag."""
     common = dict(contiguity=cfg.contiguity, invalid="repropose",
                   parity_metrics=True, geom_waits=True,
                   propose_parallel=cfg.propose_parallel)
     fam = cfg.family
     if fam in ("sec11", "frank"):
-        return Spec(n_districts=2, proposal="bi", accept=cfg.accept,
+        spec = Spec(n_districts=2, proposal="bi", accept=cfg.accept,
                     record_interface=True, **common)
-    if fam in ("temper", "tri", "hex"):
-        return Spec(n_districts=2, proposal="bi", accept=cfg.accept,
+    elif fam in ("temper", "tri", "hex"):
+        spec = Spec(n_districts=2, proposal="bi", accept=cfg.accept,
                     record_interface=False, **common)
-    if fam == "kpair":
-        return Spec(n_districts=cfg.n_districts, proposal="pair",
+    elif fam == "kpair":
+        spec = Spec(n_districts=cfg.n_districts, proposal="pair",
                     accept="cut", record_interface=False, **common)
-    if fam == "dual":
-        return Spec(n_districts=cfg.n_districts,
+    elif fam == "dual":
+        spec = Spec(n_districts=cfg.n_districts,
                     proposal="pair" if cfg.n_districts > 2 else "bi",
                     accept="cut", weighted_cut=True,
                     record_interface=False, **common)
-    raise ValueError(f"family {fam!r}")
+    else:
+        raise ValueError(f"family {fam!r}")
+    if cfg.variant == "none":
+        return spec
+    if cfg.variant == "nobacktrack":
+        if spec.proposal != "bi":
+            raise ValueError(
+                f"variant 'nobacktrack' needs the 2-district 'bi' walk; "
+                f"family {fam!r} with k={cfg.n_districts} runs "
+                f"{spec.proposal!r}")
+        return dataclasses.replace(spec, nobacktrack=True)
+    if cfg.variant == "lazy":
+        # lazy-uniform reweighting rides the geometric waiting-time
+        # machinery — every family spec above has geom_waits on
+        return dataclasses.replace(spec, lazy_uniform=True)
+    raise ValueError(f"variant {cfg.variant!r}")
 
 
 def _labels_for(cfg: ExperimentConfig) -> np.ndarray:
@@ -178,7 +205,7 @@ def run_config(cfg: ExperimentConfig, outdir: str,
         data = _run_jax(cfg, g, plan, checkpoint_dir, recorder=recorder,
                         control=control)
     data["seconds"] = time.monotonic() - t0
-    if cfg.n_districts == 2:
+    if cfg.n_districts == 2 or cfg.family == "dual":
         with obs.span(rec, "partisan", tag=cfg.tag):
             data["partisan"] = _partisan_summary(cfg, g, data)
 
@@ -225,6 +252,12 @@ def run_config(cfg: ExperimentConfig, outdir: str,
                     node_exterior_perim=geo.exterior_perim
                 ).mean(axis=1).tolist(),
             }, f, indent=1)
+        # partisan scores are a dual-family artifact (school-boundary
+        # style analyses on real dual graphs, arxiv 2206.03703): the
+        # summary computed above lands on disk next to compactness
+        with open(j("partisan.json"), "w") as f:
+            json.dump({k: (v.tolist() if hasattr(v, "tolist") else v)
+                       for k, v in data["partisan"].items()}, f, indent=1)
     return data
 
 
@@ -268,7 +301,8 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
 
     rec = obs.resolve_recorder(recorder)
     spec = spec_for(cfg)
-    use_board = kboard.supports(g, spec) and not _force_general
+    use_board = (kboard.supports(g, spec) and not _force_general
+                 and cfg.chain == "flip")
     if use_board:
         handle, states, params = init_board(
             g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
@@ -326,6 +360,19 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
                 return _run_jax(cfg, g, plan, checkpoint_dir,
                                 _stop_after_segments, recorder=recorder,
                                 _force_general=True, control=control)
+        elif cfg.chain == "recom":
+            # second chain family: same segment/checkpoint/drain/control
+            # machinery, recom_move as the transition. epsilon reuses the
+            # config's population tolerance; the target is the ideal
+            # per-district population (the reference's pop_target,
+            # grid_chain_sec11.py:330-335).
+            res = run_recom(handle, spec, params, states,
+                            n_steps=n, record_initial=(done == 0),
+                            record_every=cfg.record_every,
+                            epsilon=cfg.pop_tol,
+                            pop_target=float(np.asarray(g.pop).sum())
+                            / cfg.n_districts,
+                            recorder=recorder)
         else:
             res = run_chains(handle, spec, params, states,
                              n_steps=n, record_initial=(done == 0),
@@ -640,13 +687,16 @@ def _partisan_summary(cfg: ExperimentConfig, g, data) -> dict:
     """Election scores over the run's final plans, from the reference's
     Bernoulli(1/2) pink/purple vote attributes (grid_chain_sec11.py:
     223-228; Election wiring of line 307). Batched: every chain's final
-    plan is scored in one pass; the reference's single chain is row 0."""
-    votes = seed_votes(g, cfg.seed)
+    plan is scored in one pass; the reference's single chain is row 0.
+    Works for any k (dual-graph workloads score k=4/8 plans); votes are
+    alignment-validated against the graph before tallying."""
+    votes = validate_votes(g, seed_votes(g, cfg.seed))
     if data.get("assignments") is not None:     # jax backend: (C, N) batch
         assign = np.asarray(data["assignments"])
     else:                                       # python backend: final plan
         assign = (np.asarray(data["end_signed"]) < 0).astype(np.int64)[None]
-    tallies = partisan.district_vote_tallies(assign, votes, k=2)
+    tallies = partisan.district_vote_tallies(assign, votes,
+                                             k=cfg.n_districts)
     return {
         "mean_median": partisan.mean_median(tallies),
         "efficiency_gap": partisan.efficiency_gap(tallies),
@@ -842,7 +892,11 @@ def _ckpt_identity(cfg: ExperimentConfig) -> str:
             f"{'' if cfg.dual_source == 'quads' else '@' + cfg.dual_source}|"
             f"re={cfg.record_every}|"
             f"betas={tuple(map(float, cfg.betas))!r}|"
-            f"se={cfg.swap_every}")
+            f"se={cfg.swap_every}"
+            # conditional suffixes: checkpoints written before
+            # chain/variant existed stay valid for default configs
+            + ("" if cfg.chain == "flip" else f"|chain={cfg.chain}")
+            + ("" if cfg.variant == "none" else f"|var={cfg.variant}"))
 
 
 def _sha256_file(path: str) -> str:

@@ -8,7 +8,7 @@ from .dualgraph import (
     GeoAttributes, from_geojson, from_shapefile, synthetic_precincts,
     voronoi_precincts,
 )
-from .votes import seed_votes, PARTIES
+from .votes import seed_votes, validate_votes, VoteAlignmentError, PARTIES
 
 __all__ = [
     "LatticeGraph", "DeviceGraph", "build_lattice", "from_networkx",
@@ -18,5 +18,5 @@ __all__ = [
     "GeoAttributes", "from_geojson", "from_shapefile",
     "synthetic_precincts", "voronoi_precincts",
     "read_shapefile", "write_shapefile",
-    "seed_votes", "PARTIES",
+    "seed_votes", "validate_votes", "VoteAlignmentError", "PARTIES",
 ]

@@ -268,6 +268,11 @@ def supports(graph: LatticeGraph, spec: Spec) -> bool:
         and spec.anneal in ("none", "linear")
         and not spec.frame_interface
         and not spec.weighted_cut
+        # proposal variants: the stencil bodies draw from the packed
+        # boundary planes and record no importance weights — both
+        # variants run the general kernel
+        and not spec.nobacktrack
+        and not spec.lazy_uniform
         and (not spec.record_assignment_bits
              or st.n_real * max(
                  1, (spec.n_districts - 1).bit_length()) <= 32)

@@ -77,6 +77,15 @@ class Spec:
                                           # bits/node (graphs with
                                           # N*bits <= 32; exact-
                                           # distribution tests)
+    nobacktrack: bool = False     # exclude the last-flipped node from the
+                                  # 'bi' boundary draw (the non-backtracking
+                                  # proposal of arxiv 1204.4140) unless it
+                                  # is the sole boundary node; general
+                                  # kernel only (board.supports gates it)
+    lazy_uniform: bool = False    # emit a per-yield importance weight
+                                  # 1 + cur_wait (the lazy chain's holding
+                                  # time, riding the geometric waiting-time
+                                  # machinery) under history key 'weight'
 
 
 @struct.dataclass
@@ -183,13 +192,23 @@ def _select_nth_true(mask, m):
     return jnp.argmax(c > m).astype(jnp.int32)
 
 
-def _sample_bi(key, state: ChainState):
+def _sample_bi(key, state: ChainState, nobacktrack: bool = False):
     """Uniform over boundary nodes, flip to the other district
     (grid_chain_sec11.py:132-145). One uniform + prefix-sum selection —
     NOT a per-node Gumbel/uniform draw, which would cost N PRNG evaluations
-    per proposal (the dominant kernel cost at N=4096)."""
+    per proposal (the dominant kernel cost at N=4096).
+
+    ``nobacktrack`` removes the last-flipped node from the draw (the
+    non-backtracking proposal of arxiv 1204.4140) unless it is the SOLE
+    boundary node — the walk must always have a move."""
     b_mask = state.cut_deg > 0
     bc = state.b_count
+    if nobacktrack:
+        f = state.cur_flip_node
+        fi = jnp.maximum(f, 0)
+        excl = (f >= 0) & b_mask[fi] & (bc > 1)
+        b_mask = b_mask & ~((jnp.arange(b_mask.shape[0]) == fi) & excl)
+        bc = bc - excl.astype(bc.dtype)
     u = jax.random.uniform(key)
     m = jnp.minimum((u * bc.astype(jnp.float32)).astype(jnp.int32),
                     jnp.maximum(bc - 1, 0))
@@ -284,8 +303,13 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
         if spec.proposal == "bi":
             if k != 2:
                 raise ValueError("proposal 'bi' requires n_districts == 2")
-            v, d_to, ok = _sample_bi(key, state)
+            v, d_to, ok = _sample_bi(key, state,
+                                     nobacktrack=spec.nobacktrack)
         elif spec.proposal == "pair":
+            if spec.nobacktrack:
+                raise ValueError("nobacktrack requires proposal 'bi' "
+                                 "(the pair walk has no single excluded "
+                                 "reverse move)")
             v, d_to, ok = _sample_pair(key, dg, state, k)
         else:
             raise ValueError(f"proposal {spec.proposal!r}")
@@ -486,6 +510,11 @@ def record(dg: DeviceGraph, spec: Spec, params: StepParams,
         "wait": state.cur_wait,
         "accepts": state.accept_count,
     }
+    if spec.lazy_uniform:
+        # lazy-uniform reweighting: this yield stands for 1 + wait
+        # consecutive visits of the lazy chain, so downstream estimators
+        # weight it by the holding time
+        out["weight"] = 1.0 + state.cur_wait
 
     cut_times = state.cut_times + state.cut.astype(jnp.int32)
     waits_sum = state.waits_sum + state.cur_wait

@@ -1,12 +1,13 @@
 from .runner import RunResult, run_chains, init_batch, pop_bounds
 from .board_runner import run_board, init_board
 from .pallas_runner import run_board_pallas
-from .recom import recom_move
+from .recom import recom_move, run_recom
 from .tempered import (TemperResult, init_tempered, run_tempered,
                        per_rung_history)
 from .tempering import make_ladder_params, swap_within_batch
 
 __all__ = ["RunResult", "run_chains", "init_batch", "pop_bounds",
            "run_board", "init_board", "run_board_pallas", "recom_move",
+           "run_recom",
            "TemperResult", "init_tempered", "run_tempered",
            "per_rung_history", "make_ladder_params", "swap_within_batch"]

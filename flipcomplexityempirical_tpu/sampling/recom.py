@@ -29,13 +29,20 @@ compares the batched and host-oracle chains' stationary statistics.
 
 from __future__ import annotations
 
+import functools
+import time
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from .. import obs
 from ..graphs.lattice import DeviceGraph
 from ..kernel import step as kstep
-from ..kernel.step import Spec
+from ..kernel.step import Spec, StepParams
 from ..state.chain_state import ChainState, derive
+from .runner import (RunResult, _record_initial, assemble_history,
+                     maybe_host, pick_chunk, snap_chunk_to, thin_outs)
 
 
 def _ceil_log2(n: int) -> int:
@@ -280,6 +287,19 @@ def recom_move(dg: DeviceGraph, spec: Spec, state: ChainState,
     else:
         cur_wait = state.cur_wait
     cur_flip_node = jnp.where(found, jnp.int32(-1), state.cur_flip_node)
+    extra = {}
+    if state.reject_count is not None:
+        # recom reject taxonomy, preserving the tested invariant
+        # reject_count.sum() + accept_count == tries_sum: slot 0
+        # (nonboundary) — no cut edge to merge across; slot 1 (pop) —
+        # trees drawn but no population-balanced cut edge survived the
+        # retries. Slots 2/3 (disconnect/metropolis) cannot occur: the
+        # tree split is connected by construction and recom has no
+        # Metropolis coin. Exactly one slot fires per unfound move.
+        zero = jnp.int32(0)
+        extra["reject_count"] = state.reject_count + jnp.stack(
+            [(~any_cut).astype(jnp.int32),
+             (any_cut & ~found_tree).astype(jnp.int32), zero, zero])
     return state.replace(
         key=key, assignment=a_new, cut=cut.astype(state.cut.dtype),
         cut_deg=cut_deg.astype(state.cut_deg.dtype), dist_pop=dist_pop,
@@ -287,4 +307,219 @@ def recom_move(dg: DeviceGraph, spec: Spec, state: ChainState,
         cur_wait=cur_wait, cur_flip_node=cur_flip_node,
         part_sum=part_sum, last_flipped=last_flipped, num_flips=num_flips,
         move_clock=state.move_clock + found.astype(jnp.int32),
-        accept_count=state.accept_count + found.astype(jnp.int32))
+        accept_count=state.accept_count + found.astype(jnp.int32),
+        tries_sum=state.tries_sum + 1,
+        exhausted_count=state.exhausted_count
+        + (~found).astype(jnp.int32),
+        **extra)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "spec", "chunk", "collect", "epsilon", "pop_target", "tree_retries"))
+def _run_recom_chunk(dg: DeviceGraph, spec: Spec, params: StepParams,
+                     states: ChainState, chunk: int, collect: bool = True,
+                     epsilon: float = 0.05, pop_target=None,
+                     tree_retries: int = 4):
+    paxes = StepParams.vmap_axes()
+
+    def body(states, _):
+        states = jax.vmap(
+            lambda p, s: recom_move(dg, spec, s, epsilon=epsilon,
+                                    pop_target=pop_target,
+                                    label_values=p.label_values,
+                                    tree_retries=tree_retries),
+            in_axes=(paxes, 0))(params, states)
+        states, out = jax.vmap(
+            lambda p, s: kstep.record(dg, spec, p, s),
+            in_axes=(paxes, 0))(params, states)
+        return states, out if collect else {}
+
+    states, outs = jax.lax.scan(body, states, None, length=chunk)
+    return states, outs
+
+
+def run_recom(dg: DeviceGraph, spec: Spec, params: StepParams,
+              states: ChainState, n_steps: int,
+              epsilon: float = 0.05, pop_target=None,
+              tree_retries: int = 4,
+              record_history: bool = True,
+              chunk=None,
+              record_initial: bool = True,
+              record_every: int = 1,
+              history_device: bool = False,
+              recorder=None) -> RunResult:
+    """The ReCom chain family's chunked runner: ``run_chains`` semantics
+    (yield counting, checkpoint-segment continuation via
+    ``record_initial=False``, thinning, waits drained per chunk) with
+    ``recom_move`` as the transition. Obs events mirror the general
+    runner's contract — one ``run_start``/``run_end``, a ``chunk`` event
+    per executed chunk with the reject-reason breakdown — but tagged
+    ``runner='recom'`` / ``kernel_path='recom'``: recom is a second
+    CHAIN FAMILY, not a dispatch-ladder rung, so its records and bench
+    metrics must never cross-gate against flip-walk paths.
+
+    ``epsilon``/``pop_target``/``tree_retries`` are recom_move's knobs,
+    static per compile (part of the jit cache key). The reject-counter
+    enable/restore follows the runner's trailing-Optional contract:
+    attaching a recorder turns ``states.reject_count`` on for the run
+    and hands back the caller's treedef unchanged."""
+    rec = obs.resolve_recorder(recorder)
+    n_chains = states.assignment.shape[0]
+    had_rej = states.reject_count is not None
+    if rec and not had_rej:
+        states = states.replace(
+            reject_count=jnp.zeros((n_chains, 4), jnp.int32))
+    if record_every < 1:
+        raise ValueError(f"record_every must be >= 1, got {record_every}")
+    if chunk is None:
+        # recom moves are O(N log N) tree passes, ~100x a flip step:
+        # smaller default chunk keeps per-chunk wall time comparable
+        chunk = pick_chunk(n_steps + (0 if record_initial else 1), 256)
+    if record_every > 1:
+        chunk = snap_chunk_to(chunk, record_every)
+    if pop_target is not None:
+        pop_target = float(pop_target)
+
+    def step_chunk(states, this):
+        return _run_recom_chunk(dg, spec, params, states, this,
+                                collect=record_history, epsilon=epsilon,
+                                pop_target=pop_target,
+                                tree_retries=tree_retries)
+
+    if rec:
+        rec.emit("run_start", runner="recom", path="recom",
+                 chains=n_chains,
+                 n_steps=n_steps, chunk=chunk,
+                 record_history=record_history, record_every=record_every,
+                 record_initial=record_initial,
+                 history_device=history_device)
+        watch = obs.JitWatch(_run_recom_chunk, "recom._run_recom_chunk")
+        t_run0 = time.perf_counter()
+        last_acc = int(np.asarray(states.accept_count, np.int64).sum())
+        acc_start, hbm_bytes, transfer_total = last_acc, 0, 0
+        last_tries = int(np.asarray(states.tries_sum, np.int64).sum())
+        last_rej = (np.asarray(states.reject_count, np.int64).sum(axis=0)
+                    if states.reject_count is not None else None)
+        mon = obs.ChainMonitor(rec, total=n_steps, path="recom",
+                               runner="recom")
+        met = obs.MetricsRegistry()
+        run_span = obs.span(rec, "run:recom", annotate=True,
+                            kernel_path="recom", chains=n_chains,
+                            n_steps=n_steps).begin()
+
+    if record_initial:
+        states, out0 = _record_initial(dg, spec, params, states)
+        if record_history:
+            out0 = maybe_host(out0, history_device)
+            hist_parts = {k: [v[:, None]] for k, v in out0.items()}
+            if rec:
+                nb = obs.dict_nbytes(out0)
+                if history_device:
+                    hbm_bytes += nb
+                else:
+                    transfer_total += nb
+                    rec.emit("transfer", what="initial_record", bytes=nb)
+        else:
+            hist_parts = None
+        done = 1
+    else:
+        hist_parts = {} if record_history else None
+        done = 0
+    done0 = done
+    waits_total = np.asarray(states.waits_sum, np.float64).copy()
+    states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
+
+    t_prev = time.perf_counter() if rec else None
+    while done < n_steps:
+        this = min(chunk, n_steps - done)
+        if rec:
+            csp = obs.span(rec, "chunk", annotate=True,
+                           kernel_path="recom", steps=this,
+                           done=done).begin()
+        states, outs = step_chunk(states, this)
+        if rec:
+            watch.poll(rec, chunk=this,
+                       cost=lambda: obs.aot_cost(
+                           _run_recom_chunk, dg, spec, params, states,
+                           this, collect=record_history, epsilon=epsilon,
+                           pop_target=pop_target,
+                           tree_retries=tree_retries))
+        transfer_bytes = 0
+        host_outs = None
+        if record_history:
+            outs = maybe_host(thin_outs(outs, record_every), history_device)
+            if not history_device:
+                host_outs = outs
+            if rec:
+                nb = obs.dict_nbytes(outs)
+                if history_device:
+                    hbm_bytes += nb
+                else:
+                    transfer_bytes = nb
+                    transfer_total += nb
+            for k, v in outs.items():
+                hist_parts.setdefault(k, []).append(v.T)
+        waits_total += np.asarray(states.waits_sum, np.float64)
+        states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
+        done += this
+        if rec:
+            acc = int(np.asarray(states.accept_count, np.int64).sum())
+            now = time.perf_counter()
+            wall = now - t_prev
+            t_prev = now
+            reject = None
+            if last_rej is not None:
+                rej = np.asarray(states.reject_count, np.int64).sum(axis=0)
+                tries = int(np.asarray(states.tries_sum, np.int64).sum())
+                d = rej - last_rej
+                reject = {"nonboundary": int(d[0]), "pop": int(d[1]),
+                          "disconnect": int(d[2]), "metropolis": int(d[3]),
+                          "accepted": acc - last_acc,
+                          "proposals": tries - last_tries}
+                last_rej, last_tries = rej, tries
+            accept_rate = (acc - last_acc) / (n_chains * this)
+            flips_per_s = n_chains * this / max(wall, 1e-12)
+            rec.emit("chunk", runner="recom", path="recom",
+                     steps=this,
+                     chains=n_chains, flips=n_chains * this,
+                     wall_s=wall,
+                     flips_per_s=flips_per_s,
+                     accept_rate=accept_rate,
+                     transfer_bytes=transfer_bytes,
+                     hbm_history_bytes=hbm_bytes,
+                     done=done, total=n_steps, reject=reject)
+            last_acc = acc
+            mon.observe_chunk(outs=host_outs, wall_s=wall,
+                              flips_per_s=flips_per_s,
+                              accept_rate=accept_rate, reject=reject,
+                              done=done)
+            csp.end(wall_s=wall, reject=reject)
+            met.observe("chunk_wall_s", wall)
+            met.observe("flips_per_s", flips_per_s)
+            met.inc("chunks")
+            met.inc("flips", n_chains * this)
+            met.inc("transfer_bytes", transfer_bytes)
+            met.set("done", done)
+            met.notify(rec)
+
+    history = assemble_history(hist_parts, record_history, history_device)
+    if rec:
+        wall = time.perf_counter() - t_run0
+        flips = n_chains * (n_steps - done0)
+        met.set("hbm_history_bytes", hbm_bytes)
+        snap = met.snapshot()
+        rec.emit("metrics_snapshot", counters=snap["counters"],
+                 gauges=snap["gauges"], histograms=snap["histograms"],
+                 runner="recom", path="recom")
+        rec.emit("run_end", runner="recom", path="recom",
+                 n_yields=n_steps,
+                 chains=n_chains, flips=flips, wall_s=wall,
+                 flips_per_s=flips / max(wall, 1e-12),
+                 accept_rate=(last_acc - acc_start) / max(flips, 1),
+                 transfer_bytes=transfer_total,
+                 hbm_history_bytes=hbm_bytes, metrics=snap)
+        run_span.end(flips=flips, wall_s=wall)
+    if rec and not had_rej:
+        states = states.replace(reject_count=None)
+    return RunResult(state=states, history=history,
+                     waits_total=waits_total, n_yields=n_steps)

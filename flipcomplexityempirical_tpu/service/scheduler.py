@@ -283,10 +283,15 @@ class SweepService:
 
     def _solo_only(self, job: q.Job) -> bool:
         """Jobs the coalescer must not touch: isolation retries, the
-        temper family (run-global ladder swap state), and anything with
-        an existing checkpoint (resume points differ, coalescing
-        assumes a common step 0)."""
+        temper family (run-global ladder swap state), non-flip chain
+        families (the coalesced executor drives run_chains directly —
+        recom jobs run solo through the driver, which routes them to
+        run_recom; their fingerprints differ from any flip config so
+        they could never share a batch anyway), and anything with an
+        existing checkpoint (resume points differ, coalescing assumes
+        a common step 0)."""
         return (job.solo or job.config.family == "temper"
+                or job.config.chain != "flip"
                 or self._has_checkpoint(job.config))
 
     def _form_groups(self, jobs: list) -> list:

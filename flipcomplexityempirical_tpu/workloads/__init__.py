@@ -1,0 +1,6 @@
+from .registry import (
+    ResolvedWorkload, WorkloadSpec, get, names, register, resolve, specs,
+)
+
+__all__ = ["WorkloadSpec", "ResolvedWorkload", "register", "get",
+           "names", "specs", "resolve"]

@@ -1,0 +1,121 @@
+"""The named workload catalog.
+
+Every entry is a complete, tier-1-runnable scenario: the surgical
+reference grids (sec11, Frankengraph), k∈{2,4,8}-district seeded
+partitions on synthetic lattices, the committed precinct-style
+dual-graph fixture (workloads/data/, ingested through the production
+``from_geojson`` path), the ReCom chain family on both, and the
+proposal variants (non-backtracking flip per arxiv 1204.4140,
+lazy-uniform reweighting riding the geometric waiting-time machinery).
+
+Run shapes are tuned small enough for a CPU smoke run inside the tier-1
+budget; the CLI's ``--steps``/``--chains`` and bench's flags override
+them without re-registering. ``kernel_path`` values are the DECLARED
+dispatch expectations — tests/test_workloads.py asserts they match what
+``lower.dispatch.kernel_path_for`` actually resolves, so a workload
+silently falling off its fast path fails the suite.
+"""
+
+from __future__ import annotations
+
+from ..experiments.config import MU
+from .registry import WorkloadSpec, register
+
+_W = register
+
+
+# --- surgical reference grids -------------------------------------------
+_W(WorkloadSpec(
+    name="sec11",
+    family="sec11",
+    description="40x40 surgical sec11 grid, reference B263P10 cell, "
+                "bit-packed lowered stencil body",
+    overrides=(("alignment", 2), ("base", MU), ("pop_tol", 0.1),
+               ("total_steps", 5000), ("n_chains", 8)),
+    kernel_path="lowered_bits",
+))
+_W(WorkloadSpec(
+    name="frank",
+    family="frank",
+    description="Frankengraph B333P10 cell (slow-mixing bimodal regime)",
+    overrides=(("alignment", 2), ("base", 1 / .3), ("pop_tol", 0.1),
+               ("total_steps", 5000), ("n_chains", 8)),
+    kernel_path="lowered_bits",
+))
+
+# --- k-district seeded partitions on synthetic lattices -----------------
+for _k in (2, 4, 8):
+    _W(WorkloadSpec(
+        name=f"grid-k{_k}",
+        family="kpair",
+        description=f"k={_k} pair walk on a 32x32 rook grid (width a "
+                    f"multiple of 32, so the packed bit body applies), "
+                    f"stripes seed plan",
+        overrides=(("alignment", 0), ("base", 0.8), ("pop_tol", 0.5),
+                   ("n_districts", _k), ("grid", 32),
+                   ("total_steps", 4000), ("n_chains", 8)),
+        kernel_path="bitboard",
+    ))
+
+# --- precinct-style dual-graph fixture (real ingestion path) ------------
+for _k in (2, 4, 8):
+    _W(WorkloadSpec(
+        name="dual-fixture" if _k == 2 else f"dual-fixture-k{_k}",
+        family="dual",
+        description=f"k={_k} on the committed 80-precinct GeoJSON "
+                    f"fixture via from_geojson (weighted-cut walk, "
+                    f"compactness + partisan artifacts)",
+        overrides=(("alignment", 0), ("base", MU), ("pop_tol", 0.25),
+                   ("n_districts", _k), ("dual_source", "fixture"),
+                   ("total_steps", 1500), ("n_chains", 4)),
+        kernel_path="general",
+        stats=("compactness", "partisan"),
+    ))
+
+# --- ReCom chain family (sampling/recom.py) -----------------------------
+_W(WorkloadSpec(
+    name="recom-grid",
+    family="kpair",
+    description="spanning-tree ReCom, k=4 on an 8x8 grid — the second "
+                "chain family; ~100x flip per-step cost, so few steps",
+    overrides=(("alignment", 0), ("base", 1.0), ("pop_tol", 0.25),
+               ("n_districts", 4), ("grid", 8),
+               ("total_steps", 40), ("n_chains", 4)),
+    chain="recom",
+    kernel_path="recom",
+))
+_W(WorkloadSpec(
+    name="recom-dual",
+    family="dual",
+    description="ReCom k=4 on the committed precinct fixture",
+    overrides=(("alignment", 0), ("base", 1.0), ("pop_tol", 0.4),
+               ("n_districts", 4), ("dual_source", "fixture"),
+               ("total_steps", 30), ("n_chains", 2)),
+    chain="recom",
+    kernel_path="recom",
+    stats=("compactness", "partisan"),
+))
+
+# --- proposal variants --------------------------------------------------
+_W(WorkloadSpec(
+    name="sec11-nobacktrack",
+    family="sec11",
+    description="non-backtracking flip proposal (arxiv 1204.4140) on "
+                "the sec11 grid — excludes the last-flipped node from "
+                "the boundary draw; runs the general kernel",
+    overrides=(("alignment", 2), ("base", MU), ("pop_tol", 0.1),
+               ("total_steps", 3000), ("n_chains", 8)),
+    variant="nobacktrack",
+    kernel_path="general",
+))
+_W(WorkloadSpec(
+    name="frank-lazy",
+    family="frank",
+    description="lazy-uniform reweighting on the Frankengraph — "
+                "per-sample weight 1 + geometric wait, riding the "
+                "existing waiting-time machinery",
+    overrides=(("alignment", 2), ("base", 1 / .3), ("pop_tol", 0.1),
+               ("total_steps", 3000), ("n_chains", 8)),
+    variant="lazy",
+    kernel_path="general",
+))

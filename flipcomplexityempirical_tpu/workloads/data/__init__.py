@@ -1,0 +1,28 @@
+"""Committed workload fixtures.
+
+``precincts_10x8.geojson`` is a deterministic precinct-style
+FeatureCollection (generated once by ``graphs.dualgraph
+.synthetic_precincts(10, 8, seed=20260806)`` and committed) so
+dual-graph workloads exercise the REAL ingestion path —
+``from_geojson`` polygon->rook-adjacency extraction, the same code
+``graphs/shapefile.py``-loaded shapefiles take — without a network
+fetch or an optional GIS dependency. 80 jittered quads, POP/NAME
+properties, ~heterogeneous populations in [80, 120].
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_FIXTURE = "precincts_10x8.geojson"
+
+
+def fixture_path() -> str:
+    return os.path.join(os.path.dirname(__file__), _FIXTURE)
+
+
+def load_fixture() -> dict:
+    """The parsed FeatureCollection, ready for ``from_geojson``."""
+    with open(fixture_path()) as f:
+        return json.load(f)

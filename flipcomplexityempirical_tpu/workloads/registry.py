@@ -1,0 +1,153 @@
+"""Declarative workload registry: named, fingerprintable scenarios.
+
+A *workload* is a runnable scenario with a stable name — a graph family,
+a seed plan, a chain family (flip or ReCom), proposal-variant flags, and
+the tuned run shape that makes it complete inside the tier-1 budget.
+The registry turns "which experiment is this?" from a bag of CLI flags
+into one token that every layer can key on: the CLI (`--workload NAME`),
+the bench matrix (`--workload-matrix`), the service (jobs built from a
+workload coalesce/journal under the underlying config fingerprint), and
+bench_compare (`[workload=…]`-qualified metrics, so families never
+cross-gate).
+
+``WorkloadSpec`` is declarative — a frozen record of config overrides —
+and ``resolve`` is the single materialisation path: it builds the
+``ExperimentConfig``, runs the SAME ``build_graph_and_plan``/``spec_for``
+the driver runs, and reports the dispatch-ladder rung
+(``lower.dispatch.kernel_path_for``) the runners will actually select.
+The ``kernel_path`` field on the spec is the *declared expectation*;
+tests assert declared == resolved so a dispatch regression (a workload
+silently falling off its fast path) fails loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Dict, Iterable, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """One catalog entry. ``overrides`` is a sorted tuple of
+    (field, value) pairs applied to ``ExperimentConfig`` — a tuple, not
+    a dict, so the spec is hashable and its fingerprint is canonical."""
+    name: str
+    family: str               # ExperimentConfig.family
+    description: str
+    overrides: Tuple[Tuple[str, Any], ...] = ()
+    chain: str = "flip"       # 'flip' | 'recom' (second chain family)
+    variant: str = "none"     # 'none' | 'nobacktrack' | 'lazy'
+    kernel_path: str = "general"  # expected dispatch rung ('recom' for
+                                  # the ReCom chain family)
+    stats: Tuple[str, ...] = ()   # artifact stat bundles the driver
+                                  # attaches ('compactness', 'partisan')
+
+    def to_config(self, **extra):
+        """Materialise the ExperimentConfig. ``extra`` wins over the
+        spec's overrides (CLI --steps/--chains tweak a workload without
+        re-registering it) but never over family/chain/variant — those
+        ARE the workload's identity."""
+        from ..experiments.config import ExperimentConfig
+        kw = dict(self.overrides)
+        kw.update(extra)
+        return ExperimentConfig(family=self.family, chain=self.chain,
+                                variant=self.variant, **kw)
+
+    def fingerprint(self) -> str:
+        """Content hash of the full declaration (sorted canonical JSON).
+        Distinct from ``ExperimentConfig.fingerprint()`` — that one keys
+        kernel coalescing; this one names the catalog entry's contents,
+        so a tuned override change moves the workload fingerprint even
+        when the compiled kernel is unchanged."""
+        payload = {
+            "name": self.name,
+            "family": self.family,
+            "overrides": sorted([k, _jsonable(v)]
+                                for k, v in self.overrides),
+            "chain": self.chain,
+            "variant": self.variant,
+            "kernel_path": self.kernel_path,
+            "stats": sorted(self.stats),
+        }
+        blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def _jsonable(v):
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+@dataclasses.dataclass
+class ResolvedWorkload:
+    """What a name buys you: the graph, the seed plan, the kernel Spec,
+    and the dispatch rung the runners will take — everything the driver,
+    bench, and service need to run the scenario."""
+    workload: WorkloadSpec
+    config: Any               # ExperimentConfig
+    graph: Any                # LatticeGraph
+    plan: Any                 # (n_nodes,) seed assignment
+    geo: Any                  # GeoAttributes or None (dual graphs only)
+    spec: Any                 # kernel Spec
+    kernel_path: str          # resolved rung (may differ from declared
+                              # on dispatch regressions — tests compare)
+
+
+_REGISTRY: Dict[str, WorkloadSpec] = {}
+_CATALOG_LOADED = False
+
+
+def _ensure_catalog() -> None:
+    """Lazy-import the catalog so `import workloads` stays cheap and the
+    registry module has no import cycle with catalog.py."""
+    global _CATALOG_LOADED
+    if not _CATALOG_LOADED:
+        _CATALOG_LOADED = True
+        from . import catalog  # noqa: F401  (registers on import)
+
+
+def register(spec: WorkloadSpec, *, replace: bool = False) -> WorkloadSpec:
+    if not replace and spec.name in _REGISTRY:
+        raise ValueError(f"workload {spec.name!r} already registered")
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get(name: str) -> WorkloadSpec:
+    _ensure_catalog()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown workload {name!r}; known: {', '.join(names())}"
+        ) from None
+
+
+def names() -> Tuple[str, ...]:
+    _ensure_catalog()
+    return tuple(sorted(_REGISTRY))
+
+
+def specs() -> Iterable[WorkloadSpec]:
+    _ensure_catalog()
+    return [_REGISTRY[n] for n in sorted(_REGISTRY)]
+
+
+def resolve(name: str, **extra) -> ResolvedWorkload:
+    """Name -> (config, graph, plan, geo, spec, kernel_path), through the
+    driver's own builders so there is exactly one materialisation path."""
+    wl = get(name) if isinstance(name, str) else name
+    cfg = wl.to_config(**extra)
+    from ..experiments.driver import build_graph_and_plan, spec_for
+    g, plan, geo = build_graph_and_plan(cfg)
+    spec = spec_for(cfg)
+    if cfg.chain == "recom":
+        path = "recom"            # ReCom is a chain family, not a rung
+    else:
+        from ..lower.dispatch import kernel_path_for
+        path = kernel_path_for(g, spec)
+    return ResolvedWorkload(workload=wl, config=cfg, graph=g, plan=plan,
+                            geo=geo, spec=spec, kernel_path=path)

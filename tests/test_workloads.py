@@ -1,0 +1,328 @@
+"""Workload catalog tests: registry round-trip + fingerprint stability,
+kernel-path expectations against the live dispatch ladder, the committed
+dual-graph fixture end-to-end through run_config (partisan artifacts
+included), the ReCom chunked runner's obs contract and reject taxonomy,
+proposal-variant Spec mapping, and (slow tier) k=4 flip stationarity
+against the exact uniform target."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import obs, workloads
+from flipcomplexityempirical_tpu.experiments import driver as drv
+from flipcomplexityempirical_tpu.workloads.data import load_fixture
+
+
+# ---------------------------------------------------------------------------
+# registry round-trip + fingerprints
+# ---------------------------------------------------------------------------
+
+def test_catalog_roundtrip():
+    names = workloads.names()
+    assert len(names) >= 10
+    for must in ("sec11", "frank", "grid-k2", "grid-k4", "grid-k8",
+                 "dual-fixture", "recom-grid", "sec11-nobacktrack",
+                 "frank-lazy"):
+        assert must in names
+    for n in names:
+        w = workloads.get(n)
+        assert w.name == n
+        cfg = w.to_config()
+        assert cfg.family == w.family
+        assert cfg.chain == w.chain
+        assert cfg.variant == w.variant
+        # CLI-style extras win over the tuned shape but never identity
+        cfg2 = w.to_config(total_steps=7, n_chains=2)
+        assert (cfg2.total_steps, cfg2.n_chains) == (7, 2)
+        assert cfg2.family == w.family
+
+
+def test_workload_fingerprints_stable_and_distinct():
+    fps = {n: workloads.get(n).fingerprint() for n in workloads.names()}
+    # stable across calls
+    for n, fp in fps.items():
+        assert workloads.get(n).fingerprint() == fp
+        assert len(fp) == 16
+    # distinct across entries
+    assert len(set(fps.values())) == len(fps)
+
+
+def test_config_fingerprint_untouched_by_default_chain():
+    """Pre-existing configs must keep their exact fingerprints (journal
+    and compile-cache compatibility): the chain/variant payload keys
+    only appear when non-default."""
+    from flipcomplexityempirical_tpu.experiments.config import \
+        ExperimentConfig
+    base = dict(family="kpair", alignment=0, base=0.8, pop_tol=0.5)
+    a = ExperimentConfig(**base)
+    b = ExperimentConfig(**base, chain="flip", variant="none")
+    assert a.fingerprint() == b.fingerprint()
+    assert ExperimentConfig(**base, chain="recom").fingerprint() \
+        != a.fingerprint()
+    assert ExperimentConfig(**base, variant="lazy").fingerprint() \
+        != a.fingerprint()
+    # tags segregate artifacts/checkpoints per chain family and variant
+    assert ExperimentConfig(**base, chain="recom").tag.startswith("recom-")
+    assert ExperimentConfig(**base, variant="lazy").tag.endswith("-LAZY")
+
+
+def test_resolve_matches_declared_kernel_paths():
+    """Every catalog entry materialises through the driver's own
+    builders, and the dispatch ladder resolves the rung the entry
+    declares — a workload silently falling off its fast path fails."""
+    for n in workloads.names():
+        r = workloads.resolve(n)
+        assert r.kernel_path == r.workload.kernel_path, \
+            f"{n}: declared {r.workload.kernel_path}, got {r.kernel_path}"
+        assert r.plan.shape == (r.graph.n_nodes,)
+        k = r.config.n_districts
+        assert set(np.unique(np.asarray(r.plan))) == set(range(k))
+
+
+# ---------------------------------------------------------------------------
+# dual-graph fixture: ingestion + end-to-end sweep
+# ---------------------------------------------------------------------------
+
+def test_fixture_loads_through_real_ingestion():
+    fc = load_fixture()
+    assert fc["type"] == "FeatureCollection"
+    assert len(fc["features"]) == 80
+    g, geo = fce.graphs.from_geojson(fc, pop_property="POP")
+    assert g.n_nodes == 80
+    assert np.asarray(g.pop).shape == (80,)
+    assert (np.asarray(g.pop) > 0).all()
+    assert geo.area.shape == (80,)
+    # deterministic: same committed bytes -> same graph every session
+    g2, _ = fce.graphs.from_geojson(load_fixture(), pop_property="POP")
+    np.testing.assert_array_equal(g.edges, g2.edges)
+
+
+def test_dual_fixture_workload_end_to_end(tmp_path):
+    """--workload dual-fixture equivalent: run_config on the committed
+    fixture emits the full dual manifest, partisan.json included."""
+    cfg = workloads.get("dual-fixture").to_config(total_steps=120,
+                                                  n_chains=2)
+    drv.run_config(cfg, str(tmp_path))
+    from flipcomplexityempirical_tpu.experiments.artifacts import \
+        artifact_kinds
+    for kind in artifact_kinds("dual"):
+        assert os.path.exists(str(tmp_path / (cfg.tag + kind))), kind
+    with open(str(tmp_path / (cfg.tag + "partisan.json"))) as f:
+        partisan = json.load(f)
+    assert set(partisan) == {"mean_median", "efficiency_gap",
+                             "seats_pink"}
+    assert len(partisan["efficiency_gap"]) == cfg.n_chains
+
+
+def test_validate_votes_rejects_misalignment():
+    from flipcomplexityempirical_tpu.graphs import (VoteAlignmentError,
+                                                    validate_votes)
+    g, _ = fce.graphs.from_geojson(load_fixture(), pop_property="POP")
+    votes = fce.graphs.seed_votes(g, 0)
+    out = validate_votes(g, votes)
+    assert out.shape == (g.n_nodes, 2)
+    with pytest.raises(VoteAlignmentError):
+        validate_votes(g, votes[:-1])
+    with pytest.raises(VoteAlignmentError):
+        validate_votes(g, votes[:, :1])
+    bad = np.array(votes, dtype=float)
+    bad[0, 0] = np.nan
+    with pytest.raises(VoteAlignmentError):
+        validate_votes(g, bad)
+
+
+# ---------------------------------------------------------------------------
+# ReCom as a served chain family
+# ---------------------------------------------------------------------------
+
+def test_run_recom_events_and_reject_taxonomy(tmp_path):
+    """The chunked ReCom runner mirrors run_chains' obs contract — every
+    event tagged runner/path 'recom' — and its reject taxonomy accounts
+    for every proposal: reject.sum() + accepted == proposals."""
+    g = fce.graphs.square_grid(6, 6)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(n_districts=2, proposal="bi")
+    dg, states, params = fce.init_batch(g, plan, n_chains=2, seed=5,
+                                        spec=spec, base=1.0, pop_tol=0.5)
+    path = str(tmp_path / "recom_events.jsonl")
+    with obs.Recorder(path=path) as rec:
+        res = fce.sampling.run_recom(dg, spec, params, states,
+                                     n_steps=12, epsilon=0.4,
+                                     recorder=rec)
+    events = [json.loads(l) for l in open(path)]
+    kinds = [e["event"] for e in events]
+    assert "run_start" in kinds and "run_end" in kinds
+    chunks = [e for e in events if e["event"] == "chunk"]
+    assert chunks
+    for e in events:
+        if "runner" in e:
+            assert e["runner"] == "recom"
+        if "kernel_path" in e:
+            assert e["kernel_path"] == "recom"
+    total = {"accepted": 0, "proposals": 0, "rej": 0}
+    for c in chunks:
+        rej = c["reject"]
+        assert (rej["nonboundary"] + rej["pop"] + rej["disconnect"]
+                + rej["metropolis"] + rej["accepted"]
+                == rej["proposals"])
+        total["accepted"] += rej["accepted"]
+        total["proposals"] += rej["proposals"]
+    # chains x (steps - 1): the first yield records the initial state
+    assert total["proposals"] == 2 * (12 - 1)
+    # final states stay valid partitions
+    a = np.asarray(res.state.assignment)
+    for c in range(a.shape[0]):
+        assert set(np.unique(a[c])) == {0, 1}
+
+
+def test_recom_workload_routes_through_driver(tmp_path):
+    """cfg.chain='recom' takes the driver's recom segment branch and
+    lands the standard kpair artifact manifest under the recom- tag."""
+    cfg = workloads.get("recom-grid").to_config(total_steps=10,
+                                                n_chains=2)
+    assert cfg.tag.startswith("recom-")
+    drv.run_config(cfg, str(tmp_path))
+    from flipcomplexityempirical_tpu.experiments.artifacts import \
+        artifact_kinds
+    for kind in artifact_kinds("kpair"):
+        assert os.path.exists(str(tmp_path / (cfg.tag + kind))), kind
+
+
+# ---------------------------------------------------------------------------
+# proposal variants
+# ---------------------------------------------------------------------------
+
+def test_variant_spec_mapping():
+    import dataclasses
+    from flipcomplexityempirical_tpu.experiments.config import \
+        ExperimentConfig
+    base = dict(family="sec11", alignment=2, base=1.0, pop_tol=0.1)
+    s0 = drv.spec_for(ExperimentConfig(**base))
+    assert not s0.nobacktrack and not s0.lazy_uniform
+    s1 = drv.spec_for(ExperimentConfig(**base, variant="nobacktrack"))
+    # a variant config differs from its base by exactly that flag
+    assert s1 == dataclasses.replace(s0, nobacktrack=True)
+    s2 = drv.spec_for(ExperimentConfig(**base, variant="lazy"))
+    assert s2 == dataclasses.replace(s0, lazy_uniform=True)
+    # nobacktrack is a bi-walk variant: the pair walk has no single
+    # last-flipped node to exclude
+    with pytest.raises(ValueError):
+        drv.spec_for(ExperimentConfig(family="kpair", alignment=0,
+                                      base=1.0, pop_tol=0.5,
+                                      n_districts=4,
+                                      variant="nobacktrack"))
+    # variants fall off the board fast path (kernel/board.py supports)
+    g = fce.graphs.grid_sec11()
+    from flipcomplexityempirical_tpu.kernel import board as kboard
+    assert not kboard.supports(g, s1)
+    assert not kboard.supports(g, s2)
+
+
+def test_nobacktrack_never_reflips_after_accept():
+    """Non-backtracking flip (arxiv 1204.4140): the last-accepted node
+    is excluded from the proposal draw, so two consecutive accepted
+    moves never touch the same node. Verified by decoding the flip
+    sequence from the packed per-step assignments."""
+    g = fce.graphs.square_grid(4, 6)       # 24 nodes: abits fits uint32
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(n_districts=2, proposal="bi", nobacktrack=True,
+                    record_assignment_bits=True, geom_waits=False,
+                    parity_metrics=False)
+    dg, states, params = fce.init_batch(g, plan, n_chains=4, seed=9,
+                                        spec=spec, base=1.0, pop_tol=0.5)
+    res = fce.run_chains(dg, spec, params, states, n_steps=400)
+    ab = np.asarray(res.history["abits"])         # (C, T) uint32
+    for c in range(ab.shape[0]):
+        d = ab[c, 1:] ^ ab[c, :-1]
+        flips = d[d != 0]
+        # single-node moves only...
+        assert (np.bitwise_and(flips, flips - 1) == 0).all()
+        nodes = np.array([int(x).bit_length() - 1 for x in flips])
+        assert nodes.size > 10
+        # ...and never the same node twice in a row
+        assert (nodes[1:] != nodes[:-1]).all()
+
+
+def test_lazy_uniform_weights_ride_waits():
+    """Lazy-uniform reweighting: recorded per-sample weight is
+    1 + the geometric wait the sample would repeat for."""
+    g = fce.graphs.square_grid(6, 6)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(n_districts=2, proposal="bi", lazy_uniform=True)
+    dg, states, params = fce.init_batch(g, plan, n_chains=2, seed=11,
+                                        spec=spec, base=2.0, pop_tol=0.5)
+    res = fce.run_chains(dg, spec, params, states, n_steps=200)
+    w = np.asarray(res.history["weight"])
+    waits = np.asarray(res.history["wait"])
+    assert (w >= 1.0).all()
+    np.testing.assert_allclose(w, 1.0 + waits)
+
+
+# ---------------------------------------------------------------------------
+# k=4 stationarity (slow tier): corrected/selfloop chain at base=1 is
+# reversible w.r.t. the UNIFORM distribution on valid states
+# ---------------------------------------------------------------------------
+
+def _enumerate_k4(g, lo, hi):
+    """All 4-labelings of the 2x4 grid with every district nonempty,
+    connected, and sized in [lo, hi]; encoded 2 bits/node to match
+    record_assignment_bits' k=4 packing."""
+    import networkx as nx
+    n = g.n_nodes
+    gx = nx.Graph(list(map(tuple, g.edges)))
+    states = []
+    for m in range(4 ** n):
+        digs, t = [], m
+        for _ in range(n):
+            digs.append(t % 4)
+            t //= 4
+        sizes = np.bincount(digs, minlength=4)
+        if not ((sizes >= lo) & (sizes <= hi)).all():
+            continue
+        ok = True
+        for d in range(4):
+            members = [v for v in range(n) if digs[v] == d]
+            if not nx.is_connected(gx.subgraph(members)):
+                ok = False
+                break
+        if ok:
+            states.append(sum(d << (2 * v) for v, d in enumerate(digs)))
+    return states
+
+
+@pytest.mark.slow
+def test_flip_k4_stationarity_chi2():
+    """k=4 pair walk, accept='corrected' + invalid='selfloop' at base=1:
+    the chain is reversible w.r.t. the uniform distribution on the valid
+    states, so thinned occupancy counts face a chi-squared bar (generous
+    threshold: samples are thinned but still weakly correlated)."""
+    g = fce.graphs.square_grid(2, 4)
+    lo, hi = 1, 3                      # ideal 2, pop_tol 0.5 -> [1, 3]
+    states = _enumerate_k4(g, lo, hi)
+    assert len(states) > 20
+    index = {m: i for i, m in enumerate(states)}
+
+    spec = fce.Spec(n_districts=4, proposal="pair", accept="corrected",
+                    invalid="selfloop", contiguity="patch",
+                    record_assignment_bits=True, geom_waits=False,
+                    parity_metrics=False)
+    plan = fce.graphs.stripes_plan(g, 4, axis=1)
+    # thin=30 decorrelates enough for the chi-squared approximation;
+    # 64 x 900 samples put ~31 expected counts in each of the 1848 cells
+    chains, steps, burn, thin = 64, 30000, 3000, 30
+    dg, st, params = fce.init_batch(g, plan, n_chains=chains, seed=17,
+                                    spec=spec, base=1.0, pop_tol=0.5)
+    res = fce.run_chains(dg, spec, params, st, n_steps=steps)
+    abits = np.asarray(res.history["abits"])[:, burn::thin].ravel()
+    # KeyError here = the chain visited a state outside the valid set
+    idx = np.array([index[int(m)] for m in abits])
+    counts = np.bincount(idx, minlength=len(states)).astype(float)
+    expected = counts.sum() / len(states)
+    stat = float(((counts - expected) ** 2 / expected).sum())
+    df = len(states) - 1
+    assert stat < df + 6.0 * np.sqrt(2.0 * df), \
+        f"chi2 {stat:.1f} vs df {df} (|S|={len(states)})"

@@ -75,6 +75,12 @@ def extract_metrics(doc, out: dict | None = None) -> dict:
                 # coalescing shapes — qualify so they never gate
                 # against each other
                 name += f"[tenants={doc['tenants']}]"
+            elif "workload" in doc:
+                # workload-matrix records (bench --workload-matrix):
+                # every catalog workload is its own family (flip vs
+                # ReCom, grid vs dual fixture, proposal variants) —
+                # qualify per workload so families never cross-gate
+                name += f"[workload={doc['workload']}]"
             out[name] = float(doc["value"])
             if isinstance(doc.get("flips_per_s_per_chip"), (int, float)):
                 # multi-chip headline: the per-chip figure is the one

@@ -41,6 +41,12 @@ run_bench ess_thin 900 --ess --record-every 10
 #    this prices both the compile amortization AND the device's real
 #    batch-occupancy headroom (CPU simulation can only show the former)
 run_bench service 900 --service --graph frank --steps 2001
+# 9. Workload-catalog matrix (round 13): one per-family record per named
+#    workload — flip grids, the dual-graph fixture, ReCom, variants —
+#    gated per [workload=...] by bench_compare so families never
+#    cross-gate; on-chip this prices the recom scan and the general-path
+#    variants against their CPU records
+run_bench workloads 1200 --workload-matrix
 touch bench_runs/CAPTURED_${TS}
 commit_retry bench_runs/CAPTURED_${TS}
 echo "capture set complete: ${TS}"

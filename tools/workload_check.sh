@@ -1,0 +1,121 @@
+#!/usr/bin/env bash
+# Workload-catalog CI gate (`make workload-check`, ISSUE 14): every
+# served family must stay NAMED, RESOLVABLE, and GATEABLE.
+#
+# - lint:    graftlint over workloads/ + sampling/ (the registry and the
+#            ReCom chunked runner ride the same purity gates as the
+#            rest of the package).
+# - resolve: every catalog entry materialises through the driver's own
+#            builders, its declared dispatch rung matches what
+#            lower.dispatch actually resolves, and the two fingerprint
+#            layers (workload declaration, kernel-coalescing config)
+#            are stable and distinct across entries.
+# - smoke:   the two acceptance workloads run end to end on CPU via the
+#            real CLI — the committed dual-graph fixture (partisan
+#            artifacts attached) and the ReCom chain family — with
+#            schema-valid event streams.
+# - bench:   bench.py --workload-matrix emits per-family records that
+#            bench_compare qualifies per [workload=...], so a flip-grid
+#            regression never gates against ReCom or a dual fixture.
+#
+#   tools/workload_check.sh                  # all legs
+#   WORKLOAD_LEGS="lint resolve" tools/workload_check.sh
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+TD="$(mktemp -d)"
+trap 'rm -rf "$TD"' EXIT
+
+# one persistent XLA cache across the legs' processes
+export JAX_COMPILATION_CACHE_DIR="$TD/jax-cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+
+LEGS="${WORKLOAD_LEGS:-lint resolve smoke bench}"
+
+for LEG in $LEGS; do
+case "$LEG" in
+
+lint)
+  "$PY" -m tools.graftlint flipcomplexityempirical_tpu/workloads \
+      flipcomplexityempirical_tpu/sampling
+  echo "workload-check[lint]: workloads/ + sampling/ are graftlint-clean"
+  ;;
+
+resolve)
+  JAX_PLATFORMS=cpu "$PY" - <<'PYEOF'
+from flipcomplexityempirical_tpu import workloads
+
+fps, cfps = {}, {}
+for n in workloads.names():
+    r = workloads.resolve(n)
+    w = r.workload
+    assert r.kernel_path == w.kernel_path, (
+        f"{n}: declared kernel_path {w.kernel_path!r} but dispatch "
+        f"resolves {r.kernel_path!r}")
+    assert r.plan.shape == (r.graph.n_nodes,), n
+    assert w.fingerprint() == w.fingerprint(), n
+    fps[n] = w.fingerprint()
+    cfps[n] = r.config.fingerprint()
+assert len(set(fps.values())) == len(fps), "workload fingerprint clash"
+print(f"workload-check[resolve]: {len(fps)} entries resolve on their "
+      "declared dispatch rungs, fingerprints distinct")
+PYEOF
+  ;;
+
+smoke)
+  JAX_PLATFORMS=cpu "$PY" -m flipcomplexityempirical_tpu.experiments \
+      --workload dual-fixture --out "$TD/wl-dual" \
+      --steps "${WORKLOAD_STEPS:-200}" --chains 2 \
+      --events "$TD/events.dual.jsonl" --no-supervise
+  test -s "$TD"/wl-dual/*partisan.json \
+      || { echo "workload-check: dual fixture run left no partisan.json"; \
+           exit 1; }
+  JAX_PLATFORMS=cpu "$PY" -m flipcomplexityempirical_tpu.experiments \
+      --workload recom-grid --out "$TD/wl-recom" \
+      --steps 20 --chains 2 \
+      --events "$TD/events.recom.jsonl" --no-supervise
+  "$PY" tools/obs_report.py "$TD/events.dual.jsonl" --check
+  "$PY" tools/obs_report.py "$TD/events.recom.jsonl" --check
+  grep -q '"kernel_path": *"recom"' "$TD/events.recom.jsonl" \
+      || { echo "workload-check: recom events not tagged kernel_path=recom"; \
+           exit 1; }
+  echo "workload-check[smoke]: dual-fixture + recom-grid ran end to end"
+  ;;
+
+bench)
+  JAX_PLATFORMS=cpu "$PY" bench.py --workload-matrix --cpu \
+      --workloads "${WORKLOAD_MATRIX:-grid-k4,recom-grid,dual-fixture}" \
+      > "$TD/matrix.json" 2> "$TD/matrix.meta"
+  "$PY" - "$TD/matrix.json" <<'PYEOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+assert doc["mode"] == "workload-matrix", doc
+recs = doc["results"]
+assert recs, "empty workload matrix"
+for r in recs:
+    assert r["metric"] == "workload_steps_per_s", r
+    assert r["value"] > 0, r
+    assert "workload" in r and "kernel_path" in r, r
+names = [r["workload"] for r in recs]
+print(f"workload-check[bench]: {len(recs)} per-family records "
+      f"({', '.join(names)})")
+PYEOF
+  # self-compare: each record must extract under its own
+  # [workload=...]-qualified name — families never cross-gate
+  "$PY" tools/bench_compare.py "$TD/matrix.json" "$TD/matrix.json" \
+      | grep -q 'workload_steps_per_s\[workload=recom-grid\]' \
+      || { echo "workload-check: bench_compare did not qualify per workload"; \
+           exit 1; }
+  ;;
+
+*)
+  echo "workload-check: unknown leg '$LEG'"
+  exit 1
+  ;;
+esac
+done
+
+echo "workload-check: OK"
