@@ -4,7 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test obs-check mesh-check chaos-check bitpack-check \
-	service-check preempt-check control-check workload-check lint
+	service-check preempt-check control-check workload-check \
+	dense-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -61,6 +62,13 @@ control-check:
 # [workload=...]-qualified records so families never cross-gate
 workload-check:
 	PYTHON=$(PYTHON) JAX_PLATFORMS=cpu tools/workload_check.sh
+
+# general-dense gate (ISSUE 15): graftlint, chi2 exactness of the
+# rejection-free general_dense body vs the enumerated stationary law,
+# the >=2x CPU hex microbench over the legacy general kernel, and the
+# general_dense -> general compile-fault degradation fall-through
+dense-check:
+	PYTHON=$(PYTHON) tools/dense_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

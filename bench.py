@@ -44,7 +44,7 @@ import numpy as np
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--grid", type=int, default=64)
-    ap.add_argument("--graph", choices=["square", "sec11", "frank"],
+    ap.add_argument("--graph", choices=["square", "sec11", "frank", "hex"],
                     default="square",
                     help="workload graph: 'square' is the headline "
                          "--grid x --grid rook grid; 'sec11' / 'frank' "
@@ -52,7 +52,11 @@ def main():
                          "Frankengraph, which the lowering pass "
                          "(flipcomplexityempirical_tpu/lower) compiles "
                          "onto the board path's lowered stencil body "
-                         "(k=2 bi walk only)")
+                         "(k=2 bi walk only); 'hex' is a --grid x --grid "
+                         "hexagonal lattice — off the board path, so it "
+                         "races the rejection-free general_dense kernel "
+                         "against the legacy general kernel and reports "
+                         "the faster (ISSUE 15)")
     ap.add_argument("--chains", type=int, default=None,
                     help="chain count; explicit values always win. "
                          "Default resolves to 8192 on the chip for the "
@@ -337,7 +341,7 @@ def main():
     else:
         rec = obs.from_spec(args.events)
 
-    if args.graph != "square" and args.k != 2:
+    if args.graph in ("sec11", "frank") and args.k != 2:
         print("bench: --graph sec11/frank runs the reference 2-district "
               "bi walk; drop --k", file=sys.stderr)
         sys.exit(2)
@@ -347,6 +351,9 @@ def main():
     elif args.graph == "frank":
         g = fce.graphs.frankengraph()
         plan = fce.graphs.frank_plan(g, alignment=0)
+    elif args.graph == "hex":
+        g = fce.graphs.hex_lattice(args.grid, args.grid)
+        plan = fce.graphs.stripes_plan(g, args.k)
     else:
         g = fce.graphs.square_grid(args.grid, args.grid)
         plan = fce.graphs.stripes_plan(g, args.k)
@@ -442,9 +449,19 @@ def main():
                     record_every=args.record_every if record else 1,
                     history_device=device_hist, recorder=rec)
     else:
+        from flipcomplexityempirical_tpu.kernel import dense as kdense
         dg, states, params = fce.init_batch(
             g, plan, n_chains=args.chains, seed=0, spec=spec,
             base=args.base, pop_tol=args.pop_tol)
+
+        if args.general:
+            variants = ["general"]
+        elif kdense.supported(g, spec):
+            # the rejection-free dense body and the legacy re-propose loop
+            # serve the same distribution (not bit-identically — see
+            # tests/test_dense.py's exact-enumeration gate); time BOTH and
+            # report the faster, mirroring the board path's body race
+            variants = ["general_dense", "general"]
 
         def run(states, n_steps, variant=None, record=False,
                 device_hist=False):
@@ -452,7 +469,8 @@ def main():
                 dg, spec, params, states, n_steps=n_steps,
                 record_history=record, chunk=args.chunk,
                 record_every=args.record_every if record else 1,
-                history_device=device_hist, recorder=rec)
+                history_device=device_hist, recorder=rec,
+                kernel_path=variant)
 
     # compile + mix in (reach steady-state boundary sizes); same chunk as
     # the timed run so the timed region reuses the compiled kernel
@@ -495,12 +513,17 @@ def main():
     fps = flips / dt
     s = res.host_state()
     # the body that actually produced the winning time: 'lowered_bits' |
-    # 'lowered' | 'bitboard' | 'board' | 'pallas' | 'general' —
-    # scoreboards key on this, so a graph silently falling off the fast
-    # path is visible
-    kernel_path = ("pallas" if use_board and args.pallas
-                   else kboard.body_for(bg, spec, best) if use_board
-                   else "general")
+    # 'lowered' | 'bitboard' | 'board' | 'pallas' | 'general_dense' |
+    # 'general' — scoreboards key on this, so a graph silently falling off
+    # the fast path is visible
+    if use_board:
+        kernel_path = ("pallas" if args.pallas
+                       else kboard.body_for(bg, spec, best))
+    elif best is not None:
+        kernel_path = best  # winner of the general_dense vs general race
+    else:
+        from flipcomplexityempirical_tpu.lower import dispatch as _dispatch
+        kernel_path = _dispatch.kernel_path_for(g, spec)
     meta = {
         "device": ("cpu-fallback" if cpu_fallback else str(jax.devices()[0])),
         "path": ("pallas" if use_board and args.pallas

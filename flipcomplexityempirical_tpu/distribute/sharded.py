@@ -132,8 +132,9 @@ class _ShardedStep:
     leading chains axis, so the spec tree is uniformly P(chains).
 
     ``kernel_path`` is the body the local advance dispatches to
-    ('lowered' | 'bitboard' | 'board' | 'general'), tagged per shard on
-    events by ``run_sharded``. ``_cache_size`` sums the underlying jit
+    ('lowered_bits' | 'lowered' | 'bitboard' | 'board' |
+    'general_dense' | 'general'), tagged per shard on events by
+    ``run_sharded``. ``_cache_size`` sums the underlying jit
     caches so ``obs.JitWatch`` sees compile events across treedef
     specializations too.
     """
@@ -150,14 +151,21 @@ class _ShardedStep:
         # hook -> (body, path) so run_sharded can drop to the int8 body
         # of the same family on a kernel error (BoardState is shared:
         # the bit-pack happens inside run_board_chunk, so the carried
-        # states need no rewrite)
+        # states need no rewrite); general_dense gets the same hook down
+        # to the legacy general body (ChainState is shared — the dense
+        # rung's conn_bits plane is stripped by the prepare hook swap)
         self.fallback = None
+        # optional per-call state adapter (states -> states), applied
+        # before the treedef lookup: the general_dense step uses it to
+        # attach/strip the packed conn plane so callers keep handing in
+        # plain init_batch states
+        self.prepare = None
 
     def degrade(self):
         """Swap in the fallback body and clear the built cache so the
         next call recompiles on the safer path."""
-        body, path = self.fallback()
-        self._body, self.kernel_path = body, path
+        body, path, prepare = self.fallback()
+        self._body, self.kernel_path, self.prepare = body, path, prepare
         self._built.clear()
         self.fallback = None
 
@@ -170,6 +178,8 @@ class _ShardedStep:
             out_specs=(pspec, state_spec, P())))
 
     def __call__(self, key, params, states):
+        if self.prepare is not None:
+            states = self.prepare(states)
         treedef = jax.tree.structure(states)
         fn = self._built.get(treedef)
         if fn is None:
@@ -194,47 +204,81 @@ def _mesh_size(mesh) -> int:
 
 
 def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
-                    exchange: bool = True) -> _ShardedStep:
-    """Build a jitted sharded train step on the GENERAL (gather) kernel:
+                    exchange: bool = True,
+                    dense: bool | None = None) -> _ShardedStep:
+    """Build a jitted sharded train step on the general-family kernels:
     (key, params, states) -> (params, states, info).
 
     ``key`` is a replicated PRNG key for the swap rounds (chain-local
     randomness lives inside ChainState.key). Swap decisions are computed
     identically on both partners from the shared key.
-    """
+
+    ``dense`` picks the body exactly like the runner's ``kernel_path``:
+    None (default) auto-selects the rejection-free ``general_dense``
+    kernel when ``kernel.dense.supported`` holds, True demands it
+    (build-time error otherwise), False forces the legacy gather kernel.
+    The dense step's ``prepare`` hook attaches the packed conn plane to
+    incoming plain states (sharding follows the chains axis), and its
+    ``fallback`` drops to the legacy body with a conn-stripping prepare
+    — ``run_sharded``'s same-key replay then works unchanged because
+    both bodies advance a ChainState."""
     _check_exchange(exchange, spec)
     n_dev = _mesh_size(mesh)
     paxes = StepParams.vmap_axes()
+    from ..kernel import dense as kdense
+    if dense is None:
+        use_dense = kdense.supported(dg, spec)
+    elif dense:
+        if not kdense.supported(dg, spec):
+            raise ValueError("dense=True: kernel.dense.supported rejects "
+                             "this (graph, spec)")
+        use_dense = True
+    else:
+        use_dense = False
 
-    def local_advance(params, states):
-        def body(states, _):
-            states = jax.vmap(
-                lambda p, s: kstep.transition(dg, spec, p, s),
-                in_axes=(paxes, 0))(params, states)
-            states, _ = jax.vmap(
-                lambda p, s: kstep.record(dg, spec, p, s),
-                in_axes=(paxes, 0))(params, states)
-            return states, ()
-        states, _ = jax.lax.scan(body, states, None, length=inner_steps)
-        return states
+    def make_body(body_dense):
+        trans = kdense.transition if body_dense else kstep.transition
 
-    def train_step(key, params, states):
-        states = local_advance(params, states)
-        swaps = jnp.int32(0)
-        if exchange and n_dev > 1:
-            params, a0 = _swap_round(key, params, states.cut_count, 0,
-                                     n_dev)
-            # graftlint: disable=G002(_swap_round folds in the parity)
-            params, a1 = _swap_round(key, params, states.cut_count, 1,
-                                     n_dev)
-            swaps = a0.sum() + a1.sum()
-        info = {
-            "accepts": jax.lax.psum(states.accept_count.sum(), CHAINS_AXIS),
-            "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
-        }
-        return params, states, info
+        def local_advance(params, states):
+            def body(states, _):
+                states = jax.vmap(
+                    lambda p, s: trans(dg, spec, p, s),
+                    in_axes=(paxes, 0))(params, states)
+                states, _ = jax.vmap(
+                    lambda p, s: kstep.record(dg, spec, p, s),
+                    in_axes=(paxes, 0))(params, states)
+                return states, ()
+            states, _ = jax.lax.scan(body, states, None,
+                                     length=inner_steps)
+            return states
 
-    return _ShardedStep(mesh, train_step, "general", n_dev, exchange)
+        def train_step(key, params, states):
+            states = local_advance(params, states)
+            swaps = jnp.int32(0)
+            if exchange and n_dev > 1:
+                params, a0 = _swap_round(key, params, states.cut_count, 0,
+                                         n_dev)
+                # graftlint: disable=G002(_swap_round folds in the parity)
+                params, a1 = _swap_round(key, params, states.cut_count, 1,
+                                         n_dev)
+                swaps = a0.sum() + a1.sum()
+            info = {
+                "accepts": jax.lax.psum(states.accept_count.sum(),
+                                        CHAINS_AXIS),
+                "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
+            }
+            return params, states, info
+        return train_step
+
+    step = _ShardedStep(mesh, make_body(use_dense),
+                        "general_dense" if use_dense else "general",
+                        n_dev, exchange)
+    if use_dense:
+        step.prepare = lambda states: kdense.ensure_conn_bits(dg, spec,
+                                                              states)
+        step.fallback = lambda: (make_body(False), "general",
+                                 kdense.strip_conn_bits)
+    return step
 
 
 def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
@@ -304,7 +348,7 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
                         exchange)
     if kernel_path in ("bitboard", "lowered_bits"):
         step.fallback = lambda: (make_body(False),
-                                 kboard.body_for(bg, spec, False))
+                                 kboard.body_for(bg, spec, False), None)
     return step
 
 
@@ -397,6 +441,10 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
             met.notify(rec)
 
     jax.block_until_ready(states.accept_count)
+    if getattr(states, "conn_bits", None) is not None:
+        # the dense step's prepare hook attached the conn plane; hand
+        # the caller's treedef back (checkpoints, downstream jits)
+        states = states.replace(conn_bits=None)
     wall_total = time.perf_counter() - t_run0
     flips = n_chains * total
     fps = flips / max(wall_total, 1e-12)

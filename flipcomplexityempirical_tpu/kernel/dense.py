@@ -1,0 +1,343 @@
+"""General kernel v2: rejection-free dense proposal over bit-packed
+node sets (ISSUE 15).
+
+The legacy general kernel (kernel/step.py:propose) re-proposes invalid
+moves in a ``lax.while_loop``; under vmap that loop runs at the batch-MAX
+trip count over all C chains, re-executing boundary prefix-sum selection
+and ``patch_connected`` each pass (PROFILE.md round-2 diagnosis). This
+module applies the board-kernel playbook to arbitrary sparse graphs:
+
+1. **rejection-free proposal** — per step, build the full length-N (or
+   N*K for the 'pair' walk) validity plane once and select the m-th
+   valid move directly. Conditioned on the state, "uniform over the
+   move set, re-propose until valid" IS "uniform over the valid subset"
+   (rejection-sampling equivalence), so the step distribution matches
+   the legacy kernel exactly whenever a valid move exists; a step with
+   zero valid moves self-loops (the legacy kernel's max_tries
+   exhaustion, reached deterministically instead of after 256 draws).
+2. **bit-packed node sets** — the validity plane lives in
+   ``ceil(N/32)`` uint32 words; selection is a two-stage
+   ``lax.population_count`` reduction (word cumsum -> in-word prefix
+   popcount), the bitboard-v3 selection generalized off the lattice.
+3. **incremental contiguity plane** — ``ChainState.conn_bits`` carries
+   "flipping node i keeps its origin district connected" as one bit per
+   node. A committed flip at v only changes the plane inside
+   {v} | patch(v) (radius-r patch balls are symmetric: u in patch(v)
+   iff v in patch(u), asserted by tests/test_dense.py), so the refresh
+   is O(P) ``patch_connected`` calls per step, not N.
+
+Not bit-identical to the legacy kernel (different PRNG consumption),
+so it ships as its own visibly tagged dispatch rung ``general_dense``
+with the legacy kernel as correctness oracle and degradation target —
+never a silent swap. Acceptance and all bookkeeping funnel through
+``kernel/step.py:commit``, shared with the legacy kernel, which pins
+the Metropolis/waits/counter semantics equal by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graphs.lattice import DeviceGraph
+from ..state import chain_state
+from ..state.chain_state import ChainState
+from . import contiguity
+from . import step as kstep
+from .step import Spec, StepParams
+
+
+def supported(graph, spec: Spec) -> bool:
+    """True iff ``general_dense`` can run this (graph, spec). Gated OUT:
+    'selfloop' invalid policy (one draw per step is a different walk than
+    uniform-over-valid), frame_interface (a global plane, not per-node),
+    'exact' contiguity (a whole-graph BFS per node would cost O(N^2));
+    everything else the legacy general kernel accepts is in."""
+    if spec.proposal not in ("bi", "pair"):
+        return False
+    if spec.proposal == "bi" and spec.n_districts != 2:
+        return False
+    if spec.nobacktrack and spec.proposal != "bi":
+        return False
+    if spec.invalid != "repropose":
+        return False
+    if spec.frame_interface:
+        return False
+    if spec.contiguity not in ("patch", "none"):
+        return False
+    if spec.contiguity == "patch" and not getattr(graph, "patch_ok", True):
+        return False
+    return True
+
+
+def n_words(n: int) -> int:
+    """uint32 words needed for an n-bit node set."""
+    return (n + 31) // 32
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def pack_mask(mask: jnp.ndarray) -> jnp.ndarray:
+    """bool[M] -> uint32[ceil(M/32)], bit j of word w = mask[32*w + j].
+    Pad bits (past M) are zero, so packed planes can be AND-ed freely
+    without ever selecting a pad index."""
+    m = mask.shape[0]
+    w = n_words(m)
+    padded = jnp.zeros(w * 32, bool).at[: m].set(mask)
+    lanes = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(
+        jnp.where(padded.reshape(w, 32),
+                  jnp.uint32(1) << lanes[None, :], jnp.uint32(0)),
+        axis=1, dtype=jnp.uint32)
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def unpack_mask(words: jnp.ndarray, n: int) -> jnp.ndarray:
+    """uint32[W] -> bool[n] (inverse of pack_mask, pad bits dropped)."""
+    lanes = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[:, None] >> lanes[None, :]) & jnp.uint32(1)
+    return bits.reshape(-1)[:n].astype(bool)
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def select_nth_set(words: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Index of the (m+1)-th set bit of a packed uint32[W] set — the
+    two-stage popcount selection: word-level popcount cumsum finds the
+    containing word, a 32-lane in-word prefix popcount finds the bit.
+    Returns 0 when the set is empty (callers check total > 0)."""
+    pc = jax.lax.population_count(words).astype(jnp.int32)
+    c = jnp.cumsum(pc)
+    wi = jnp.argmax(c > m).astype(jnp.int32)
+    r = m - (c[wi] - pc[wi])                 # rank within the word
+    lanes = jnp.arange(32, dtype=jnp.uint32)
+    # (2 << lane) - 1 keeps bits 0..lane; at lane 31 the uint32 shift
+    # wraps to 0 and the -1 yields the full mask — exactly right.
+    prefix = jax.lax.population_count(
+        words[wi] & ((jnp.uint32(2) << lanes) - jnp.uint32(1))
+    ).astype(jnp.int32)
+    bit = jnp.argmax(prefix > r).astype(jnp.int32)
+    return wi * 32 + bit
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def conn_plane(dg: DeviceGraph, spec: Spec, assignment: jnp.ndarray):
+    """bool[N]: "flipping node i out of its current district keeps that
+    district connected" — the full recompute (init and oracle; the
+    in-loop path maintains it incrementally via refresh_conn_bits)."""
+    n = dg.n_nodes
+    if spec.contiguity == "none":
+        return jnp.ones(n, bool)
+    a = assignment.astype(jnp.int32)
+    return jax.vmap(
+        lambda u: contiguity.patch_connected(dg, assignment, u, a[u])
+    )(jnp.arange(n, dtype=jnp.int32))
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def init_conn_bits(dg: DeviceGraph, spec: Spec, assignment: jnp.ndarray):
+    """uint32[ceil(N/32)] packed conn plane for one chain's assignment."""
+    return pack_mask(conn_plane(dg, spec, assignment))
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def _joint_patch_connected(dg: DeviceGraph, assignment: jnp.ndarray,
+                           nodes: jnp.ndarray) -> jnp.ndarray:
+    """``contiguity.patch_connected`` for a whole (R,) index vector at
+    once, with ONE fixpoint loop shared across the rows. Label
+    propagation is a monotone map, so running all rows to the joint
+    fixpoint computes exactly the per-node fixpoints — bit-identical to
+    R independent patch_connected calls — while the while_loop stops at
+    the deepest row's convergence (~member-subgraph diameter) instead
+    of the static P-iteration worst case, the refresh-path win that
+    pays for maintaining the conn plane every step."""
+    p = dg.max_patch
+    a = assignment.astype(jnp.int32)
+    pn = dg.patch_nodes[nodes]                        # (R, P), pad = self
+    padj = dg.patch_adj[nodes]                        # (R, P)
+    slots = jnp.arange(p, dtype=jnp.int32)
+    member = (a[pn] == a[nodes][:, None]) & (pn != nodes[:, None])
+    lane = jnp.uint32(1) << slots.astype(jnp.uint32)
+    member_word = jnp.sum(jnp.where(member, lane[None, :], 0),
+                          axis=1, dtype=jnp.uint32)   # (R,)
+    seed_mask = member & (slots[None, :] < dg.deg[nodes][:, None])
+    seed_word = jnp.sum(jnp.where(seed_mask, lane[None, :], 0),
+                        axis=1, dtype=jnp.uint32)
+    n_seeds = seed_mask.sum(axis=1)
+    start = seed_word & (~seed_word + jnp.uint32(1))  # lowest set bit
+
+    def cond(carry):
+        return carry[1]
+
+    def body(carry):
+        reach, _ = carry
+        sel = ((reach[:, None] >> slots.astype(jnp.uint32))
+               & jnp.uint32(1)).astype(bool)
+        contrib = jnp.where(sel, padj, jnp.uint32(0))
+        new = reach | (jax.lax.reduce(
+            contrib, jnp.uint32(0), jax.lax.bitwise_or, (1,))
+            & member_word)
+        return new, (new != reach).any()
+
+    reach, _ = jax.lax.while_loop(cond, body, (start, jnp.bool_(True)))
+    all_reached = (seed_word & ~reach) == 0
+    return jnp.where(n_seeds <= 1, True, all_reached)
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def refresh_conn_bits(dg: DeviceGraph, spec: Spec, assignment: jnp.ndarray,
+                      conn_bits: jnp.ndarray, v: jnp.ndarray):
+    """Post-commit incremental refresh: recompute the conn bit of
+    {v} | patch(v) against the committed assignment and splice the bits
+    in place. Idempotent on a rejected step (the recomputed bits equal
+    the carried ones), so no accept gating is needed. Patch pad slots
+    (pn == v) are deduped via ``live`` so every touched (word, bit) pair
+    is distinct and the scatter-adds cannot carry."""
+    if spec.contiguity == "none":
+        return conn_bits
+    pn = dg.patch_nodes[v]                            # i32[P], pad = v
+    aff = jnp.concatenate([v[None].astype(jnp.int32), pn])
+    live = jnp.concatenate([jnp.ones((1,), bool), pn != v])
+    new_bits = _joint_patch_connected(dg, assignment, aff)
+    w = conn_bits.shape[0]
+    wi = aff // 32
+    bit = (aff % 32).astype(jnp.uint32)
+    clear = jnp.zeros(w, jnp.uint32).at[wi].add(
+        jnp.where(live, jnp.uint32(1) << bit, jnp.uint32(0)))
+    sets = jnp.zeros(w, jnp.uint32).at[wi].add(
+        jnp.where(live & new_bits, jnp.uint32(1) << bit, jnp.uint32(0)))
+    return (conn_bits & ~clear) | sets
+
+
+def _pop_plane_bi(dg: DeviceGraph, params, a, dist_pop):
+    """bool[N] population feasibility for the 2-district sign flip
+    (d_to = 1 - a): both bounds evaluated at the single target —
+    two N-planes, not an (N, K) table."""
+    popv = dg.pop.astype(jnp.float32)
+    return (((dist_pop[a] - popv) >= params.pop_lo)
+            & ((dist_pop[1 - a] + popv) <= params.pop_hi))
+
+
+def _pop_planes(dg: DeviceGraph, params, a, dist_pop):
+    """Population-bound planes: ``from_ok`` bool[N] (donor district stays
+    >= pop_lo after losing node i) and ``to_ok`` bool[N, K] (district d
+    stays <= pop_hi after gaining node i) — the vectorized form of the
+    legacy _validate_parts pop predicate (pair walk)."""
+    popv = dg.pop.astype(jnp.float32)
+    from_ok = (dist_pop[a] - popv) >= params.pop_lo
+    to_ok = (dist_pop[None, :] + popv[:, None]) <= params.pop_hi
+    return from_ok, to_ok
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def propose_dense(dg: DeviceGraph, spec: Spec, params: StepParams,
+                  state: ChainState, key,
+                  count: bool = False):
+    """Rejection-free proposal: one uniform, one packed-popcount
+    selection over the exact valid-move set. Returns
+    ``(v, d_to, valid, tries)`` (+ the int32[3] reject-reason vector
+    when ``count``), the same contract as kernel/step.py:propose —
+    ``tries`` is always 1 (each step consumes exactly one draw), and a
+    zero-valid step returns valid=False (commit self-loops it and
+    exhausted_count advances, the legacy exhaustion outcome)."""
+    k = spec.n_districts
+    n = dg.n_nodes
+    a = state.assignment.astype(jnp.int32)
+    dist_pop = state.dist_pop.astype(jnp.float32)
+
+    if spec.proposal == "bi":
+        if k != 2:
+            raise ValueError("proposal 'bi' requires n_districts == 2")
+        cand = state.cut_deg > 0
+        if spec.nobacktrack:
+            f = state.cur_flip_node
+            fi = jnp.maximum(f, 0)
+            excl = (f >= 0) & cand[fi] & (state.b_count > 1)
+            cand = cand & ~((jnp.arange(n) == fi) & excl)
+        pop_ok = _pop_plane_bi(dg, params, a, dist_pop)
+        words = pack_mask(cand & pop_ok) & state.conn_bits
+    elif spec.proposal == "pair":
+        if spec.nobacktrack:
+            raise ValueError("nobacktrack requires proposal 'bi' "
+                             "(the pair walk has no single excluded "
+                             "reverse move)")
+        from_ok, to_ok = _pop_planes(dg, params, a, dist_pop)
+        pm = chain_state.pair_move_mask(dg, a, k)         # (N, K)
+        conn = unpack_mask(state.conn_bits, n)
+        cand = pm.any(axis=1)
+        pop_ok2 = from_ok[:, None] & to_ok
+        pop_ok = (pm & pop_ok2).any(axis=1)
+        words = pack_mask((pm & pop_ok2 & conn[:, None]).reshape(-1))
+    else:
+        raise ValueError(f"proposal {spec.proposal!r}")
+
+    total = jnp.sum(jax.lax.population_count(words).astype(jnp.int32))
+    u = jax.random.uniform(key)
+    m = jnp.minimum((u * total.astype(jnp.float32)).astype(jnp.int32),
+                    jnp.maximum(total - 1, 0))
+    idx = select_nth_set(words, m)
+    if spec.proposal == "bi":
+        v = jnp.minimum(idx, n - 1)
+        d_to = 1 - a[v]
+    else:
+        v = jnp.minimum(idx // k, n - 1)
+        d_to = idx % k
+    valid = total > 0
+    tries = jnp.int32(1)
+    if not count:
+        return v, d_to, valid, tries
+    # zero-valid attribution, priority-ordered like the legacy taxonomy
+    # ([non-boundary, pop-bound, disconnect]): no boundary move at all ->
+    # non-boundary; boundary moves but none pop-feasible -> pop; else the
+    # contiguity plane killed the survivors -> disconnect.
+    if spec.proposal == "bi":
+        any_cand = cand.any()
+        any_pop = (cand & pop_ok).any()
+    else:
+        any_cand = cand.any()
+        any_pop = pop_ok.any()
+    reason = jnp.where(~any_cand, 0, jnp.where(~any_pop, 1, 2))
+    rej3 = ((jnp.arange(3) == reason) & ~valid).astype(jnp.int32)
+    return v, d_to, valid, tries, rej3
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
+               state: ChainState) -> ChainState:
+    """One general_dense chain step: rejection-free propose, then the
+    SHARED accept/commit tail (kernel/step.py:commit), then the O(P)
+    incremental conn-plane refresh. Requires ``state.conn_bits`` (the
+    runner enables it at entry, exactly the reject_count pattern)."""
+    if state.conn_bits is None:
+        raise ValueError("general_dense transition needs state.conn_bits; "
+                         "enable it with kernel.dense.ensure_conn_bits "
+                         "(runners do this on entry)")
+    key, kprop, kacc, kwait = jax.random.split(state.key, 4)
+    count = state.reject_count is not None
+    if count:
+        v, d_to, valid, tries, rej3 = propose_dense(
+            dg, spec, params, state, kprop, count=True)
+    else:
+        v, d_to, valid, tries = propose_dense(dg, spec, params, state, kprop)
+        rej3 = None
+    new = kstep.commit(dg, spec, params, state, key, kacc, kwait,
+                       v, d_to, valid, tries, rej3)
+    return new.replace(conn_bits=refresh_conn_bits(
+        dg, spec, new.assignment, state.conn_bits, v))
+
+
+def ensure_conn_bits(dg: DeviceGraph, spec: Spec, states: ChainState
+                     ) -> ChainState:
+    """Batch entry hook: attach the packed conn plane to a batch of
+    chain states (leading chains axis) if absent. Treedef changes from
+    None -> array, so callers jit AFTER this, never across it."""
+    if states.conn_bits is not None:
+        return states
+    init = jax.jit(jax.vmap(lambda a: init_conn_bits(dg, spec, a)))
+    return states.replace(conn_bits=init(states.assignment))
+
+
+def strip_conn_bits(states: ChainState) -> ChainState:
+    """Exit hook / degradation edge: drop the carried conn plane so the
+    escaping treedef matches the legacy contract."""
+    if states.conn_bits is None:
+        return states
+    return states.replace(conn_bits=None)

@@ -391,7 +391,6 @@ def propose(dg: DeviceGraph, spec: Spec, params: StepParams,
 def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
                state: ChainState) -> ChainState:
     """One chain step: propose(+retries), Metropolis-accept, commit."""
-    k = spec.n_districts
     key, kprop, kacc, kwait = jax.random.split(state.key, 4)
     count = state.reject_count is not None
     if count:
@@ -399,6 +398,24 @@ def transition(dg: DeviceGraph, spec: Spec, params: StepParams,
                                               kprop, count=True)
     else:
         v, d_to, valid, tries = propose(dg, spec, params, state, kprop)
+        rej3 = None
+    return commit(dg, spec, params, state, key, kacc, kwait,
+                  v, d_to, valid, tries, rej3)
+
+
+# graftlint: traced  (entered via cross-module jit/vmap/scan)
+def commit(dg: DeviceGraph, spec: Spec, params: StepParams,
+           state: ChainState, key, kacc, kwait, v, d_to, valid, tries,
+           rej3=None) -> ChainState:
+    """Metropolis-accept + masked state commit for a drawn proposal
+    (v, d_to, valid). Shared tail of every general-path transition —
+    the legacy re-propose kernel above and the rejection-free dense
+    kernel (kernel/dense.py) both funnel through it, which is what makes
+    their acceptance/bookkeeping semantics identical by construction.
+    ``rej3`` is the int32[3] pre-accept reject taxonomy (None when the
+    state carries no reject_count); the Metropolis taxon is added here."""
+    k = spec.n_districts
+    count = state.reject_count is not None
 
     d_from = state.assignment[v].astype(jnp.int32)
     nb = dg.nbr[v]                       # (D,), pad = v

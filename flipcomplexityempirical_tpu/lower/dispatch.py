@@ -21,10 +21,13 @@ from .stencil import stencil_for
 # walks this ladder downward when a body fails to compile or run — but
 # only between bodies that share a state layout: lowered_bits -> lowered
 # and bitboard -> board are in-segment retries (each pair carries the
-# same BoardState), everything else -> general means a config-level
-# restart on the general runner.
+# same BoardState), and general_dense -> general is in-segment on the
+# general runner (both carry ChainState; the dense rung's extra
+# conn_bits plane is stripped on the way down). Board-family ->
+# general_dense/general means a config-level restart on the general
+# runner.
 DISPATCH_LADDER = ("lowered_bits", "lowered", "bitboard", "board",
-                   "general")
+                   "general_dense", "general")
 
 
 def next_path(path: str) -> str | None:
@@ -38,14 +41,16 @@ def next_path(path: str) -> str | None:
 
 
 def kernel_path_for(graph: LatticeGraph, spec) -> str:
-    """'lowered_bits' | 'lowered' | 'bitboard' | 'board' | 'general' —
-    the body the runners will select for this workload
-    (sampling/board_runner.py + kernel/board.py::run_board_chunk
-    dispatch, bits=None auto)."""
-    from ..kernel import bitboard, board
+    """'lowered_bits' | 'lowered' | 'bitboard' | 'board' |
+    'general_dense' | 'general' — the body the runners will select for
+    this workload (sampling/board_runner.py + kernel/board.py::
+    run_board_chunk dispatch, bits=None auto; sampling/runner.py
+    general-family dispatch, kernel_path=None auto)."""
+    from ..kernel import bitboard, board, dense
 
     if not board.supports(graph, spec):
-        return "general"
+        return "general_dense" if dense.supported(graph, spec) \
+            else "general"
     st = stencil_for(graph)
     if st.surgical or spec.record_interface:
         # the packed-body gate duck-types on StencilSpec (uniform_pop,

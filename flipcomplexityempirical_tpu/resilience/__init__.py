@@ -25,7 +25,8 @@ from .faults import (ENV_VAR, SITES, FaultPlan, FaultRule, InjectedFault,
                      install_from_env, install_from_spec, install_plan,
                      truncate_file)
 from .degrade import (DEGRADATIONS, is_device_loss, is_kernel_error,
-                      next_board_body, record_degradation)
+                      next_board_body, next_general_path,
+                      record_degradation)
 from .supervisor import (DETERMINISTIC, RESOURCE, TRANSIENT,
                          DeadlineScope, RetryPolicy, SweepReport,
                          check_deadline, classify_error,
@@ -39,7 +40,7 @@ __all__ = [
     "active_plan", "corrupt_file", "fault_point", "install_from_env",
     "install_from_spec", "install_plan", "truncate_file",
     "DEGRADATIONS", "is_device_loss", "is_kernel_error",
-    "next_board_body", "record_degradation",
+    "next_board_body", "next_general_path", "record_degradation",
     "DETERMINISTIC", "RESOURCE", "TRANSIENT", "DeadlineScope",
     "RetryPolicy", "SweepReport", "check_deadline", "classify_error",
     "clear_deadline", "run_supervised_sweep", "set_deadline",

@@ -12,11 +12,14 @@ the intended path.
 
 Within the board family, lowered_bits -> lowered and bitboard -> int8
 board are retryable *in-segment* (each pair advances the same
-BoardState; the bit-packing happens inside ``run_board_chunk``). A
-lowered or int8-board failure raises ``KernelPathError`` instead, and
-the driver reruns the config on the general gather kernel from its last
-compatible checkpoint (board and general states are different pytrees,
-so there is no mid-segment hop between them).
+BoardState; the bit-packing happens inside ``run_board_chunk``). Within
+the general family, general_dense -> general is likewise in-segment
+(both advance a ChainState; the dense rung's extra ``conn_bits`` plane
+is stripped on the way down — ``next_general_path``). A lowered or
+int8-board failure raises ``KernelPathError`` instead, and the driver
+reruns the config on the general runner from its last compatible
+checkpoint (board and general states are different pytrees, so there
+is no mid-segment hop between them).
 """
 
 from __future__ import annotations
@@ -76,6 +79,16 @@ def next_board_body(path: str):
     nxt = next_path(path)
     return (nxt if (path, nxt) in (("lowered_bits", "lowered"),
                                    ("bitboard", "board")) else None)
+
+
+def next_general_path(path: str):
+    """The next body down *within the general family*, or None.
+    general_dense -> general shares the ChainState layout (the runner
+    strips ``conn_bits`` on the hop); plain general is the ladder floor."""
+    from ..lower.dispatch import next_path  # import-light until needed
+
+    nxt = next_path(path)
+    return nxt if (path, nxt) == ("general_dense", "general") else None
 
 
 def describe_error(exc: BaseException) -> str:

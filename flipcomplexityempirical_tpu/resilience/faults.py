@@ -13,8 +13,11 @@ checkpoint.load    driver.load_checkpoint (raise before reading;
 segment.step       the segment loops in driver._run_jax /
                    _run_temper_segmented, before each segment
 compile            sampling.board_runner / distribute.sharded, before
-                   each chunk dispatch (stands in for an XLA
-                   compile/runtime error to exercise degradation)
+                   each chunk dispatch, and sampling.runner on the
+                   general_dense rung only — the legacy general floor
+                   stays fault-free so poisoned runs can complete
+                   (stands in for an XLA compile/runtime error to
+                   exercise degradation)
 recorder.emit      obs.recorder.Recorder.emit (telemetry sink I/O)
 heartbeat.write    driver.write_heartbeat (must be non-fatal)
 sigterm            service.lifecycle.check_drain (an armed rule stands

@@ -23,8 +23,11 @@ import numpy as np
 from .. import obs
 from ..graphs.lattice import DeviceGraph, LatticeGraph
 from ..state.chain_state import ChainState, init_state
+from ..kernel import dense as kdense
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
+from ..resilience import degrade as rdegrade
+from ..resilience import faults as rfaults
 
 
 @dataclasses.dataclass
@@ -99,10 +102,17 @@ def init_batch(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
 def _run_chunk(dg: DeviceGraph, spec: Spec, params: StepParams,
                states: ChainState, chunk: int, collect: bool = True):
     paxes = StepParams.vmap_axes()
+    # general-family body dispatch is a trace-time treedef decision: a
+    # state carrying the packed conn plane runs the rejection-free dense
+    # kernel, a bare state runs the legacy re-propose kernel — exactly
+    # how reject_count toggles counting. The runner (and the degradation
+    # hop) controls which by attaching/stripping conn_bits.
+    trans = (kdense.transition if states.conn_bits is not None
+             else kstep.transition)
 
     def body(states, _):
         states = jax.vmap(
-            lambda p, s: kstep.transition(dg, spec, p, s),
+            lambda p, s: trans(dg, spec, p, s),
             in_axes=(paxes, 0))(params, states)
         states, out = jax.vmap(
             lambda p, s: kstep.record(dg, spec, p, s),
@@ -165,7 +175,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                record_initial: bool = True,
                record_every: int = 1,
                history_device: bool = False,
-               recorder=None) -> RunResult:
+               recorder=None,
+               kernel_path: Optional[str] = None) -> RunResult:
     """Run the batched chain for ``n_steps`` yields (the first yield is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
 
@@ -199,6 +210,19 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     (``states.reject_count``), which respecializes the jit via the
     pytree treedef; the sampled trajectories are bit-identical either
     way (counting draws no randomness).
+
+    ``kernel_path``: which general-family body advances the chain.
+    None (the default) auto-resolves like lower/dispatch.py —
+    'general_dense' (the rejection-free bit-packed kernel,
+    kernel/dense.py) when the (graph, spec) supports it, else the
+    legacy 'general'. Pass 'general' to force the legacy oracle (bench
+    races, parity tests) or 'general_dense' to demand the dense body
+    (raises when unsupported). The two bodies are distribution-
+    equivalent, not bit-identical, so the resolved path is tagged on
+    every obs event and never swapped silently; an injected/real
+    compile failure on the dense body degrades in-segment to 'general'
+    (conn_bits stripped, same chunk replayed) with a
+    ``kernel_path_degraded`` event + DEGRADATIONS entry.
     """
     rec = obs.resolve_recorder(recorder)
     n_chains = states.assignment.shape[0]
@@ -206,6 +230,25 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     if rec and not had_rej:
         states = states.replace(
             reject_count=jnp.zeros((n_chains, 4), jnp.int32))
+    if kernel_path is None:
+        path = ("general_dense" if kdense.supported(dg, spec)
+                else "general")
+    elif kernel_path == "general_dense":
+        if not kdense.supported(dg, spec):
+            raise ValueError(
+                "kernel_path='general_dense' demanded but "
+                "kernel.dense.supported rejects this (graph, spec)")
+        path = "general_dense"
+    elif kernel_path == "general":
+        path = "general"
+    else:
+        raise ValueError(f"kernel_path {kernel_path!r}: general-family "
+                         f"runner takes 'general_dense' | 'general' | None")
+    had_conn = states.conn_bits is not None
+    if path == "general_dense":
+        states = kdense.ensure_conn_bits(dg, spec, states)
+    elif not had_conn:
+        states = kdense.strip_conn_bits(states)
     if record_every < 1:
         raise ValueError(f"record_every must be >= 1, got {record_every}")
     if chunk is None:
@@ -214,7 +257,7 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         chunk = snap_chunk_to(chunk, record_every)
 
     if rec:
-        rec.emit("run_start", runner="general", path="general",
+        rec.emit("run_start", runner="general", path=path,
                  chains=n_chains,
                  n_steps=n_steps, chunk=chunk,
                  record_history=record_history, record_every=record_every,
@@ -227,11 +270,11 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         last_tries = int(np.asarray(states.tries_sum, np.int64).sum())
         last_rej = (np.asarray(states.reject_count, np.int64).sum(axis=0)
                     if states.reject_count is not None else None)
-        mon = obs.ChainMonitor(rec, total=n_steps, path="general",
+        mon = obs.ChainMonitor(rec, total=n_steps, path=path,
                                runner="general")
         met = obs.MetricsRegistry()
-        run_span = obs.span(rec, "run:general", annotate=True,
-                            kernel_path="general", chains=n_chains,
+        run_span = obs.span(rec, f"run:{path}", annotate=True,
+                            kernel_path=path, chains=n_chains,
                             n_steps=n_steps).begin()
 
     if record_initial:
@@ -266,10 +309,31 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
             # event so compile/diag spans nest inside it. annotate=True
             # mirrors it into jax.profiler.TraceAnnotation.
             csp = obs.span(rec, "chunk", annotate=True,
-                           kernel_path="general", steps=this,
+                           kernel_path=path, steps=this,
                            done=done).begin()
-        states, outs = _run_chunk(dg, spec, params, states, this,
-                                  collect=record_history)
+        try:
+            if path == "general_dense":
+                # the legacy floor carries no fault point: it is the
+                # ladder's terminal rung, so a persistent injected
+                # compile fault (chaos: compile:always) must still let
+                # the run complete there
+                rfaults.fault_point("compile", path=path, done=done)
+            states, outs = _run_chunk(dg, spec, params, states, this,
+                                      collect=record_history)
+        except Exception as e:  # noqa: BLE001 — classified just below
+            if path != "general_dense" or not rdegrade.is_kernel_error(e):
+                raise
+            # in-segment fall-through: strip the dense-only conn plane
+            # and replay this very chunk on the legacy kernel with the
+            # SAME state/key (deterministic; `done` is untouched).
+            rdegrade.record_degradation(
+                rec, "general_dense", "general",
+                rdegrade.describe_error(e), done=done)
+            path = "general"
+            states = kdense.strip_conn_bits(states)
+            if rec:
+                csp.end(degraded=True)
+            continue
         if rec:
             watch.poll(rec, chunk=this,
                        cost=lambda: obs.aot_cost(
@@ -313,7 +377,7 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                 last_rej, last_tries = rej, tries
             accept_rate = (acc - last_acc) / (n_chains * this)
             flips_per_s = n_chains * this / max(wall, 1e-12)
-            rec.emit("chunk", runner="general", path="general",
+            rec.emit("chunk", runner="general", path=path,
                      steps=this,
                      chains=n_chains, flips=n_chains * this,
                      wall_s=wall,
@@ -344,8 +408,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         snap = met.snapshot()
         rec.emit("metrics_snapshot", counters=snap["counters"],
                  gauges=snap["gauges"], histograms=snap["histograms"],
-                 runner="general", path="general")
-        rec.emit("run_end", runner="general", path="general",
+                 runner="general", path=path)
+        rec.emit("run_end", runner="general", path=path,
                  n_yields=n_steps,
                  chains=n_chains, flips=flips, wall_s=wall,
                  flips_per_s=flips / max(wall, 1e-12),
@@ -357,5 +421,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         # the counters were telemetry-enabled here; hand back the
         # caller's treedef (checkpoints, downstream jits) unchanged
         states = states.replace(reject_count=None)
+    if not had_conn:
+        # same treedef contract for the dense conn plane
+        states = kdense.strip_conn_bits(states)
     return RunResult(state=states, history=history,
                      waits_total=waits_total, n_yields=n_steps)

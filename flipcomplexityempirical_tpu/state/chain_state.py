@@ -57,6 +57,14 @@ class ChainState:
     # enable it with .replace(reject_count=zeros) when a recorder is
     # attached, which respecializes the jit via the treedef change.
     reject_count: Optional[jnp.ndarray] = None
+    # packed per-node contiguity plane (ISSUE 15): uint32[ceil(N/32)],
+    # bit i == "flipping node i keeps its origin district connected" for
+    # the CURRENT assignment. Same trailing-Optional contract as
+    # reject_count: None keeps the treedef (and every compiled graph and
+    # checkpoint) identical; only the general_dense kernel enables it
+    # (kernel/dense.py maintains it incrementally), and runners strip it
+    # again before states escape.
+    conn_bits: Optional[jnp.ndarray] = None
 
     @property
     def n_districts(self) -> int:

@@ -68,7 +68,7 @@ for _k in (2, 4, 8):
         overrides=(("alignment", 0), ("base", MU), ("pop_tol", 0.25),
                    ("n_districts", _k), ("dual_source", "fixture"),
                    ("total_steps", 1500), ("n_chains", 4)),
-        kernel_path="general",
+        kernel_path="general_dense",
         stats=("compactness", "partisan"),
     ))
 
@@ -102,11 +102,12 @@ _W(WorkloadSpec(
     family="sec11",
     description="non-backtracking flip proposal (arxiv 1204.4140) on "
                 "the sec11 grid — excludes the last-flipped node from "
-                "the boundary draw; runs the general kernel",
+                "the boundary draw; runs the rejection-free dense "
+                "general kernel",
     overrides=(("alignment", 2), ("base", MU), ("pop_tol", 0.1),
                ("total_steps", 3000), ("n_chains", 8)),
     variant="nobacktrack",
-    kernel_path="general",
+    kernel_path="general_dense",
 ))
 _W(WorkloadSpec(
     name="frank-lazy",
@@ -117,5 +118,5 @@ _W(WorkloadSpec(
     overrides=(("alignment", 2), ("base", 1 / .3), ("pop_tol", 0.1),
                ("total_steps", 3000), ("n_chains", 8)),
     variant="lazy",
-    kernel_path="general",
+    kernel_path="general_dense",
 ))

@@ -148,21 +148,24 @@ def test_kernel_path_routing():
     assert lower.kernel_path_for(
         fce.graphs.square_grid(6, queen=True), spec) == "lowered_bits"
     assert lower.kernel_path_for(fce.graphs.square_grid(6, 6), spec) == "board"
+    # hex rejects lowering (radius-3 patches) and lands on the
+    # rejection-free dense rung (ISSUE 15), not the legacy kernel
     assert lower.kernel_path_for(fce.graphs.hex_lattice(4, 4),
-                                 spec) == "general"
+                                 spec) == "general_dense"
     # a w=4 canvas realizes one flat B2 offset by two distinct (dr, dc)
     # pairs => b2_disp is None and the packed body stands down to the
     # int8 lowered body (bitboard.supported_lowered)
     g4 = fce.graphs.square_grid(3, 4, remove_nodes=[(0, 0)],
                                 extra_edges=[((0, 1), (1, 0))])
     assert lower.kernel_path_for(g4, spec) == "lowered"
-    # record_interface: lowered where wall planes encode, general where
-    # the graph has no walls at all
+    # record_interface: lowered where wall planes encode, the general
+    # family (dense rung first — interface recording lives in the
+    # shared commit tail) where the graph has no walls at all
     ispec = fce.Spec(record_interface=True)
     assert lower.kernel_path_for(fce.graphs.grid_sec11(),
                                  ispec) == "lowered_bits"
     assert lower.kernel_path_for(fce.graphs.square_grid(6, 6),
-                                 ispec) == "general"
+                                 ispec) == "general_dense"
     # dispatch agrees with the body the runner will build
     for g in (fce.graphs.grid_sec11(), fce.graphs.square_grid(6, 6)):
         bg = kb.make_board_graph(g)

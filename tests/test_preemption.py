@@ -625,10 +625,14 @@ def test_elastic_run_survives_device_loss(tmp_path):
     from tools import bench_compare
 
     dg, spec, mesh, states, params = _mesh_setup()
+    # dense=False forces the legacy general step: since ISSUE 15 the
+    # default resolves general_dense, whose in-family fallback would
+    # CONSUME the injected compile fault as a kernel degradation
+    # (covered in test_elastic_dense_fault_degrades_not_resharded) —
+    # the legacy body has no fallback, so the fault escapes run_sharded
+    # as a device loss mid-run (segment 1)
     make_step = lambda m: dsh.make_train_step(dg, spec, m,
-                                              inner_steps=5)
-    # general step has no in-family fallback, so the injected compile
-    # fault escapes run_sharded as a device loss mid-run (segment 1)
+                                              inner_steps=5, dense=False)
     rfaults.install_from_spec("compile:once@3")
     ev = str(tmp_path / "events.jsonl")
     with obs.Recorder(ev) as rec:
@@ -645,6 +649,38 @@ def test_elastic_run_survives_device_loss(tmp_path):
     assert len(md) == 1 and md[0]["to_devices"] == 2
     # degraded records must not gate
     assert bench_compare.record_degraded(info)
+
+
+def test_elastic_dense_fault_degrades_not_resharded(tmp_path):
+    """The default sharded step resolves general_dense (ISSUE 15), which
+    HAS an in-family fallback: an injected compile fault degrades the
+    kernel general_dense -> general inside run_sharded — same segment,
+    same key, shared ChainState with the conn plane stripped — so the
+    fault never escapes as a device loss and the mesh stays whole."""
+    import jax
+    from flipcomplexityempirical_tpu.distribute import sharded as dsh
+    from flipcomplexityempirical_tpu.resilience import degrade as rdegrade
+
+    dg, spec, mesh, states, params = _mesh_setup()
+    make_step = lambda m: dsh.make_train_step(dg, spec, m, inner_steps=5)
+    assert make_step(mesh).kernel_path == "general_dense"
+    mark = rdegrade.snapshot()
+    rfaults.install_from_spec("compile:once@3")
+    ev = str(tmp_path / "events.jsonl")
+    with obs.Recorder(ev) as rec:
+        _, _, info = dsh.run_sharded_elastic(
+            make_step, mesh, params, states, rounds=4, inner_steps=5,
+            key=jax.random.PRNGKey(3), recorder=rec, segment_rounds=2)
+    rfaults.install_plan(None)
+    assert info["devices"] == 4 and "degraded" not in info  # mesh whole
+    assert info["flips"] == 8 * 4 * 5
+    assert info["kernel_path"] == "general"  # finished on the fallback
+    falls = [(d["from_path"], d["to_path"]) for d in rdegrade.since(mark)]
+    assert falls == [("general_dense", "general")]
+    evs = [json.loads(l) for l in open(ev)]
+    assert not any(e["event"] == "mesh_degraded" for e in evs)
+    kd = [e for e in evs if e["event"] == "kernel_path_degraded"]
+    assert len(kd) == 1 and kd[0]["to_path"] == "general"
 
 
 def test_elastic_run_clean_is_unmarked():

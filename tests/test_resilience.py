@@ -222,17 +222,23 @@ def test_checkpoint_identity_error_names_both_sides():
 def test_dispatch_ladder_and_board_fallback():
     from flipcomplexityempirical_tpu.lower import dispatch
     assert dispatch.DISPATCH_LADDER == ("lowered_bits", "lowered",
-                                        "bitboard", "board", "general")
+                                        "bitboard", "board",
+                                        "general_dense", "general")
     assert dispatch.next_path("lowered_bits") == "lowered"
     assert dispatch.next_path("lowered") == "bitboard"
+    assert dispatch.next_path("general_dense") == "general"
     assert dispatch.next_path("general") is None
     assert dispatch.next_path("pallas") is None
-    # only the state-compatible lowered_bits -> lowered and
-    # bitboard -> board hops stay in-segment
+    # only the state-compatible lowered_bits -> lowered,
+    # bitboard -> board and general_dense -> general hops stay
+    # in-segment
     assert rz.next_board_body("lowered_bits") == "lowered"
     assert rz.next_board_body("bitboard") == "board"
     assert rz.next_board_body("lowered") is None
     assert rz.next_board_body("board") is None
+    assert rz.next_general_path("general_dense") == "general"
+    assert rz.next_general_path("general") is None
+    assert rz.next_general_path("board") is None
 
 
 # ---- supervisor over a stubbed driver ----------------------------------
@@ -563,8 +569,10 @@ def test_poison_config_quarantined_with_nonzero_exit(tmp_path):
 def test_compile_fault_degrades_to_general(tmp_path):
     """A persistent kernel failure walks the WHOLE ladder: the packed
     lowered_bits body falls in-segment to the int8 lowered body, which
-    then hands the config to the general gather kernel — completing
-    with two kernel_path_degraded events instead of crashing."""
+    then hands the config to the general rerun; there the dense rung
+    faults once more and falls in-segment to the legacy general kernel
+    — the ladder's fault-free terminal floor — completing with three
+    kernel_path_degraded events instead of crashing."""
     cfg = _ckpt_cfg(total_steps=40, checkpoint_every=0)
     rfaults.install_from_spec("compile:always")
     ev = str(tmp_path / "ev.jsonl")
@@ -575,7 +583,8 @@ def test_compile_fault_degrades_to_general(tmp_path):
     assert data["history"]["cut_count"].shape == (2, 40)
     deg = [e for e in _events(ev) if e["event"] == "kernel_path_degraded"]
     assert [(d["from_path"], d["to_path"]) for d in deg] == [
-        ("lowered_bits", "lowered"), ("lowered", "general")]
+        ("lowered_bits", "lowered"), ("lowered", "general"),
+        ("general_dense", "general")]
     assert len(rz.DEGRADATIONS) > mark   # audit trail for bench records
 
 

@@ -314,3 +314,33 @@ def test_bench_compare_mesh_still_refuses_fallback_mismatch(tmp_path):
     r = _compare(a, b)
     assert r.returncode == 0, r.stderr
     assert "incomparable devices" in r.stderr
+
+
+def test_bench_compare_general_paths_never_cross_gate(tmp_path):
+    """Two records whose bench config lines differ ONLY in kernel_path
+    (general_dense vs legacy general) must not gate against each other:
+    the dense body is distribution-equivalent but a different kernel, so
+    its 3x throughput must never read as a legacy-path 'regression' (or
+    vice versa). _config_name keys on kernel_path — pin that here."""
+    def rec(path, kernel_path, seconds):
+        cfg = {"path": "general", "kernel_path": kernel_path,
+               "graph": "hex", "grid": 32, "k": 2, "chains": 256,
+               "steps": 201, "seconds": seconds, "device": "TFRT_CPU_0"}
+        # a shared, unchanged metric keeps the gate armed: the refusal
+        # we are pinning is per-key, not a record-level incomparability
+        tail = (json.dumps({"metric": "flips_per_sec_total",
+                            "value": 1000.0, "unit": "flips/s",
+                            "device": "TFRT_CPU_0"}) + "\n"
+                + json.dumps(cfg) + "\n")
+        path.write_text(json.dumps({"n": 1, "rc": 0, "tail": tail}))
+        return path
+
+    a = rec(tmp_path / "a.json", "general_dense", seconds=0.8)
+    b = rec(tmp_path / "b.json", "general", seconds=2.4)  # 3x slower body
+    r = _compare(a, b)
+    assert r.returncode == 0, r.stderr
+    assert "REGRESSED" not in r.stdout
+    # the two config throughputs landed under distinct keys, one per side
+    assert "kernel_path=general_dense" in r.stdout
+    assert "kernel_path=general," in r.stdout
+    assert "only in A" in r.stdout and "only in B" in r.stdout

@@ -252,14 +252,16 @@ def test_run_chains_span_stream(tmp_path):
     begins = [e for e in events if e["event"] == "span_begin"]
     ends = [e for e in events if e["event"] == "span_end"]
     assert len(begins) == len(ends) > 0
-    run_b = next(b for b in begins if b["name"] == "run:general")
-    assert run_b["kernel_path"] == "general" and run_b["chains"] == 4
+    # an 8x8 rook grid off the board path auto-resolves the rejection-
+    # free dense rung — the span stream must carry the REAL path tag
+    run_b = next(b for b in begins if b["name"] == "run:general_dense")
+    assert run_b["kernel_path"] == "general_dense" and run_b["chains"] == 4
     chunk_bs = [b for b in begins if b["name"] == "chunk"]
     assert len(chunk_bs) == 4  # one per executed chunk
     for b in chunk_bs:
-        assert b["kernel_path"] == "general"
+        assert b["kernel_path"] == "general_dense"
         assert b["parent_id"] == run_b["span_id"]
-    run_e = next(e for e in ends if e["name"] == "run:general")
+    run_e = next(e for e in ends if e["name"] == "run:general_dense")
     assert run_e["flips"] > 0 and run_e["wall_s"] > 0
     chunk_es = [e for e in ends if e["name"] == "chunk"]
     assert all("reject" in e and e["wall_s"] > 0 for e in chunk_es)
@@ -421,7 +423,7 @@ def test_trace_export_real_run_roundtrip(tmp_path):
     with open(out) as f:
         doc = json.load(f)
     xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
-    assert {e["name"] for e in xs} >= {"run:general", "chunk"}
+    assert {e["name"] for e in xs} >= {"run:general_dense", "chunk"}
 
 
 def test_trace_export_validate_rejects_broken_spans(tmp_path):

@@ -83,6 +83,17 @@ def test_resolve_matches_declared_kernel_paths():
         assert set(np.unique(np.asarray(r.plan))) == set(range(k))
 
 
+def test_stencil_rejected_families_resolve_to_general_dense():
+    """The dual-fixture family and the proposal variants are exactly the
+    workloads the stencil pass rejects; since ISSUE 15 they must land on
+    the rejection-free general_dense rung, not the legacy general kernel
+    — pinned here explicitly (the declaration-vs-resolution test above
+    would still pass if both quietly reverted together)."""
+    for n in ("dual-fixture", "dual-fixture-k4", "dual-fixture-k8",
+              "sec11-nobacktrack", "frank-lazy"):
+        assert workloads.resolve(n).kernel_path == "general_dense", n
+
+
 # ---------------------------------------------------------------------------
 # dual-graph fixture: ingestion + end-to-end sweep
 # ---------------------------------------------------------------------------

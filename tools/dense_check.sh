@@ -1,0 +1,112 @@
+#!/usr/bin/env bash
+# General-dense CI gate (`make dense-check`), ISSUE 15: the rejection-free
+# general_dense kernel body must (1) keep the tree graftlint-clean,
+# (2) sample the exact stationary law (chi2 vs the enumerated state
+# space on a small hex graph — the same slow-marked test the full suite
+# runs, so gate and test can never disagree), (3) beat the legacy
+# general kernel >=2x on the CPU hex microbench (32x32 hex lattice,
+# C=256, pop_tol=0.1, base=2.0 — steady-state scan timing, compile
+# excluded; the transition-level harness PROFILE.md round 14 used), and
+# (4) degrade general_dense -> general under an injected compile fault
+# without losing the run.
+#
+#   tools/dense_check.sh
+#
+# Exercised by tests/test_dense.py (slow tier), so the gate rots loudly.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+
+echo "dense-check: [1/4] graftlint"
+"$PY" -m tools.graftlint flipcomplexityempirical_tpu tools
+
+echo "dense-check: [2/4] chi2 exactness smoke (enumerated hex, N=10)"
+JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_dense.py --runslow -q \
+  -k chi2_hex
+
+echo "dense-check: [3/4] CPU microbench (hex 32x32, C=256)"
+JAX_PLATFORMS=cpu "$PY" - <<'PYEOF'
+import time
+
+import jax
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.kernel import dense as kdense
+from flipcomplexityempirical_tpu.kernel import step as kstep
+from flipcomplexityempirical_tpu.lower import dispatch
+
+g = fce.graphs.hex_lattice(32, 32)
+plan = fce.graphs.stripes_plan(g, 2)
+spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                invalid="repropose", accept="cut")
+assert kdense.supported(g, spec), "gate fixture fell off the dense rung"
+assert dispatch.kernel_path_for(g, spec) == "general_dense", \
+    f"dispatch resolves {dispatch.kernel_path_for(g, spec)}"
+dg, states, params = fce.init_batch(g, plan, n_chains=256, seed=0,
+                                    spec=spec, base=2.0, pop_tol=0.1)
+
+
+def steady(trans, states, n=200):
+    """Steady-state ms/step: jit a fixed-length transition scan, one
+    warmup call (compile + reach steady boundary sizes), best of 3."""
+    paxes = kstep.StepParams.vmap_axes()
+
+    @jax.jit
+    def run(s):
+        s, _ = jax.lax.scan(
+            lambda st, _: (jax.vmap(lambda p, x: trans(dg, spec, p, x),
+                                    in_axes=(paxes, 0))(params, st), ()),
+            s, None, length=n)
+        return s
+
+    out = run(states)
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = run(states)
+        jax.tree.map(lambda x: x.block_until_ready(), out)
+        best = min(best, time.perf_counter() - t0)
+    return best / n * 1e3
+
+
+md = steady(kdense.transition, kdense.ensure_conn_bits(dg, spec, states))
+ml = steady(kstep.transition, states)
+print(f"dense-check: general_dense {md:.3f} ms/step, "
+      f"legacy general {ml:.3f} ms/step -> {ml / md:.2f}x")
+assert ml / md >= 2.0, (
+    f"general_dense is only {ml / md:.2f}x the legacy general kernel "
+    f"(gate: >=2.0x at hex 32x32, C=256) — the rejection-free path "
+    f"regressed")
+PYEOF
+
+echo "dense-check: [4/4] compile-fault degradation general_dense -> general"
+JAX_PLATFORMS=cpu "$PY" - <<'PYEOF'
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu.resilience import degrade as rdegrade
+from flipcomplexityempirical_tpu.resilience import faults as rfaults
+
+g = fce.graphs.hex_lattice(6, 6)
+plan = fce.graphs.stripes_plan(g, 2)
+spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                invalid="repropose", accept="cut")
+dg, states, params = fce.init_batch(g, plan, n_chains=8, seed=0,
+                                    spec=spec, base=2.0, pop_tol=0.2)
+mark = rdegrade.snapshot()
+rfaults.install_from_spec("compile:once")
+try:
+    res = fce.run_chains(dg, spec, params, states, n_steps=51, chunk=25,
+                         record_history=True)
+finally:
+    rfaults.install_from_spec(None)
+falls = [(d["from_path"], d["to_path"]) for d in rdegrade.since(mark)]
+assert falls == [("general_dense", "general")], falls
+assert res.n_yields == 51, f"degraded run lost steps: {res.n_yields}/51"
+assert res.history["cut_count"].shape == (8, 51), \
+    res.history["cut_count"].shape
+print("dense-check: compile fault fell through to the legacy kernel, "
+      "run completed (51/51 yields)")
+PYEOF
+
+echo "dense-check: OK"

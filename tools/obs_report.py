@@ -255,11 +255,12 @@ def report_runs(runs, out):
 
 def report_paths(runs, out):
     """Aggregate throughput per kernel path (lowered_bits / lowered /
-    bitboard / board / general / pallas). The dispatch in
-    kernel/board.py is silent —
+    bitboard / board / general_dense / general / pallas). The dispatch
+    in kernel/board.py is silent —
     this table is where a workload that regressed off its fast path
     shows up (e.g. a sec11 run reporting 'general' instead of
-    'lowered')."""
+    'lowered', or a hex run reporting 'general' instead of
+    'general_dense')."""
     by_path: dict = {}
     for r in runs:
         e = r["end"] or synthesize_totals(r)

@@ -34,6 +34,15 @@ run_bench frank 900 --graph frank
 run_bench sec11_c16384 1800 --graph sec11 --chains 16384
 # 6. General-path record refresh (round-2's 0.30x was this path)
 run_bench general 900 --general
+# 6b. General-dense headlines (round 14): hex races the rejection-free
+#     general_dense body against the legacy general kernel (kernel_path
+#     in the record says which won; CPU gate is >=2x at 32x32/C=256,
+#     >=3x is the silicon aspiration), and the dual-fixture matrix rows
+#     price the new path on the real 80-precinct ingestion family —
+#     both BENCH trajectories were empty before this round
+run_bench hex 900 --graph hex --grid 32
+run_bench dual_fixture 900 --workload-matrix \
+  --workloads dual-fixture,dual-fixture-k4,dual-fixture-k8
 # 7. ESS with thinning (record_every ~ IAT)
 run_bench ess_thin 900 --ess --record-every 10
 # 8. Sweep-service tenant efficiency (round 9): 4 coalescible tenants
