@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test obs-check mesh-check chaos-check bitpack-check \
 	service-check preempt-check control-check workload-check \
-	dense-check lint
+	dense-check fleet-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -69,6 +69,14 @@ workload-check:
 # general_dense -> general compile-fault degradation fall-through
 dense-check:
 	PYTHON=$(PYTHON) tools/dense_check.sh
+
+# fleet gate (ISSUE 17): one HTTP front door + two worker processes +
+# eight tenants + a worker.sigkill chaos fault — every job DONE with an
+# artifact, the SIGKILLed worker's lease reclaimed by the survivor,
+# fleet + run journals replay with zero corruption, no double
+# execution, Jain fairness >= 0.8, schema-valid event streams
+fleet-check:
+	PYTHON=$(PYTHON) tools/fleet_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

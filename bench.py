@@ -182,6 +182,17 @@ def main():
                     help="--workload-matrix: comma-separated workload "
                          "names to run (default: a tier-1-sized spread "
                          "across the chain families and variants)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="measure the fleet admission design instead "
+                         "of a kernel: tools/loadtest.py's virtual-"
+                         "clock simulation over the server's own "
+                         "TokenBucket + FairAdmission, reported as a "
+                         "'fleet_fairness_jain' record (+ p50/p99 "
+                         "queue-to-start). The scenario is FROZEN at "
+                         "the ROADMAP target shape (500 tenants x 2 "
+                         "jobs, 16 workers, ~25% utilization) for "
+                         "cross-round comparability; scheduling only, "
+                         "no device work — always cpu-tagged")
     ap.add_argument("--ess-host", action="store_true",
                     help="force the host-copy f64 ESS estimator for the "
                          "--ess recorded pass (streams the history to "
@@ -190,6 +201,21 @@ def main():
                          "(chains, steps) x 4-key f32 history would not "
                          "fit HBM)")
     args = ap.parse_args()
+    if args.fleet:
+        for flag, name in ((args.pallas, "--pallas"),
+                           (args.general, "--general"),
+                           (args.ess, "--ess"),
+                           (args.mesh is not None, "--mesh"),
+                           (args.body is not None, "--body"),
+                           (args.service, "--service"),
+                           (args.adaptive, "--adaptive"),
+                           (args.workload_matrix, "--workload-matrix")):
+            if flag:
+                ap.error(f"{name} is incompatible with --fleet (the "
+                         "fleet benchmark simulates admission "
+                         "scheduling, not device work)")
+        _fleet_bench(args)
+        return
     if args.service:
         for flag, name in ((args.pallas, "--pallas"),
                            (args.general, "--general"),
@@ -819,6 +845,35 @@ def _mesh_bench(args, cpu_fallback, g, plan, spec, rec):
         headline["degraded"] = True
         headline["degradations"] = degradations
     print(json.dumps(headline))
+
+
+def _fleet_bench(args):
+    """--fleet: the fleet admission/fairness record.
+
+    Delegates to tools/loadtest.py's discrete-event simulator — the
+    server's REAL TokenBucket + FairAdmission classes on a virtual
+    clock, K simulated workers of constant service time. The scenario
+    is FROZEN (500 tenants x 2 jobs, 16 workers, spread 4x total
+    service time ≈ 25% utilization, seed 7): like the cpu_fallback
+    kernel configuration, a fleet record is only interpretable against
+    earlier fleet records if the shape never moves. The record's
+    tenants+workers fields make bench_compare qualify it
+    ``[tenants=500,workers=16]`` so it never gates a kernel metric."""
+    from tools.loadtest import build_record, simulate
+
+    tenants, jobs, workers, service_s, seed = 500, 2, 16, 1.0, 7
+    spread = 4.0 * tenants * jobs * service_s / workers
+    sim = simulate(tenants, jobs, workers, service_s, spread,
+                   0.002, seed)
+    record = build_record(
+        sim["waits"], sim["turnarounds"], sim["rejected"], tenants,
+        workers, jobs, "simulate",
+        extra={"service_s": service_s, "spread_s": round(spread, 3),
+               "admit_s": 0.002, "seed": seed,
+               "makespan_s": round(sim["makespan_s"], 3)})
+    print(json.dumps({"mode": "fleet", "frozen": True},
+                     ), file=sys.stderr)
+    print(json.dumps(record))
 
 
 def _service_bench(args):

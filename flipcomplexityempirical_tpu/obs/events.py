@@ -140,6 +140,28 @@ Core field semantics:
   functions of observed history, so a drained/recovered sweep replays
   the identical sequence — ``obs_report --heartbeat`` treats a
   ``kind=stop`` like ``job_done`` when probing namespaced heartbeats.
+- ``http_request``: the front door (service.server) served one HTTP
+  request: ``method``/``path`` name the route, ``status`` the response
+  code; extras carry ``tenant``, ``job_id`` (submissions), and
+  ``dur_s`` (monotonic handler time). obs_report's Fleet section
+  derives request-mix and error-rate views from these.
+- ``quota_rejected``: a tenant's submission was refused by its
+  token-bucket quota (429). ``tenant`` names the bucket; extras carry
+  the route and the bucket's refill rate — admission-control pressure
+  as data.
+- ``lease_acquired``: a worker claimed a job's atomic lease file
+  (service.worker). ``job_id``/``worker`` identify the claim; extras
+  mark ``reclaim=True`` when the claim broke an expired lease.
+- ``lease_expired``: a worker found a lease past its heartbeat TTL (or
+  torn) and broke it before reclaiming the job. ``worker`` is the
+  *previous* holder (the crashed process); extras carry the reclaiming
+  worker and the lease age. ``--strict`` report mode fails when one
+  job accumulates more than two of these (a lease-expiry storm: the
+  TTL is racing the job's own runtime).
+- ``worker_started`` / ``worker_exited``: fleet membership. ``worker``
+  is the stable worker id; ``reason`` on exit is ``idle`` / ``drain``
+  / ``done`` / an error class. A SIGKILLed worker has a start with no
+  exit — obs_report's Fleet section surfaces the asymmetry.
 
 Adding a new event *type* (as ``diag``/``anomaly`` were added) does NOT
 bump SCHEMA_VERSION: readers fold by type and validation rejects only
@@ -294,6 +316,36 @@ EVENT_REGISTRY = {
         "doc": "adaptive control decision at a segment boundary: "
                "stop / retune / reshape_ladder / reallocate; pure in "
                "observed history so recovery replays it bit-identically",
+    },
+    "http_request": {
+        "fields": ("method", "path", "status"),
+        "doc": "front door served one HTTP request; extras carry "
+               "tenant/job_id/dur_s",
+    },
+    "quota_rejected": {
+        "fields": ("tenant",),
+        "doc": "submission refused by the tenant's token-bucket quota "
+               "(HTTP 429)",
+    },
+    "lease_acquired": {
+        "fields": ("job_id", "worker"),
+        "doc": "worker claimed a job's atomic lease file; "
+               "reclaim=True extra when it broke an expired lease",
+    },
+    "lease_expired": {
+        "fields": ("job_id", "worker"),
+        "doc": "lease past its heartbeat TTL (or torn) was broken; "
+               "worker is the previous holder",
+    },
+    "worker_started": {
+        "fields": ("worker",),
+        "doc": "fleet worker process came up and began scanning for "
+               "claimable jobs",
+    },
+    "worker_exited": {
+        "fields": ("worker", "reason"),
+        "doc": "fleet worker stopped: idle / drain / done / error "
+               "class (a SIGKILL leaves no exit event)",
     },
 }
 

@@ -31,6 +31,20 @@ journal.append     service.journal.Journal.append (raise before the
 dispatch.stall     service.lifecycle.DispatchWatchdog.stall_point (a
                    firing rule holds the dispatch past the watchdog
                    timeout, then surfaces as the killed hung call)
+lease.write        service.worker lease-file claim/refresh (raise
+                   before the write — a claim that never lands;
+                   truncate rules tear the freshly-written lease file —
+                   the torn lease another worker must treat as dead,
+                   not block on)
+http.accept        service.server request dispatch, before routing (a
+                   firing rule turns into a 503 — the front door's
+                   failure mode is a refused request, never a torn
+                   state mutation)
+worker.sigkill     service.worker lease-heartbeat beats (a firing rule
+                   SIGKILLs the worker process mid-run — the
+                   crash-interchangeability story: the stale lease
+                   expires and a surviving worker resumes the job from
+                   its sliced checkpoint)
 =================  ====================================================
 
 Plan grammar (CLI ``--faults`` / env ``GRAFT_FAULTS``), comma-separated
@@ -69,7 +83,8 @@ ENV_VAR = "GRAFT_FAULTS"
 
 SITES = ("checkpoint.write", "checkpoint.load", "segment.step",
          "compile", "recorder.emit", "heartbeat.write",
-         "sigterm", "journal.append", "dispatch.stall")
+         "sigterm", "journal.append", "dispatch.stall",
+         "lease.write", "http.accept", "worker.sigkill")
 
 _RAISING_MODES = ("fail", "always", "p")
 

@@ -38,25 +38,52 @@ Preemption-proofing (ISSUE 11) makes the loop durable:
   hung device dispatches as poison-suspect so a restart retries those
   jobs solo.
 
+The fleet layer (PR 17) scales the loop across processes and a
+network boundary:
+
+- ``server`` — the HTTP/JSON front door (stdlib threaded
+  ``http.server``): submit by workload-catalog name or full config
+  doc, per-tenant token-bucket quotas, weighted-fair admission into a
+  shared spool, status/artifact reads, a journaled drain endpoint.
+- ``worker`` — N crash-interchangeable worker processes claiming
+  spooled jobs via atomic lease files with mtime heartbeats; each job
+  runs in its own single-job ``SweepService`` so every journal /
+  checkpoint / recovery guarantee holds per job across processes (a
+  SIGKILLed worker's job is reclaimed and resumed bit-identically).
+- ``client`` — the stdlib tenant client the ``submit`` / ``status``
+  CLI subcommands and the live-mode loadtest drive.
+
 ``python -m flipcomplexityempirical_tpu.service --simulate`` is the
 hardware-free proof: N tenants coalesced on one device vs one tenant
 solo, reported as ``tenant_efficiency`` (also ``bench.py --service``).
+``serve`` / ``worker`` / ``submit`` / ``status`` subcommands run the
+fleet (``make fleet-check`` gates it end to end).
 """
 
 from .cache import CompileCache, enable_persistent_cache
+from .client import ClientError, ServiceClient
 from .journal import Journal
 from .lifecycle import (DispatchWatchdog, DrainController,
                         DrainRequested, EXIT_DRAINED, check_drain,
-                        clear_drain, drain_requested, request_drain)
+                        clear_drain, clear_drain_marker, drain_marked,
+                        drain_requested, mark_drain, request_drain)
 from .queue import Job, JobQueue
 from .scheduler import SweepService, concat_params, concat_states
+from .server import (FairAdmission, FleetServer, FrontDoor, TokenBucket,
+                     serve)
+from .worker import LeaseManager, Worker, fleet_dirs, result_summary
 
 __all__ = [
     "CompileCache", "enable_persistent_cache",
+    "ClientError", "ServiceClient",
     "Journal",
     "DispatchWatchdog", "DrainController", "DrainRequested",
-    "EXIT_DRAINED", "check_drain", "clear_drain", "drain_requested",
-    "request_drain",
+    "EXIT_DRAINED", "check_drain", "clear_drain",
+    "clear_drain_marker", "drain_marked", "drain_requested",
+    "mark_drain", "request_drain",
     "Job", "JobQueue",
     "SweepService", "concat_params", "concat_states",
+    "FairAdmission", "FleetServer", "FrontDoor", "TokenBucket",
+    "serve",
+    "LeaseManager", "Worker", "fleet_dirs", "result_summary",
 ]

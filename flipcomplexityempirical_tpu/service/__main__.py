@@ -2,6 +2,37 @@
          --simulate --out /tmp/svc [--tenants 4] [--chains 2]
          [--compile-cache DIR] [--events PATH]
      or: ... --family frank --out plots/frank-svc [--steps N]
+     or: ... serve ROOT [--port N] / worker ROOT / submit URL /
+         status URL [JOB]
+
+Fleet subcommands (PR 17 — the network front door)::
+
+    serve ROOT    HTTP front door over the shared fleet root: quotas,
+                  weighted-fair admission, the fleet journal. Blocks
+                  until drained (POST /v1/drain or SIGTERM), exits 3.
+    worker ROOT   one fleet worker process: claims spooled jobs via
+                  atomic leases, runs each through its own
+                  SweepService, publishes verdicts + artifacts.
+    submit URL    POST one job (--workload NAME [--set k=v ...] or
+                  --config FILE.json) as --tenant; prints the job doc;
+                  --wait polls to a terminal status.
+    status URL    GET fleet status, or one job's (status URL JOB_ID);
+                  --artifact fetches the result summary instead.
+
+With no subcommand the legacy flat interface below runs unchanged.
+
+Exit codes (extends the 0/2/3 table in ``service.lifecycle``):
+
+=====  ================================================================
+code   meaning
+=====  ================================================================
+0      all jobs done (worker: all it executed; submit --wait: job done)
+2      failures/quarantines present among executed/waited jobs
+3      drained — server always exits 3 (serving only ends by drain);
+       workers exit 3 when the drain marker/signal stopped them
+4      client-side refusal: submit/status got an HTTP error (429 quota,
+       503 draining, 400 bad request, 404 unknown job) or no server
+=====  ================================================================
 
 ``--simulate`` is the hardware-free proof of the sweep service
 (ISSUE 9): N coalescible tenants are submitted against one device and
@@ -130,7 +161,184 @@ def run_simulation(tenants: int = 4, chains: int = 2, steps: int = 400,
     }
 
 
+EXIT_CLIENT_ERROR = 4
+
+
+def _parse_overrides(pairs) -> dict:
+    """``--set k=v`` pairs -> override dict; values parse as JSON when
+    they can (numbers, bools, lists), else stay strings."""
+    out = {}
+    for pair in pairs or ():
+        if "=" not in pair:
+            raise SystemExit(f"--set expects k=v, got {pair!r}")
+        k, v = pair.split("=", 1)
+        try:
+            out[k] = json.loads(v)
+        except ValueError:
+            out[k] = v
+    return out
+
+
+def _parse_weights(spec):
+    """``--weights a=2,b=1`` -> {tenant: weight} or None."""
+    if not spec:
+        return None
+    out = {}
+    for pair in spec.split(","):
+        k, v = pair.split("=", 1)
+        out[k.strip()] = int(v)
+    return out
+
+
+def _fleet_main(argv) -> int:
+    from .client import ClientError, ServiceClient
+    from .server import serve
+    from .worker import Worker
+
+    ap = argparse.ArgumentParser(
+        prog="python -m flipcomplexityempirical_tpu.service")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("serve", help="HTTP front door over ROOT")
+    sp.add_argument("root")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=0,
+                    help="0 binds an OS-assigned port (see --ready-file)")
+    sp.add_argument("--ready-file", default=None,
+                    help="write {host, port, url, pid} JSON once bound "
+                         "(default ROOT/server.json)")
+    sp.add_argument("--events", default=None)
+    sp.add_argument("--quota-rate", type=float, default=None,
+                    metavar="R", help="per-tenant submissions/s "
+                    "(default: unlimited)")
+    sp.add_argument("--quota-burst", type=float, default=10.0)
+    sp.add_argument("--weights", default=None, metavar="T=W,...",
+                    help="admission weights per tenant (default 1)")
+    sp.add_argument("--ttl", type=float, default=15.0,
+                    help="lease TTL used for liveness in status views")
+    sp.add_argument("--faults", default=None)
+
+    wp = sub.add_parser("worker", help="one fleet worker over ROOT")
+    wp.add_argument("root")
+    wp.add_argument("--name", default=None,
+                    help="worker id (default w<pid>)")
+    wp.add_argument("--ttl", type=float, default=15.0)
+    wp.add_argument("--hb", type=float, default=None,
+                    help="heartbeat period (default TTL/3)")
+    wp.add_argument("--poll", type=float, default=0.5)
+    wp.add_argument("--idle-timeout", type=float, default=None,
+                    help="exit after this long with nothing claimable "
+                         "(default: poll forever)")
+    wp.add_argument("--events", default=None)
+    wp.add_argument("--compile-cache", default=None)
+    wp.add_argument("--retries", type=int, default=3)
+    wp.add_argument("--quarantine-after", type=int, default=2)
+    wp.add_argument("--dispatch-timeout", type=float, default=None)
+    wp.add_argument("--cpu", action="store_true")
+    wp.add_argument("--faults", default=None)
+    wp.add_argument("--verbose", action="store_true")
+
+    bp = sub.add_parser("submit", help="submit one job to URL")
+    bp.add_argument("url")
+    bp.add_argument("--workload", default=None,
+                    help="workload-catalog name (GET /v1/workloads)")
+    bp.add_argument("--config", default=None, metavar="FILE",
+                    help="full ExperimentConfig JSON doc")
+    bp.add_argument("--set", dest="overrides", action="append",
+                    metavar="K=V", help="workload override (repeat)")
+    bp.add_argument("--tenant", default="default")
+    bp.add_argument("--wait", action="store_true",
+                    help="poll until the job is terminal")
+    bp.add_argument("--timeout", type=float, default=600.0)
+
+    tp = sub.add_parser("status", help="fleet (or one job's) status")
+    tp.add_argument("url")
+    tp.add_argument("job_id", nargs="?", default=None)
+    tp.add_argument("--artifact", action="store_true",
+                    help="fetch the job's result summary instead")
+    tp.add_argument("--tenant", default="default")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "serve":
+        rfaults.install_from_spec(args.faults) if args.faults \
+            else rfaults.install_from_env()
+        os.makedirs(args.root, exist_ok=True)
+        ready = args.ready_file or os.path.join(args.root,
+                                                "server.json")
+        with from_spec(args.events) as rec:
+            return serve(args.root, host=args.host, port=args.port,
+                         recorder=rec, ready_file=ready,
+                         quota_rate=args.quota_rate,
+                         quota_burst=args.quota_burst,
+                         weights=_parse_weights(args.weights),
+                         ttl_s=args.ttl)
+
+    if args.cmd == "worker":
+        if args.cpu:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        rfaults.install_from_spec(args.faults) if args.faults \
+            else rfaults.install_from_env()
+        policy = RetryPolicy(max_retries=args.retries,
+                             quarantine_after=args.quarantine_after)
+        with from_spec(args.events) as rec:
+            compile_cache = None
+            if args.compile_cache:
+                enable_persistent_cache(args.compile_cache)
+                compile_cache = CompileCache(args.compile_cache,
+                                             recorder=rec)
+            worker = Worker(args.root, worker=args.name,
+                            ttl_s=args.ttl, hb_s=args.hb,
+                            poll_s=args.poll,
+                            idle_timeout_s=args.idle_timeout,
+                            recorder=rec,
+                            compile_cache=compile_cache,
+                            policy=policy,
+                            dispatch_timeout=args.dispatch_timeout,
+                            verbose=args.verbose)
+            with DrainController():
+                return worker.run()
+
+    client = ServiceClient(args.url, tenant=args.tenant)
+    try:
+        if args.cmd == "submit":
+            config = None
+            if args.config:
+                with open(args.config, "r", encoding="utf-8") as f:
+                    config = json.load(f)
+            out = client.submit(workload=args.workload, config=config,
+                                overrides=_parse_overrides(
+                                    args.overrides))
+            if args.wait:
+                out = client.wait(out["job_id"],
+                                  timeout_s=args.timeout)
+            print(json.dumps(out, sort_keys=True))
+            if args.wait and out.get("status") != "done":
+                return 2
+            return 0
+        # status
+        if args.artifact:
+            if not args.job_id:
+                raise SystemExit("status --artifact needs a JOB_ID")
+            out = client.artifact(args.job_id)
+        elif args.job_id:
+            out = client.status(args.job_id)
+        else:
+            out = client.jobs()
+        print(json.dumps(out, sort_keys=True))
+        return 0
+    except (ClientError, ValueError, OSError) as e:
+        print(json.dumps({"error": str(e)}), file=sys.stderr)
+        return EXIT_CLIENT_ERROR
+
+
 def main():
+    # Fleet subcommands dispatch on the first positional token; any
+    # flag-led invocation is the legacy flat interface, untouched.
+    if len(sys.argv) > 1 and sys.argv[1] in ("serve", "worker",
+                                             "submit", "status"):
+        sys.exit(_fleet_main(sys.argv[1:]))
     ap = argparse.ArgumentParser()
     ap.add_argument("--simulate", action="store_true",
                     help="N-tenant coalescing measurement on this host "

@@ -1,0 +1,151 @@
+"""Stdlib client for the fleet front door (``service.server``).
+
+The other side of the HTTP contract: ``ServiceClient`` wraps the
+``/v1`` routes in methods the CLI subcommands (``submit`` / ``status``)
+and the live-mode loadtest drive. Pure stdlib (``urllib``), pure JSON —
+a tenant integration needs nothing from this package beyond this file's
+idea of the routes, which is the point of having a network surface.
+
+Refusals map to ``ClientError`` with the HTTP status attached, so
+callers distinguish a quota rejection (429 — back off and retry) from a
+drain (503 — the fleet is going away) from a bad request (400 — fix the
+submission) without string-matching."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class ClientError(RuntimeError):
+    """An HTTP-level refusal. ``status`` is the response code (429
+    quota, 503 draining/fault, 404 unknown, 400 bad submission);
+    ``body`` is the parsed error doc when the server sent one."""
+
+    def __init__(self, status: int, message: str,
+                 body: Optional[dict] = None):
+        self.status = status
+        self.body = body or {}
+        super().__init__(f"HTTP {status}: {message}")
+
+
+class ServiceClient:
+    """One front door, one tenant identity. ``timeout_s`` bounds each
+    request; ``wait`` polls with the injected sleep so tests drive it
+    on a virtual timeline."""
+
+    def __init__(self, url: str, tenant: str = "default",
+                 timeout_s: float = 10.0, sleep=time.sleep):
+        self.url = url.rstrip("/")
+        self.tenant = tenant
+        self.timeout_s = float(timeout_s)
+        self._sleep = sleep
+
+    # -- transport ----------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> dict:
+        data = (json.dumps(body).encode("utf-8")
+                if body is not None else None)
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method,
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": self.tenant})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                doc = json.loads(e.read().decode("utf-8"))
+            except (ValueError, OSError):
+                doc = {}
+            raise ClientError(e.code, doc.get("error", e.reason),
+                              body=doc) from None
+        except urllib.error.URLError as e:
+            raise ClientError(0, f"unreachable: {e.reason}") from None
+
+    # -- the /v1 surface ----------------------------------------------
+
+    def submit(self, workload: Optional[str] = None,
+               config: Optional[dict] = None,
+               overrides: Optional[dict] = None) -> dict:
+        """POST /v1/jobs: by catalog name or full config doc. Returns
+        ``{job_id, tag, tenant, fingerprint}``."""
+        body: dict = {"tenant": self.tenant}
+        if workload is not None:
+            body["workload"] = workload
+            if overrides:
+                body["overrides"] = overrides
+        elif config is not None:
+            body["config"] = config
+        else:
+            raise ValueError("submit needs a workload name or a "
+                             "config doc")
+        return self._request("POST", "/v1/jobs", body)
+
+    def status(self, job_id: str) -> dict:
+        return self._request("GET", f"/v1/jobs/{job_id}")
+
+    def jobs(self) -> dict:
+        return self._request("GET", "/v1/jobs")
+
+    def artifact(self, job_id: str) -> dict:
+        return self._request("GET", f"/v1/jobs/{job_id}/artifact")
+
+    def workloads(self) -> list:
+        return self._request("GET", "/v1/workloads")["workloads"]
+
+    def healthz(self) -> dict:
+        return self._request("GET", "/v1/healthz")
+
+    def drain(self) -> dict:
+        return self._request("POST", "/v1/drain", {})
+
+    # -- conveniences -------------------------------------------------
+
+    TERMINAL = ("done", "failed", "quarantined")
+
+    def wait(self, job_id: str, timeout_s: float = 300.0,
+             poll_s: float = 0.5) -> dict:
+        """Poll until ``job_id`` is terminal; returns its final status
+        doc. Raises ClientError(0) on timeout — the job itself is NOT
+        cancelled (the fleet owns it; the client only watches)."""
+        waited = 0.0
+        while True:
+            doc = self.status(job_id)
+            if doc.get("status") in self.TERMINAL:
+                return doc
+            if waited >= timeout_s:
+                raise ClientError(
+                    0, f"timeout: {job_id} still "
+                       f"{doc.get('status')!r} after {timeout_s:g}s")
+            self._sleep(poll_s)
+            waited += poll_s
+
+    def wait_all(self, job_ids, timeout_s: float = 300.0,
+                 poll_s: float = 0.5) -> dict:
+        """``{job_id: final status doc}`` for every id, polling the
+        fleet view (one request per poll, not per job)."""
+        pending = set(job_ids)
+        out: dict = {}
+        waited = 0.0
+        while pending:
+            fleet = {j["job_id"]: j for j in self.jobs()["jobs"]}
+            for job_id in list(pending):
+                doc = fleet.get(job_id)
+                if doc and doc.get("status") in self.TERMINAL:
+                    out[job_id] = doc
+                    pending.discard(job_id)
+            if not pending:
+                break
+            if waited >= timeout_s:
+                raise ClientError(
+                    0, f"timeout: {sorted(pending)} not terminal "
+                       f"after {timeout_s:g}s")
+            self._sleep(poll_s)
+            waited += poll_s
+        return out

@@ -25,7 +25,10 @@ journaling) and its truncate rules tear the file after it (a crash
 *during* journaling) — both halves of the torn-tail story are
 chaos-testable on CPU.
 
-Record kinds (the scheduler is the only writer):
+Record kinds (one writer per journal FILE — the integrity contract is
+a contiguous ``seq``, so cross-process appends are forbidden by
+construction: the scheduler owns its outdir's journal, the fleet front
+door owns the shared root's, and each fleet job's run dir has its own):
 
 ==================   ==================================================
 kind                 meaning
@@ -45,6 +48,10 @@ batch_poison_suspect the watchdog marked this batch's dispatch as hung;
                      on recovery its jobs retry SOLO
 service_draining     drain request honored; RUNNING members of any
                      open batch were checkpointed and requeued
+job_admitted         fleet front door only: the admission pump granted
+                     this submission its ``admit_seq`` and spooled it
+                     for the worker fleet (``replay`` ignores it — a
+                     recovering scheduler never sees one)
 ==================   ==================================================
 """
 

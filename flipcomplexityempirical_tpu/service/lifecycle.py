@@ -48,6 +48,8 @@ code   meaning
 
 from __future__ import annotations
 
+import json
+import os
 import signal
 import threading
 import time
@@ -109,6 +111,60 @@ def check_drain(tag: str = "") -> None:
     reason = _DRAIN_REASON
     if reason is not None:
         raise DrainRequested(reason)
+
+
+# -- cross-process drain (the worker fleet) ---------------------------
+#
+# The in-process flag above addresses ONE process; a fleet is N worker
+# processes plus a front-door server sharing a directory. The server's
+# /v1/drain endpoint (and its own SIGTERM handler) writes a DRAIN
+# marker file into the shared root; workers poll it between jobs and
+# exit with EXIT_DRAINED after finishing (and checkpointing) their
+# current lease. The marker is advisory data, not a lock — torn writes
+# are impossible (one atomic rename) and a stale marker just means the
+# next fleet run starts drained, which `clear_drain_marker` fixes.
+
+DRAIN_MARKER = "DRAIN"
+
+
+def drain_marker_path(root: str) -> str:
+    return os.path.join(root, DRAIN_MARKER)
+
+
+def mark_drain(root: str, reason: str, clock=time.time) -> str:
+    """Write the fleet-wide drain marker atomically; returns its path.
+    Idempotent: a second drain request keeps the first reason."""
+    path = drain_marker_path(root)
+    if os.path.exists(path):
+        return path
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"reason": reason, "ts": clock()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def drain_marked(root: str):
+    """The fleet drain reason, or None. Unreadable markers still drain
+    (``"torn-marker"``): a half-written drain request is a drain
+    request."""
+    path = drain_marker_path(root)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f).get("reason", "unknown")
+    except (OSError, ValueError):
+        return "torn-marker"
+
+
+def clear_drain_marker(root: str) -> None:
+    try:
+        os.remove(drain_marker_path(root))
+    except FileNotFoundError:
+        pass
 
 
 class DrainController:

@@ -1,0 +1,610 @@
+"""The network front door: stdlib HTTP/JSON surface over the fleet.
+
+``python -m flipcomplexityempirical_tpu.service serve OUT`` exposes the
+sweep service to tenants who cannot ``import flipcomplexityempirical_tpu``
+— the ROADMAP's "millions of users" axis finally has an entry point.
+Threaded ``http.server``, JSON bodies, no dependencies:
+
+=======  =====================  ==================================
+method   route                  meaning
+=======  =====================  ==================================
+POST     /v1/jobs               submit: a workload-catalog name
+                                (``{"workload": "frank", "overrides":
+                                {...}}``) or a full ExperimentConfig
+                                doc (``{"config": {...}}``) — PR 12
+                                fingerprints are the request schema
+GET      /v1/jobs               fleet status: every job + counts
+GET      /v1/jobs/<id>          one job's status (queue-to-start
+                                included once started)
+GET      /v1/jobs/<id>/artifact result summary JSON (DONE jobs)
+GET      /v1/workloads          catalog names a tenant may submit
+GET      /v1/healthz            liveness + drain flag
+POST     /v1/drain              graceful fleet drain (marker +
+                                in-process flag, journaled)
+=======  =====================  ==================================
+
+**Handler hygiene (the graftlint G009 contract).** Request threads
+never touch ``SweepService`` — execution belongs to the worker fleet
+(``service.worker``), reached only through the spool directory. A
+submit handler does exactly three things: journals the submission
+write-ahead, indexes it, and enqueues it for the admission pump; all
+other handlers are read-only over the shared files. No handler calls
+``time.time()`` (the clock is injected — PR 10's G007 rule) and no
+handler mutates state it does not journal.
+
+**Admission.** Behind the door sit per-tenant token buckets
+(``quota_rate`` tokens/s, ``quota_burst`` cap — a refused take is an
+HTTP 429 + ``quota_rejected`` event) and a weighted deficit
+round-robin (``FairAdmission``): each tenant's accepted submissions
+wait in their own FIFO, and the admission pump thread spools them to
+``jobs/`` in weighted-fair interleaved order, assigning the
+``admit_seq`` workers honor. One tenant's 10k-chain burst therefore
+delays its *own* queue, not its neighbors' — Jain's fairness index
+over queue-to-start is the bench gate (``tools/loadtest.py``).
+
+The server is the ONE writer of the fleet journal (``journal.jsonl``):
+``job_submitted`` (full config doc + tenant — the same record shape
+``SweepService`` journals, so ``journal.replay`` folds it) and
+``job_admitted`` records make a server restart lossless — pending
+submissions re-enter the admission queue, spooled ones don't double.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .. import obs
+from ..resilience import faults as rfaults
+from ..workloads import registry as wreg
+from . import journal as jnl
+from . import lifecycle
+from .worker import (ARTIFACTS_DIR, JOBS_DIR, STARTED_DIR, STATUS_DIR,
+                     LeaseManager, _read_json, _write_json_atomic,
+                     fleet_dirs)
+
+
+class FrontDoorError(RuntimeError):
+    """An HTTP-mappable refusal; ``status`` is the response code."""
+
+    status = 500
+
+    def __init__(self, message: str):
+        self.message = message
+        super().__init__(message)
+
+
+class BadRequest(FrontDoorError):
+    status = 400
+
+
+class NotFound(FrontDoorError):
+    status = 404
+
+
+class QuotaExceeded(FrontDoorError):
+    status = 429
+
+
+class Unavailable(FrontDoorError):
+    status = 503
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``.
+    Thread-safe; the clock is injected (G007) so quota tests replay on
+    a virtual timeline."""
+
+    def __init__(self, rate: float, burst: float, clock=time.time):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst,
+                self._tokens + max(0.0, now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class FairAdmission:
+    """Weighted deficit round-robin over per-tenant FIFOs. ``enqueue``
+    appends to the tenant's queue; ``pop`` serves tenants in first-seen
+    cycle order, up to ``weight`` items per tenant per round — an
+    8-job burst from one tenant interleaves behind every other
+    tenant's head-of-line job instead of monopolizing the spool. Not
+    thread-safe on its own (the FrontDoor serializes access)."""
+
+    def __init__(self, weights: Optional[dict] = None,
+                 default_weight: int = 1):
+        self._weights = dict(weights or {})
+        self._default = int(default_weight)
+        self._queues: dict = {}
+        self._order: list = []
+        self._credits: dict = {}
+        self._cursor = 0
+
+    def weight(self, tenant: str) -> int:
+        return max(1, int(self._weights.get(tenant, self._default)))
+
+    def enqueue(self, tenant: str, item) -> None:
+        if tenant not in self._queues:
+            self._queues[tenant] = collections.deque()
+            self._order.append(tenant)
+            self._credits[tenant] = self.weight(tenant)
+        self._queues[tenant].append(item)
+
+    def __len__(self) -> int:
+        return sum(len(qd) for qd in self._queues.values())
+
+    def pop(self):
+        """``(tenant, item)`` in weighted-fair order, or None when
+        every queue is empty."""
+        if not len(self):
+            return None
+        n = len(self._order)
+        scanned = 0
+        while True:
+            tenant = self._order[self._cursor % n]
+            qd = self._queues[tenant]
+            if qd and self._credits[tenant] > 0:
+                self._credits[tenant] -= 1
+                if self._credits[tenant] == 0:
+                    self._cursor += 1
+                return tenant, qd.popleft()
+            self._cursor += 1
+            scanned += 1
+            if scanned >= n:
+                for t in self._order:
+                    self._credits[t] = self.weight(t)
+                scanned = 0
+
+
+class FrontDoor:
+    """The server's state: journal (sole writer), quota buckets, the
+    admission queue + pump thread, and read-only status snapshots over
+    the shared fleet files. HTTP handlers call NOTHING else."""
+
+    def __init__(self, root: str, recorder=None,
+                 quota_rate: Optional[float] = None,
+                 quota_burst: float = 10.0,
+                 weights: Optional[dict] = None,
+                 ttl_s: float = 15.0,
+                 clock=time.time):
+        self.root = root
+        self.dirs = fleet_dirs(root)
+        self._rec = obs.resolve_recorder(recorder)
+        self._clock = clock
+        self.quota_rate = quota_rate
+        self.quota_burst = quota_burst
+        self._buckets: dict = {}
+        self.journal = jnl.Journal(jnl.journal_path_for(root),
+                                   clock=clock)
+        self._leases = LeaseManager(root, "server", ttl_s=ttl_s,
+                                    clock=clock, recorder=None)
+        self._admission = FairAdmission(weights=weights)
+        self._cond = threading.Condition()
+        self._jobs: dict = {}       # job_id -> submission index entry
+        self._admit_seq = 0
+        self._stop = threading.Event()
+        self._pump_thread: Optional[threading.Thread] = None
+        self._recover()
+
+    # -- restart recovery ---------------------------------------------
+
+    def _recover(self) -> None:
+        """Rebuild the submission index from the journal: admitted jobs
+        are already spooled (workers own them from here); pending ones
+        re-enter the admission queue. Lossless across server crashes —
+        the WAL is written before any in-memory mutation."""
+        admitted = set()
+        for record in self.journal.recovered_records:
+            kind = record.get("kind")
+            if kind == "job_submitted":
+                self._jobs[record["job_id"]] = {
+                    "job_id": record["job_id"],
+                    "tag": record.get("tag"),
+                    "tenant": record.get("tenant", "default"),
+                    "submitted_ts": record.get("ts"),
+                    "config": record.get("config"),
+                }
+            elif kind == "job_admitted":
+                admitted.add(record["job_id"])
+                self._admit_seq = max(self._admit_seq,
+                                      record.get("admit_seq", 0) + 1)
+        for job_id, info in self._jobs.items():
+            if job_id not in admitted:
+                self._admission.enqueue(info["tenant"], job_id)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        if self._pump_thread is None:
+            self._pump_thread = threading.Thread(
+                target=self._pump, name="admission-pump", daemon=True)
+            self._pump_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=5.0)
+
+    @property
+    def draining(self) -> bool:
+        return (lifecycle.drain_requested() is not None
+                or lifecycle.drain_marked(self.root) is not None)
+
+    def drain(self, reason: str) -> dict:
+        """The /v1/drain action: journal first (write-ahead), then the
+        in-process flag and the fleet-wide marker the workers poll."""
+        self.journal.append("service_draining", reason=reason)
+        self._rec.emit("service_draining", reason=reason)
+        lifecycle.request_drain(reason)
+        lifecycle.mark_drain(self.root, reason, clock=self._clock)
+        return {"draining": reason}
+
+    # -- submission ---------------------------------------------------
+
+    def _resolve_config(self, body: dict):
+        if "config" in body:
+            try:
+                return jnl.config_from_doc(dict(body["config"]))
+            except (TypeError, ValueError) as e:
+                raise BadRequest(f"bad config doc: {e}")
+        if "workload" in body:
+            name = body["workload"]
+            try:
+                spec = wreg.get(name)
+            except KeyError:
+                raise BadRequest(
+                    f"unknown workload {name!r} "
+                    f"(GET /v1/workloads lists the catalog)")
+            overrides = body.get("overrides") or {}
+            if not isinstance(overrides, dict):
+                raise BadRequest("overrides must be an object")
+            try:
+                return spec.to_config(**overrides)
+            except (TypeError, ValueError) as e:
+                raise BadRequest(f"bad overrides: {e}")
+        raise BadRequest("body needs 'workload' or 'config'")
+
+    def _bucket(self, tenant: str) -> Optional[TokenBucket]:
+        if self.quota_rate is None:
+            return None
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            bucket = TokenBucket(self.quota_rate, self.quota_burst,
+                                 clock=self._clock)
+            self._buckets[tenant] = bucket
+        return bucket
+
+    def submit(self, body: dict, tenant: str) -> dict:
+        """Accept one submission: quota check, write-ahead journal,
+        index, enqueue for the pump. Raises FrontDoorError refusals."""
+        if self.draining:
+            raise Unavailable("service is draining")
+        config = self._resolve_config(body)
+        bucket = self._bucket(tenant)
+        if bucket is not None and not bucket.take():
+            self._rec.emit("quota_rejected", tenant=tenant,
+                           path="/v1/jobs", rate=self.quota_rate)
+            raise QuotaExceeded(
+                f"tenant {tenant!r} exceeded {self.quota_rate:g} "
+                "submissions/s")
+        with self._cond:
+            job_id = f"j{len(self._jobs):04d}"
+            doc = jnl.config_to_doc(config)
+            # WAL before any mutation the record describes
+            self.journal.append("job_submitted", job_id=job_id,
+                                tag=config.tag, tenant=tenant,
+                                config=doc)
+            self._jobs[job_id] = {
+                "job_id": job_id, "tag": config.tag, "tenant": tenant,
+                "submitted_ts": self._clock(), "config": doc,
+            }
+            self._admission.enqueue(tenant, job_id)
+            self._cond.notify()
+        self._rec.emit("job_submitted", job_id=job_id, tag=config.tag,
+                       tenant=tenant, fingerprint=config.fingerprint())
+        return {"job_id": job_id, "tag": config.tag,
+                "tenant": tenant,
+                "fingerprint": config.fingerprint()}
+
+    # -- the admission pump -------------------------------------------
+
+    def _pump(self) -> None:
+        """Drain the fair-admission queue into the spool: journal
+        ``job_admitted`` (write-ahead), then write the job doc workers
+        claim. Runs until stop(); keeps spooling while draining so
+        accepted work is never stranded in memory."""
+        while not self._stop.is_set():
+            with self._cond:
+                item = self._admission.pop()
+                if item is None:
+                    self._cond.wait(timeout=0.2)
+                    continue
+                tenant, job_id = item
+                admit_seq = self._admit_seq
+                self._admit_seq += 1
+            info = self._jobs[job_id]
+            self.journal.append("job_admitted", job_id=job_id,
+                                tenant=tenant, admit_seq=admit_seq)
+            _write_json_atomic(
+                os.path.join(self.dirs[JOBS_DIR], f"{job_id}.json"),
+                {"job_id": job_id, "tenant": tenant,
+                 "tag": info["tag"], "admit_seq": admit_seq,
+                 "submitted_ts": info["submitted_ts"],
+                 "admitted_ts": self._clock(),
+                 "config": info["config"]})
+
+    def pump_idle(self) -> bool:
+        with self._cond:
+            return len(self._admission) == 0
+
+    # -- read-only views ----------------------------------------------
+
+    def job_status(self, job_id: str) -> dict:
+        info = self._jobs.get(job_id)
+        if info is None:
+            raise NotFound(f"unknown job {job_id!r}")
+        out = {"job_id": job_id, "tag": info["tag"],
+               "tenant": info["tenant"],
+               "submitted_ts": info["submitted_ts"]}
+        verdict = _read_json(os.path.join(self.dirs[STATUS_DIR],
+                                          f"{job_id}.json"))
+        started = _read_json(os.path.join(self.dirs[STARTED_DIR],
+                                          f"{job_id}.json"))
+        if started and started.get("started_ts") is not None \
+                and info["submitted_ts"] is not None:
+            out["started_ts"] = started["started_ts"]
+            out["worker"] = started.get("worker")
+            out["queue_to_start_s"] = round(
+                started["started_ts"] - info["submitted_ts"], 6)
+        if verdict is not None:
+            out.update({k: verdict[k] for k in
+                        ("status", "attempts", "error", "worker",
+                         "finished_ts") if k in verdict})
+        elif started is not None and self._leases.live(job_id):
+            out["status"] = "running"
+        elif os.path.exists(os.path.join(self.dirs[JOBS_DIR],
+                                         f"{job_id}.json")):
+            out["status"] = "queued"
+        else:
+            out["status"] = "pending"
+        return out
+
+    def jobs_status(self) -> dict:
+        jobs = [self.job_status(job_id) for job_id in self._jobs]
+        counts: dict = {}
+        for j in jobs:
+            counts[j["status"]] = counts.get(j["status"], 0) + 1
+        return {"jobs": jobs, "counts": counts,
+                "draining": self.draining}
+
+    def artifact(self, job_id: str) -> dict:
+        if job_id not in self._jobs:
+            raise NotFound(f"unknown job {job_id!r}")
+        doc = _read_json(os.path.join(self.dirs[ARTIFACTS_DIR],
+                                      f"{job_id}.json"))
+        if doc is None:
+            status = self.job_status(job_id).get("status")
+            raise NotFound(f"no artifact for {job_id} yet "
+                           f"(status: {status})")
+        return doc
+
+    def workloads(self) -> dict:
+        return {"workloads": wreg.names()}
+
+    def healthz(self) -> dict:
+        return {"ok": True, "draining": self.draining,
+                "n_jobs": len(self._jobs)}
+
+    def observe_request(self, method: str, path: str, status: int,
+                        tenant: Optional[str], dur_s: float,
+                        job_id: Optional[str] = None) -> None:
+        self._rec.emit("http_request", method=method, path=path,
+                       status=status, tenant=tenant,
+                       dur_s=round(dur_s, 6), job_id=job_id)
+
+
+class FleetHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    front: FrontDoor
+
+
+class FrontDoorHandler(BaseHTTPRequestHandler):
+    """Thin routing layer: parse, delegate to the FrontDoor, serialize.
+    Holds NO state and mutates none — see the module docstring for the
+    G009 hygiene contract this class is linted against."""
+
+    server_version = "graft-fleet/1"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------
+
+    def log_message(self, fmt, *args):   # stdlib default spams stderr
+        pass
+
+    def _tenant(self, body: Optional[dict] = None) -> str:
+        if body and isinstance(body.get("tenant"), str):
+            return body["tenant"]
+        return self.headers.get("X-Tenant", "default")
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length == 0:
+            return {}
+        raw = self.rfile.read(length)
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            raise BadRequest("body is not JSON")
+        if not isinstance(doc, dict):
+            raise BadRequest("body must be a JSON object")
+        return doc
+
+    def _reply(self, status: int, doc: dict) -> None:
+        payload = json.dumps(doc).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    # -- routes -------------------------------------------------------
+
+    def _route(self, method: str) -> None:
+        t0 = time.monotonic()
+        tenant = None
+        job_id = None
+        try:
+            rfaults.fault_point("http.accept", path=self.path)
+            front = self.server.front
+            parts = [p for p in self.path.split("?")[0].split("/")
+                     if p]
+            if method == "POST" and parts == ["v1", "jobs"]:
+                body = self._body()
+                tenant = self._tenant(body)
+                out = front.submit(body, tenant)
+                job_id = out["job_id"]
+                status = 200
+            elif method == "POST" and parts == ["v1", "drain"]:
+                out = front.drain("http")
+                status = 200
+            elif method == "GET" and parts == ["v1", "jobs"]:
+                out = front.jobs_status()
+                status = 200
+            elif (method == "GET" and len(parts) == 3
+                  and parts[:2] == ["v1", "jobs"]):
+                job_id = parts[2]
+                out = front.job_status(job_id)
+                status = 200
+            elif (method == "GET" and len(parts) == 4
+                  and parts[:2] == ["v1", "jobs"]
+                  and parts[3] == "artifact"):
+                job_id = parts[2]
+                out = front.artifact(job_id)
+                status = 200
+            elif method == "GET" and parts == ["v1", "workloads"]:
+                out = front.workloads()
+                status = 200
+            elif method == "GET" and parts == ["v1", "healthz"]:
+                out = front.healthz()
+                status = 200
+            else:
+                raise NotFound(f"no route {method} {self.path}")
+        except FrontDoorError as e:
+            status, out = e.status, {"error": e.message}
+        except rfaults.InjectedFault as e:
+            status, out = 503, {"error": str(e)}
+        try:
+            self._reply(status, out)
+        finally:
+            self.server.front.observe_request(
+                method, self.path, status, tenant,
+                time.monotonic() - t0, job_id=job_id)
+
+    def do_GET(self) -> None:
+        self._route("GET")
+
+    def do_POST(self) -> None:
+        self._route("POST")
+
+
+class FleetServer:
+    """The served front door: FrontDoor + ThreadingHTTPServer on a
+    background thread. ``with FleetServer(root) as srv:`` yields a
+    bound server; ``srv.port`` is the OS-assigned port when 0 was
+    requested (tests and the gate script read it from the ready
+    file)."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1",
+                 port: int = 0, ready_file: Optional[str] = None,
+                 **front_kwargs):
+        self.root = root
+        self.host = host
+        self._port = port
+        self.ready_file = ready_file
+        self.front = FrontDoor(root, **front_kwargs)
+        self._httpd: Optional[FleetHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        return self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "FleetServer":
+        self._httpd = FleetHTTPServer((self.host, self._port),
+                                      FrontDoorHandler)
+        self._httpd.front = self.front
+        self.front.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="front-door", daemon=True)
+        self._thread.start()
+        if self.ready_file:
+            _write_json_atomic(self.ready_file,
+                               {"host": self.host, "port": self.port,
+                                "url": self.url, "pid": os.getpid()})
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.front.stop()
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def serve(root: str, host: str = "127.0.0.1", port: int = 0,
+          recorder=None, ready_file: Optional[str] = None,
+          poll_s: float = 0.2, **front_kwargs) -> int:
+    """Blocking CLI entry: serve until a drain arrives (HTTP endpoint,
+    SIGTERM/SIGINT, or a pre-existing marker), keep serving status
+    reads until the admission queue is spooled, then stop. Returns the
+    process exit code (EXIT_DRAINED — serving only ends by drain)."""
+    with lifecycle.DrainController():
+        with FleetServer(root, host=host, port=port,
+                         ready_file=ready_file,
+                         recorder=recorder, **front_kwargs) as srv:
+            while not srv.front.draining:
+                time.sleep(poll_s)
+            reason = (lifecycle.drain_requested()
+                      or lifecycle.drain_marked(root) or "drain")
+            # a signal-delivered drain never hit the endpoint: journal
+            # + marker it so workers drain too
+            if lifecycle.drain_marked(root) is None:
+                srv.front.drain(str(reason))
+            while not srv.front.pump_idle():
+                time.sleep(poll_s)
+    return lifecycle.EXIT_DRAINED

@@ -1,0 +1,533 @@
+"""Worker fleet: crash-interchangeable executors over a shared spool.
+
+The front door (``service.server``) turns HTTP submissions into job
+docs spooled under one shared fleet root; this module is the other half
+of the contract — N worker *processes* that claim those jobs, run each
+through its own single-job ``SweepService`` (so every PR 10/11
+guarantee — write-ahead journal, checkpointed segments, supervisor
+taxonomy, bit-identical recovery — applies per job, now across
+processes), and publish terminal verdicts + artifact summaries back
+into the shared root.
+
+Fleet root layout (everything the fleet shares is a file)::
+
+    root/
+      journal.jsonl        server's WAL (job_submitted/job_admitted)
+      jobs/<id>.json       admitted job docs: config + tenant + admit_seq
+      leases/<id>.lease    atomic claim files (this module)
+      started/<id>.json    first-claim marker (queue-to-start anchor)
+      status/<id>.json     terminal verdict (done/failed/quarantined)
+      artifacts/<id>.json  result summary + array sha256 (DONE jobs)
+      run/<id>/            the job's own SweepService outdir + journal
+      ckpt/                shared sliced checkpoints (resume points)
+      DRAIN                fleet-wide drain marker (lifecycle)
+
+**The lease protocol.** A job may be executed by at most one worker at
+a time, with no coordinator: claims are ``O_CREAT|O_EXCL`` creates of
+``leases/<id>.lease`` (atomic on POSIX — exactly one concurrent
+claimer wins), liveness is the lease file's mtime refreshed by a
+heartbeat thread every ``hb_s`` seconds, and expiry is
+``now - mtime > ttl_s``. Reclaiming an expired (or torn — an
+unparseable payload does NOT block the job) lease renames it to a
+tombstone first: ``os.replace`` is atomic, so of two workers racing a
+stale lease exactly one wins the rename and the loser's subsequent
+claim sees the winner's fresh lease. mtime (not a payload timestamp)
+carries liveness so tests age leases deterministically with
+``os.utime`` and a torn payload cannot forge freshness.
+
+Why per-job run dirs instead of N appenders on one journal: the
+journal's integrity contract is a contiguous ``seq`` per file —
+cross-process interleaved appends would tear it by construction. One
+writer per file is the discipline everywhere: the server owns
+``journal.jsonl``, and whichever worker holds a job's lease owns
+``run/<id>/journal.jsonl`` (a reclaim re-opens it through
+``SweepService.recover``, continuing the same file's story).
+
+The ``worker.sigkill`` fault site is consulted on every heartbeat
+beat: an armed rule SIGKILLs this process mid-run — the chaos stand-in
+for a preempted node. The job's lease goes stale, a surviving worker
+breaks it (``lease_expired``), and ``recover`` resumes from the sliced
+checkpoint bit-identically — `make fleet-check` gates the whole story.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from ..resilience import faults as rfaults
+from ..resilience.supervisor import RetryPolicy
+from . import journal as jnl
+from . import lifecycle
+from . import queue as q
+from .scheduler import SweepService
+
+JOBS_DIR = "jobs"
+LEASES_DIR = "leases"
+STARTED_DIR = "started"
+STATUS_DIR = "status"
+ARTIFACTS_DIR = "artifacts"
+RUN_DIR = "run"
+CKPT_DIR = "ckpt"
+
+
+def fleet_dirs(root: str) -> dict:
+    """Ensure and return the shared fleet subdirectories."""
+    dirs = {name: os.path.join(root, name)
+            for name in (JOBS_DIR, LEASES_DIR, STARTED_DIR, STATUS_DIR,
+                         ARTIFACTS_DIR, RUN_DIR, CKPT_DIR)}
+    for d in dirs.values():
+        os.makedirs(d, exist_ok=True)
+    return dirs
+
+
+def _write_json_atomic(path: str, doc: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    """Parsed JSON doc, or None when missing/torn (callers treat torn
+    exactly like missing — a half-written doc must never wedge the
+    fleet)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def result_summary(job: q.Job, worker: str,
+                   job_id: Optional[str] = None) -> dict:
+    """Compact JSON artifact for one DONE job: every scalar field of the
+    run data plus a single SHA-256 over all array leaves (sorted key
+    order, shape/dtype folded in). The digest is the fleet's
+    bit-identity witness: a job resumed by a different worker after a
+    SIGKILL must produce the same digest a solo uninterrupted run does
+    (timing fields are scalars, so they never enter it).
+
+    ``job_id`` is the FLEET job id; ``job.job_id`` is the per-job
+    SweepService's internal numbering (always j0000 in a one-job
+    service) and must never name shared-root files."""
+    data = job.result or {}
+    h = hashlib.sha256()
+    arrays: dict = {}
+
+    def fold(prefix: str, val):
+        if isinstance(val, dict):
+            for k in sorted(val):
+                fold(f"{prefix}/{k}", val[k])
+        elif hasattr(val, "shape") and hasattr(val, "dtype"):
+            arr = np.ascontiguousarray(np.asarray(val))
+            h.update(prefix.encode("utf-8"))
+            h.update(str(arr.dtype).encode("utf-8"))
+            h.update(repr(arr.shape).encode("utf-8"))
+            h.update(arr.tobytes())
+            arrays[prefix] = {"shape": list(arr.shape),
+                              "dtype": str(arr.dtype)}
+
+    for key in sorted(data):
+        fold(key, data[key])
+    scalars = {k: v for k, v in data.items()
+               if v is None or isinstance(v, (str, int, float, bool))}
+    return {
+        "job_id": job_id or job.job_id,
+        "tag": job.tag,
+        "status": job.status,
+        "attempts": job.attempts,
+        "worker": worker,
+        "result_sha256": h.hexdigest() if arrays else None,
+        "arrays": arrays,
+        "summary": scalars,
+    }
+
+
+class Lease:
+    """Handle for one held lease; returned by ``LeaseManager.claim``."""
+
+    def __init__(self, manager: "LeaseManager", job_id: str):
+        self._mgr = manager
+        self.job_id = job_id
+        self.released = False
+
+    @property
+    def path(self) -> str:
+        return self._mgr.path(self.job_id)
+
+    def refresh(self) -> None:
+        self._mgr.refresh(self.job_id)
+
+    def release(self) -> None:
+        if not self.released:
+            self.released = True
+            self._mgr.release(self.job_id)
+
+
+class LeaseManager:
+    """Atomic lease files with mtime-heartbeat liveness (module doc has
+    the full protocol). One instance per worker process."""
+
+    def __init__(self, root: str, worker: str, ttl_s: float = 15.0,
+                 clock=time.time, recorder=None):
+        self.root = root
+        self.dir = os.path.join(root, LEASES_DIR)
+        os.makedirs(self.dir, exist_ok=True)
+        self.worker = worker
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self._rec = obs.resolve_recorder(recorder)
+        self._tomb_seq = 0
+
+    def path(self, job_id: str) -> str:
+        return os.path.join(self.dir, f"{job_id}.lease")
+
+    def holder(self, job_id: str) -> Optional[dict]:
+        """The lease payload ({worker, pid, ts}), or None when the
+        lease is missing or torn."""
+        return _read_json(self.path(job_id))
+
+    def age_s(self, job_id: str) -> Optional[float]:
+        """Seconds since the lease's last heartbeat (mtime), or None
+        when no lease exists. Compares the injected clock against
+        mtime, so tests age leases with ``os.utime``."""
+        try:
+            mtime = os.path.getmtime(self.path(job_id))
+        except OSError:
+            return None
+        return self._clock() - mtime
+
+    def live(self, job_id: str) -> bool:
+        age = self.age_s(job_id)
+        return age is not None and age <= self.ttl_s
+
+    def _payload(self) -> dict:
+        return {"worker": self.worker, "pid": os.getpid(),
+                "ts": self._clock()}
+
+    def _create(self, path: str) -> bool:
+        """One O_EXCL create attempt; False when somebody else holds
+        the name. The ``lease.write`` fault site raises *before* the
+        create (a claim that never lands) and its truncate rules tear
+        the payload *after* (the torn lease a peer must not block on)."""
+        rfaults.fault_point("lease.write", path=path,
+                            worker=self.worker)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(self._payload(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        rfaults.corrupt_file("lease.write", path)
+        return True
+
+    def claim(self, job_id: str) -> Optional[Lease]:
+        """Try to acquire ``job_id``'s lease. Returns a Lease, or None
+        when a live peer holds it (or we lost a reclaim race —
+        indistinguishable, and equally retriable next scan)."""
+        path = self.path(job_id)
+        reclaim = False
+        if not self._create(path):
+            if self.live(job_id):
+                return None
+            # Stale or torn: break it via an atomic rename — exactly
+            # one of N racing reclaimers wins the replace.
+            prev = self.holder(job_id) or {}
+            age = self.age_s(job_id)
+            if age is not None:
+                tomb = (f"{path}.expired."
+                        f"{self.worker}.{self._tomb_seq}")
+                self._tomb_seq += 1
+                try:
+                    os.replace(path, tomb)
+                except FileNotFoundError:
+                    return None       # a peer broke it first
+                self._rec.emit("lease_expired", job_id=job_id,
+                               worker=prev.get("worker", "unknown"),
+                               by=self.worker,
+                               age_s=round(age, 3))
+                reclaim = True
+            # else: released between checks — plain fresh claim below
+            if not self._create(path):
+                return None           # a third claimer slipped in
+        self._rec.emit("lease_acquired", job_id=job_id,
+                       worker=self.worker, reclaim=reclaim)
+        return Lease(self, job_id)
+
+    def refresh(self, job_id: str) -> None:
+        """Heartbeat: rewrite the payload atomically, advancing mtime.
+        Raises on an armed ``lease.write`` fault — the caller skips the
+        beat and the lease ages toward expiry (the chaos story)."""
+        path = self.path(job_id)
+        rfaults.fault_point("lease.write", path=path,
+                            worker=self.worker)
+        tmp = f"{path}.hb.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._payload(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        rfaults.corrupt_file("lease.write", path)
+
+    def release(self, job_id: str) -> None:
+        try:
+            os.remove(self.path(job_id))
+        except FileNotFoundError:
+            pass        # expired + reclaimed out from under us
+
+
+class _LeaseHeartbeat(threading.Thread):
+    """Daemon thread refreshing one held lease every ``hb_s`` seconds.
+    Each beat consults the ``worker.sigkill`` fault site first: an
+    armed rule hard-kills the process (uncatchable, mid-dispatch) —
+    the closest CPU-testable analogue of node preemption. A failed
+    refresh (armed ``lease.write``, full disk) skips the beat; the
+    lease simply ages."""
+
+    def __init__(self, lease: Lease, hb_s: float):
+        super().__init__(name=f"lease-hb-{lease.job_id}", daemon=True)
+        self._lease = lease
+        self._hb_s = hb_s
+        # NB: not `_stop` — that name is Thread internals.
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.wait(self._hb_s):
+            try:
+                rfaults.fault_point("worker.sigkill",
+                                    job_id=self._lease.job_id)
+            except rfaults.InjectedFault:
+                os.kill(os.getpid(), signal.SIGKILL)
+            try:
+                self._lease.refresh()
+            except (OSError, rfaults.InjectedFault):
+                continue
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=5.0)
+
+
+class Worker:
+    """One fleet worker: scan the spool in admission order, claim, run,
+    publish. ``run()`` loops until drained/idle; ``run_once()`` is one
+    scan pass (tests drive it directly)."""
+
+    def __init__(self, root: str, worker: Optional[str] = None,
+                 ttl_s: float = 15.0, hb_s: Optional[float] = None,
+                 poll_s: float = 0.5,
+                 idle_timeout_s: Optional[float] = None,
+                 recorder=None, compile_cache=None,
+                 policy: Optional[RetryPolicy] = None,
+                 dispatch_timeout: Optional[float] = None,
+                 clock=time.time, verbose: bool = False):
+        self.root = root
+        self.dirs = fleet_dirs(root)
+        self.worker = worker or f"w{os.getpid()}"
+        self._rec = obs.resolve_recorder(recorder)
+        self._clock = clock
+        self.ttl_s = float(ttl_s)
+        # Three beats per TTL: one lost beat (fault, disk hiccup) never
+        # expires a healthy worker's lease.
+        self.hb_s = float(hb_s) if hb_s is not None else self.ttl_s / 3.0
+        self.poll_s = float(poll_s)
+        self.idle_timeout_s = idle_timeout_s
+        self.compile_cache = compile_cache
+        self.policy = policy
+        self.dispatch_timeout = dispatch_timeout
+        self.verbose = verbose
+        self.leases = LeaseManager(root, self.worker, ttl_s=ttl_s,
+                                   clock=clock, recorder=recorder)
+        self.executed: list = []      # (job_id, status) this process ran
+        self.failures = 0             # failed/quarantined among those
+
+    # -- spool views --------------------------------------------------
+
+    def spooled(self) -> list:
+        """Admitted job docs in admission order (torn docs skipped —
+        the server's spool write is atomic, so torn means mid-replace
+        on a non-POSIX filesystem; the next scan sees it whole)."""
+        docs = []
+        try:
+            names = os.listdir(self.dirs[JOBS_DIR])
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            doc = _read_json(os.path.join(self.dirs[JOBS_DIR], name))
+            if doc is not None and "job_id" in doc:
+                docs.append(doc)
+        docs.sort(key=lambda d: (d.get("admit_seq", 0), d["job_id"]))
+        return docs
+
+    def status_path(self, job_id: str) -> str:
+        return os.path.join(self.dirs[STATUS_DIR], f"{job_id}.json")
+
+    def terminal(self, job_id: str) -> Optional[dict]:
+        return _read_json(self.status_path(job_id))
+
+    def all_terminal(self) -> bool:
+        return all(self.terminal(d["job_id"]) is not None
+                   for d in self.spooled())
+
+    # -- execution ----------------------------------------------------
+
+    def _mark_started(self, job_id: str) -> None:
+        """First-claim marker (O_EXCL — first worker wins, reclaims
+        keep the original anchor): queue-to-start is measured from the
+        job's FIRST execution start, not a post-crash resume."""
+        path = os.path.join(self.dirs[STARTED_DIR], f"{job_id}.json")
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump({"job_id": job_id, "worker": self.worker,
+                       "started_ts": self._clock()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _publish(self, job: q.Job, doc: dict) -> None:
+        # doc["job_id"] is the FLEET id; job.job_id is the per-job
+        # service's internal numbering (j0000 for every one-job
+        # service) and must not key anything in the shared root.
+        job_id = doc["job_id"]
+        if job.status == q.DONE:
+            art = result_summary(job, self.worker, job_id=job_id)
+            if job.result is None:
+                # recovered-DONE edge: the journal says done but the
+                # arrays died with the previous worker; the verdict
+                # stands, the digest is honestly absent
+                art["recovered"] = True
+            _write_json_atomic(
+                os.path.join(self.dirs[ARTIFACTS_DIR],
+                             f"{job_id}.json"), art)
+        started = _read_json(os.path.join(self.dirs[STARTED_DIR],
+                                          f"{job_id}.json")) or {}
+        _write_json_atomic(self.status_path(job_id), {
+            "job_id": job_id,
+            "tag": job.tag,
+            "tenant": doc.get("tenant"),
+            "status": job.status,
+            "attempts": job.attempts,
+            "error": job.error,
+            "worker": self.worker,
+            "submitted_ts": doc.get("submitted_ts"),
+            "started_ts": started.get("started_ts"),
+            "finished_ts": self._clock(),
+        })
+
+    def _execute(self, lease: Lease, doc: dict) -> bool:
+        """Run one claimed job to a terminal state (or to a drain
+        boundary). Returns True when a terminal verdict was published."""
+        job_id = doc["job_id"]
+        self._mark_started(job_id)
+        hb = _LeaseHeartbeat(lease, self.hb_s)
+        hb.start()
+        rundir = os.path.join(self.dirs[RUN_DIR], job_id)
+        # per-job checkpoint subdir: the ckpt tree is shared (any
+        # worker can resume any job) but jobs with equal tags must not
+        # clobber each other's resume points
+        ckpt_dir = os.path.join(self.dirs[CKPT_DIR], job_id)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        kwargs = dict(checkpoint_dir=ckpt_dir,
+                      recorder=self._rec,
+                      compile_cache=self.compile_cache,
+                      policy=self.policy,
+                      dispatch_timeout=self.dispatch_timeout,
+                      clock=self._clock, verbose=self.verbose)
+        try:
+            if os.path.exists(jnl.journal_path_for(rundir)):
+                svc = SweepService.recover(rundir, **kwargs)
+            else:
+                svc = SweepService(rundir, **kwargs)
+                svc.submit(jnl.config_from_doc(doc["config"]))
+            svc.run_until_idle()
+            if svc.drained:
+                # requeued + checkpointed in the run journal; the
+                # released lease lets any worker resume after restart
+                return False
+            job = svc.queue.jobs()[0]
+            self._publish(job, doc)
+            self.executed.append((job_id, job.status))
+            if job.status != q.DONE:
+                self.failures += 1
+            if self.verbose:
+                print(f"[{self.worker}] {job_id} {job.tag} "
+                      f"-> {job.status}")
+            return True
+        finally:
+            hb.stop()
+
+    def run_once(self) -> int:
+        """One spool scan: claim and run every claimable job. Returns
+        the number of terminal verdicts published."""
+        n = 0
+        for doc in self.spooled():
+            if (lifecycle.drain_requested() is not None
+                    or lifecycle.drain_marked(self.root) is not None):
+                break
+            job_id = doc["job_id"]
+            if self.terminal(job_id) is not None:
+                continue
+            lease = self.leases.claim(job_id)
+            if lease is None:
+                continue
+            try:
+                if self.terminal(job_id) is not None:
+                    continue    # published between scan and claim
+                if self._execute(lease, doc):
+                    n += 1
+            finally:
+                lease.release()
+        return n
+
+    def run(self) -> int:
+        """The worker loop: scan until drained (marker or signal) or
+        idle past ``idle_timeout_s``. Returns the CLI exit code
+        (0 / 2 failures / 3 drained)."""
+        self._rec.emit("worker_started", worker=self.worker,
+                       pid=os.getpid(), root=self.root)
+        idle_t0 = time.monotonic()
+        reason = "idle"
+        while True:
+            if lifecycle.drain_requested() is not None:
+                reason = "drain"
+                break
+            marker = lifecycle.drain_marked(self.root)
+            if marker is not None:
+                reason = "drain"
+                break
+            did = self.run_once()
+            if lifecycle.drain_requested() is not None:
+                reason = "drain"
+                break
+            if did:
+                idle_t0 = time.monotonic()
+                continue
+            if (self.idle_timeout_s is not None
+                    and time.monotonic() - idle_t0
+                    >= self.idle_timeout_s):
+                reason = "done" if self.all_terminal() else "idle"
+                break
+            time.sleep(self.poll_s)
+        self._rec.emit("worker_exited", worker=self.worker,
+                       reason=reason, n_executed=len(self.executed),
+                       n_failures=self.failures)
+        if reason == "drain":
+            return lifecycle.EXIT_DRAINED
+        return 2 if self.failures else 0

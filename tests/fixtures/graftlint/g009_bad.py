@@ -1,0 +1,24 @@
+"""G009 positive fixture: every HTTP-handler hygiene hazard."""
+
+import time
+
+
+class SweepService:
+    def run_until_idle(self):
+        pass
+
+
+class BadHandler:  # structurally a handler: defines do_* methods
+    def do_POST(self):
+        # blocking sweep execution on the request thread
+        svc = SweepService()
+        svc.run_until_idle()
+        # unjournaled shared-state mutation (no journal call anywhere
+        # in this method)
+        self.server.jobs.append("j0000")
+        self.server.n_jobs = 1
+
+    def do_GET(self):
+        # wall clock inside a handler bypasses the injected clock
+        started = time.time()
+        return started

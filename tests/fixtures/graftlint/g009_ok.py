@@ -1,0 +1,26 @@
+"""G009 negative fixture: the hygienic handler shape — delegate to the
+FrontDoor (which journals), measure durations monotonically, mutate
+nothing the journal doesn't see."""
+
+import time
+
+
+class GoodHandler:
+    def do_POST(self):
+        # delegation: the FrontDoor's submit journals write-ahead
+        out = self.server.front.submit({"workload": "frank"}, "t0")
+        return out
+
+    def do_GET(self):
+        t0 = time.monotonic()          # durations: monotonic is legal
+        doc = self.server.front.job_status("j0000")  # read-only
+        doc["dur_s"] = time.monotonic() - t0
+        return doc
+
+
+class NotAHandler:
+    """Outside handler classes none of this is G009's business."""
+
+    def helper(self):
+        self.server = object()         # plain attribute, not a handler
+        return time.time()

@@ -1,0 +1,442 @@
+"""Network front door + worker fleet (ISSUE 17).
+
+The load-bearing claims, each tested here:
+- per-tenant token buckets and weighted deficit round-robin shape WHO
+  enters the spool (quota refusal is a typed 429, weights change the
+  interleave, one tenant's burst never reorders a neighbor's queue);
+- the front door journals every submission write-ahead and a restarted
+  server recovers losslessly — pending submissions re-enter admission,
+  spooled ones don't double;
+- the HTTP surface round-trips submit / status / artifact / drain with
+  typed refusals (400 unknown workload, 404 missing artifact, 429
+  quota, 503 draining or armed ``http.accept`` fault);
+- a worker executes a spooled job and publishes the verdict under the
+  FLEET job id (per-job services number internally from j0000 — the
+  regression that once spun the fleet forever);
+- ``tools/loadtest.py`` simulate mode is seed-deterministic and its
+  p99/p50 + Jain gates hold at the frozen bench scenario's shape;
+- ``bench_compare`` qualifies fleet records per [tenants=N,workers=K]
+  so they never cross-gate kernel metrics, and ``obs_report`` renders
+  the Fleet section and fails --strict on lease-expiry storms.
+
+The cross-process story (SIGKILL mid-batch, lease reclaim between real
+worker processes) lives in tools/fleet_check.sh (`make fleet-check`,
+wrapped here as a slow-tier test) and the lease-protocol matrix in
+tests/test_preemption.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.resilience import faults as rfaults
+from flipcomplexityempirical_tpu.service import (
+    EXIT_DRAINED, FairAdmission, FleetServer, FrontDoor, ServiceClient,
+    ClientError, TokenBucket, Worker, clear_drain, drain_marked)
+from flipcomplexityempirical_tpu.service import journal as jnl
+from flipcomplexityempirical_tpu.service.server import (
+    BadRequest, QuotaExceeded, Unavailable)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# cheap catalog job: same 60/20/2 jit specialization every service-layer
+# test suite uses, so the compile is paid once per pytest process
+OVERRIDES = {"total_steps": 60, "n_chains": 2, "checkpoint_every": 20}
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    rfaults.install_plan(None)
+    clear_drain()
+    yield
+    rfaults.install_plan(None)
+    clear_drain()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _tools(name):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# admission primitives
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_burst_then_refill():
+    clk = FakeClock()
+    b = TokenBucket(rate=2.0, burst=3.0, clock=clk)
+    assert [b.take() for _ in range(4)] == [True, True, True, False]
+    clk.t += 1.0          # 2 tokens back
+    assert b.take() and b.take() and not b.take()
+    clk.t += 100.0        # refill caps at burst
+    assert [b.take() for _ in range(4)] == [True, True, True, False]
+
+
+def test_fair_admission_round_robin_interleaves_bursts():
+    fa = FairAdmission()
+    for i in range(3):
+        fa.enqueue("a", f"a{i}")
+    fa.enqueue("b", "b0")
+    fa.enqueue("c", "c0")
+    order = [fa.pop()[1] for _ in range(len(fa))]
+    # a's burst waits behind every other tenant's head-of-line job
+    assert order == ["a0", "b0", "c0", "a1", "a2"]
+    assert fa.pop() is None
+
+
+def test_fair_admission_weights_set_the_share():
+    fa = FairAdmission(weights={"heavy": 2})
+    for i in range(4):
+        fa.enqueue("heavy", f"h{i}")
+        fa.enqueue("light", f"l{i}")
+    order = [fa.pop()[0] for _ in range(len(fa))]
+    # per full cycle: two heavy admissions to one light
+    assert order[:3] == ["heavy", "heavy", "light"]
+    assert order.count("heavy") == 4 and order.count("light") == 4
+
+
+# ---------------------------------------------------------------------------
+# front door: journal, spool, recovery, quota, drain
+# ---------------------------------------------------------------------------
+
+def _submit_workload(front, tenant, seed=3):
+    return front.submit({"workload": "frank",
+                         "overrides": {**OVERRIDES, "seed": seed}},
+                        tenant)
+
+
+def test_front_door_spools_in_admission_order(tmp_path):
+    front = FrontDoor(str(tmp_path))
+    ids = [_submit_workload(front, t, seed=3 + i)["job_id"]
+           for i, t in enumerate(["a", "a", "b"])]
+    assert ids == ["j0000", "j0001", "j0002"]
+    front.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        docs = [d for d in
+                (os.path.join(tmp_path, "jobs", f"{j}.json")
+                 for j in ids) if os.path.exists(d)]
+        if len(docs) == 3:
+            break
+        time.sleep(0.02)
+    front.stop()
+    spooled = {j: json.load(open(
+        os.path.join(tmp_path, "jobs", f"{j}.json"))) for j in ids}
+    # fair admission: a's second job admitted AFTER b's first
+    assert spooled["j0002"]["admit_seq"] < spooled["j0001"]["admit_seq"]
+    records, truncated = jnl.Journal.read(
+        jnl.journal_path_for(str(tmp_path)))
+    assert not truncated
+    kinds = [r["kind"] for r in records]
+    assert kinds.count("job_submitted") == 3
+    assert kinds.count("job_admitted") == 3
+    # WAL ordering: every submission journaled before it's admitted
+    assert kinds.index("job_submitted") < kinds.index("job_admitted")
+    sub = next(r for r in records if r["kind"] == "job_submitted")
+    assert sub["config"]["total_steps"] == 60   # full config doc rides
+
+
+def test_front_door_restart_recovers_pending(tmp_path):
+    front = FrontDoor(str(tmp_path))     # pump never started
+    _submit_workload(front, "a", seed=3)
+    _submit_workload(front, "b", seed=4)
+    # crash before admission: journal has the submissions, spool empty
+    assert os.listdir(tmp_path / "jobs") == []
+    front2 = FrontDoor(str(tmp_path))
+    assert front2.job_status("j0000")["status"] == "pending"
+    front2.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not (
+            front2.pump_idle()
+            and len(os.listdir(tmp_path / "jobs")) == 2):
+        time.sleep(0.02)
+    front2.stop()
+    assert sorted(os.listdir(tmp_path / "jobs")) == ["j0000.json",
+                                                     "j0001.json"]
+    assert front2.job_status("j0001")["status"] == "queued"
+    # a third restart does NOT double-spool admitted jobs
+    front3 = FrontDoor(str(tmp_path))
+    front3.start()
+    time.sleep(0.3)
+    front3.stop()
+    records, _ = jnl.Journal.read(jnl.journal_path_for(str(tmp_path)))
+    assert sum(r["kind"] == "job_admitted" for r in records) == 2
+
+
+def test_front_door_quota_refuses_with_429(tmp_path):
+    clk = FakeClock()
+    ev = tmp_path / "events.jsonl"
+    rec = obs.Recorder(str(ev))
+    front = FrontDoor(str(tmp_path), recorder=rec, quota_rate=1.0,
+                      quota_burst=2.0, clock=clk)
+    _submit_workload(front, "greedy", seed=3)
+    _submit_workload(front, "greedy", seed=4)
+    with pytest.raises(QuotaExceeded) as ei:
+        _submit_workload(front, "greedy", seed=5)
+    assert ei.value.status == 429
+    # quotas are per tenant: a neighbor is unaffected
+    _submit_workload(front, "polite", seed=6)
+    clk.t += 1.0
+    _submit_workload(front, "greedy", seed=7)
+    rec.close()
+    events = [json.loads(l) for l in ev.read_text().splitlines()]
+    rejected = [e for e in events if e["event"] == "quota_rejected"]
+    assert len(rejected) == 1 and rejected[0]["tenant"] == "greedy"
+
+
+def test_front_door_drain_refuses_and_marks(tmp_path):
+    front = FrontDoor(str(tmp_path))
+    _submit_workload(front, "a")
+    out = front.drain("test")
+    assert out == {"draining": "test"}
+    assert drain_marked(str(tmp_path)) == "test"
+    with pytest.raises(Unavailable):
+        _submit_workload(front, "a", seed=9)
+    records, _ = jnl.Journal.read(jnl.journal_path_for(str(tmp_path)))
+    assert records[-1]["kind"] == "service_draining"
+
+
+def test_front_door_rejects_bad_bodies(tmp_path):
+    front = FrontDoor(str(tmp_path))
+    for body in ({}, {"workload": "no-such-workload"},
+                 {"workload": "frank", "overrides": ["not", "a", "dict"]},
+                 {"workload": "frank",
+                  "overrides": {"no_such_field": 1}},
+                 {"config": {"family": "frank", "bogus": True}}):
+        with pytest.raises(BadRequest):
+            front.submit(body, "a")
+    # refusals journal nothing (write-ahead means no take-backs needed)
+    records, _ = jnl.Journal.read(jnl.journal_path_for(str(tmp_path)))
+    assert records == []
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+def test_http_round_trip_and_typed_refusals(tmp_path):
+    with FleetServer(str(tmp_path)) as srv:
+        client = ServiceClient(srv.url, tenant="alice")
+        assert client.healthz()["ok"] is True
+        assert "frank" in client.workloads()
+        with pytest.raises(ClientError) as ei:
+            client.submit(workload="no-such-workload")
+        assert ei.value.status == 400
+        doc = client.submit(workload="frank", overrides=OVERRIDES)
+        assert doc["job_id"] == "j0000" and doc["tenant"] == "alice"
+        st = client.status("j0000")
+        assert st["status"] in ("pending", "queued")
+        with pytest.raises(ClientError) as ei:
+            client.artifact("j0000")      # not run yet
+        assert ei.value.status == 404
+        with pytest.raises(ClientError) as ei:
+            client.status("j9999")
+        assert ei.value.status == 404
+        # an armed http.accept fault is a 503 refusal, never torn state
+        rfaults.install_from_spec("http.accept:once")
+        with pytest.raises(ClientError) as ei:
+            client.healthz()
+        assert ei.value.status == 503
+        assert client.healthz()["ok"] is True   # once means once
+        n = client.jobs()
+        assert n["counts"] == {"queued": 1} or n["counts"] == \
+            {"pending": 1}
+
+
+@pytest.mark.slow
+def test_http_submit_worker_executes_artifact_served(tmp_path):
+    """The full tenant story over real HTTP: submit a catalog workload,
+    a worker claims it from the spool, the artifact (with its
+    bit-identity digest) comes back through the door — verdicts keyed
+    by the FLEET id, not the per-job service's internal j0000."""
+    with FleetServer(str(tmp_path)) as srv:
+        client = ServiceClient(srv.url, tenant="alice")
+        a = client.submit(workload="frank", overrides=OVERRIDES)
+        b = client.submit(workload="frank",
+                          overrides={**OVERRIDES, "seed": 11})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not os.path.exists(
+                tmp_path / "jobs" / "j0001.json"):
+            time.sleep(0.02)
+        w = Worker(str(tmp_path), worker="wtest", ttl_s=30.0)
+        assert w.run_once() == 2
+        for job_id in (a["job_id"], b["job_id"]):
+            st = client.status(job_id)
+            assert st["status"] == "done", st
+            assert st["worker"] == "wtest"
+            assert st["queue_to_start_s"] >= 0
+            art = client.artifact(job_id)
+            assert art["job_id"] == job_id
+            assert art["result_sha256"]
+        # distinct seeds -> distinct result digests (real payloads)
+        assert client.artifact(a["job_id"])["result_sha256"] != \
+            client.artifact(b["job_id"])["result_sha256"]
+        assert client.jobs()["counts"] == {"done": 2}
+
+
+def test_drain_endpoint_stops_workers_and_refuses(tmp_path):
+    with FleetServer(str(tmp_path)) as srv:
+        client = ServiceClient(srv.url)
+        client.submit(workload="frank", overrides=OVERRIDES)
+        assert client.drain() == {"draining": "http"}
+        assert client.healthz()["draining"] is True
+        with pytest.raises(ClientError) as ei:
+            client.submit(workload="frank", overrides=OVERRIDES)
+        assert ei.value.status == 503
+        # a worker landing on the drained root exits 3 without claiming
+        w = Worker(str(tmp_path), worker="wd", idle_timeout_s=0.1,
+                   poll_s=0.05)
+        assert w.run() == EXIT_DRAINED
+        assert w.executed == []
+
+
+# ---------------------------------------------------------------------------
+# loadtest + bench_compare + obs_report
+# ---------------------------------------------------------------------------
+
+def test_jain_index():
+    loadtest = _tools("loadtest")
+    assert loadtest.jain_index([1.0, 1.0, 1.0, 1.0]) == pytest.approx(1.0)
+    assert loadtest.jain_index([1.0, 0.0, 0.0, 0.0]) == pytest.approx(0.25)
+    # degenerate inputs lean fair (empty / all-zero -> 1.0): the gate
+    # is value >= threshold, so the conservative direction is not to
+    # fabricate unfairness where there is no signal
+    assert loadtest.jain_index([]) == 1.0
+    assert loadtest.jain_index([0.0, 0.0]) == 1.0
+
+
+def test_loadtest_simulate_deterministic_and_gated():
+    loadtest = _tools("loadtest")
+    kw = dict(tenants=40, jobs=2, workers=8, service_s=0.5,
+              spread_s=20.0, admit_s=0.002, seed=7)
+    sim = loadtest.simulate(**kw)
+    again = loadtest.simulate(**kw)
+    assert sim["waits"] == again["waits"]       # seeded, replayable
+    rec = loadtest.build_record(sim["waits"], sim["turnarounds"],
+                                sim["rejected"], tenants=40, workers=8,
+                                jobs=2, mode="simulate")
+    assert rec["metric"] == "fleet_fairness_jain"
+    assert rec["cpu_fallback"] is True and rec["device"] == "cpu"
+    assert rec["jobs_measured"] == 80
+    # the acceptance gates at the SLO-regime shape
+    assert rec["p99_over_p50"] <= 2.0, rec
+    assert rec["value"] >= 0.8, rec
+
+
+def test_loadtest_quota_rejections_counted():
+    loadtest = _tools("loadtest")
+    sim = loadtest.simulate(tenants=4, jobs=50, workers=4,
+                            service_s=0.01, spread_s=1.0, admit_s=0.0,
+                            seed=7, quota_rate=1.0, quota_burst=2.0)
+    assert sum(sim["rejected"].values()) > 0
+    done = sum(len(w) for w in sim["waits"].values())
+    assert done + sum(sim["rejected"].values()) == 200
+
+
+def test_bench_compare_qualifies_fleet_records():
+    bench_compare = _tools("bench_compare")
+    fleet = {"metric": "fleet_fairness_jain", "value": 0.97,
+             "tenants": 500, "workers": 16}
+    assert bench_compare.extract_metrics(fleet) == \
+        {"fleet_fairness_jain[tenants=500,workers=16]": 0.97}
+    # the service record's qualifier is untouched (no workers key)
+    svc = {"metric": "tenant_efficiency", "value": 2.4, "tenants": 4}
+    assert bench_compare.extract_metrics(svc) == \
+        {"tenant_efficiency[tenants=4]": 2.4}
+
+
+def _fleet_events(n_expired):
+    evs = [{"event": "job_submitted", "ts": 100.0, "job_id": "j0000",
+            "tenant": "a"},
+           {"event": "worker_started", "ts": 100.5, "worker": "w1"},
+           {"event": "lease_acquired", "ts": 101.0, "job_id": "j0000",
+            "worker": "w1", "reclaim": False},
+           {"event": "http_request", "ts": 101.2, "method": "POST",
+            "path": "/v1/jobs", "status": 200, "tenant": "a",
+            "dur_s": 0.004},
+           {"event": "quota_rejected", "ts": 101.3, "tenant": "b"}]
+    for i in range(n_expired):
+        evs.append({"event": "lease_expired", "ts": 102.0 + i,
+                    "job_id": "j0000", "worker": "w1", "by": "w2",
+                    "age_s": 9.0})
+    evs.append({"event": "worker_exited", "ts": 110.0, "worker": "w1",
+                "reason": "done", "n_executed": 1, "n_failures": 0})
+    return evs
+
+
+def test_obs_report_lease_storms_threshold():
+    obs_report = _tools("obs_report")
+    assert obs_report.lease_storms(_fleet_events(2)) == {}
+    storms = obs_report.lease_storms(_fleet_events(3))
+    assert storms == {"j0000": 3}
+
+
+def test_obs_report_fleet_section_renders():
+    import io
+
+    obs_report = _tools("obs_report")
+    out = io.StringIO()
+    obs_report.report_fleet(_fleet_events(1), out)
+    text = out.getvalue()
+    assert "Fleet" in text
+    assert "w1" in text and "quota" in text.lower()
+    # no fleet events -> no section
+    out2 = io.StringIO()
+    obs_report.report_fleet([{"event": "run_start", "ts": 1.0}], out2)
+    assert out2.getvalue() == ""
+
+
+def test_obs_report_strict_fails_on_lease_storm(tmp_path):
+    p = tmp_path / "events.jsonl"
+    p.write_text("".join(
+        json.dumps({"v": 1, **e}) + "\n" for e in _fleet_events(3)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         str(p), "--strict"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "storm" in (r.stdout + r.stderr).lower()
+
+
+# ---------------------------------------------------------------------------
+# CLI + CI gate
+# ---------------------------------------------------------------------------
+
+def test_cli_submit_refusal_exits_4(tmp_path):
+    """Client-side refusals (here: no server at all) are exit code 4 —
+    distinct from job failures (2) and drains (3)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "flipcomplexityempirical_tpu.service",
+         "submit", "http://127.0.0.1:9", "--workload", "frank"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 4, r.stdout + r.stderr
+    assert "error" in r.stderr
+
+
+@pytest.mark.slow
+def test_fleet_check_gate_passes():
+    """make fleet-check: 1 server + 2 workers + 8 tenants + SIGKILL
+    chaos as one script. Slow-tier like the mesh gate — CI runs it
+    both here (--runslow) and as the make target."""
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "fleet_check.sh")],
+        capture_output=True, text=True, cwd=REPO, timeout=420)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "fleet-check: OK" in r.stdout

@@ -21,6 +21,7 @@ import json
 import os
 import signal
 import subprocess
+import threading
 import time
 
 import numpy as np
@@ -36,8 +37,8 @@ from flipcomplexityempirical_tpu.resilience import supervisor as sup
 from flipcomplexityempirical_tpu.resilience.degrade import is_device_loss
 from flipcomplexityempirical_tpu.service import (
     DispatchWatchdog, DrainController, DrainRequested, EXIT_DRAINED,
-    Journal, SweepService, check_drain, clear_drain, drain_requested,
-    request_drain)
+    Journal, LeaseManager, SweepService, Worker, check_drain,
+    clear_drain, drain_requested, request_drain)
 from flipcomplexityempirical_tpu.service import journal as jnl
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -697,6 +698,205 @@ def test_elastic_run_clean_is_unmarked():
     assert info["devices"] == 4
     assert "degraded" not in info
     assert not bench_compare.record_degraded(info)
+
+
+# ---------------------------------------------------------------------------
+# fleet lease protocol (ISSUE 17): claim races, expiry, reclaim,
+# SIGKILL-resume bit-identity
+# ---------------------------------------------------------------------------
+
+def _events(path):
+    return [json.loads(line) for line in open(path)
+            if line.strip()]
+
+
+def test_lease_claim_race_exactly_one_winner(tmp_path):
+    """Two workers racing one job: the O_EXCL create arbitrates, so
+    exactly one claim lands per job no matter how the race times out."""
+    root = str(tmp_path)
+    m1 = LeaseManager(root, "w1")
+    m2 = LeaseManager(root, "w2")
+    for i in range(20):
+        job_id = f"j{i:04d}"
+        barrier = threading.Barrier(2)
+        wins = []
+
+        def race(mgr):
+            barrier.wait()
+            lease = mgr.claim(job_id)
+            if lease is not None:
+                wins.append(lease)
+
+        ts = [threading.Thread(target=race, args=(m,))
+              for m in (m1, m2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(wins) == 1, f"{len(wins)} winners on {job_id}"
+        # the loser is blocked while the winner is live
+        loser = m1 if wins[0]._mgr is m2 else m2
+        assert loser.claim(job_id) is None
+        wins[0].release()
+
+
+def test_lease_release_then_fresh_claim(tmp_path):
+    m1 = LeaseManager(root := str(tmp_path), "w1")
+    m2 = LeaseManager(root, "w2")
+    lease = m1.claim("j0000")
+    assert lease is not None
+    lease.release()
+    lease.release()      # idempotent
+    again = m2.claim("j0000")
+    assert again is not None and m2.holder("j0000")["worker"] == "w2"
+
+
+def test_expired_lease_reclaimed_with_events(tmp_path):
+    ev = tmp_path / "events.jsonl"
+    rec = obs.Recorder(str(ev))
+    root = str(tmp_path)
+    m1 = LeaseManager(root, "w1", ttl_s=2.0, recorder=rec)
+    m2 = LeaseManager(root, "w2", ttl_s=2.0, recorder=rec)
+    assert m1.claim("j0000") is not None
+    assert m2.claim("j0000") is None          # live: blocked
+    # w1 dies silently; its heartbeat stops and the lease ages out
+    path = m1.path("j0000")
+    old = time.time() - 10.0
+    os.utime(path, (old, old))
+    assert not m2.live("j0000")
+    lease = m2.claim("j0000")
+    assert lease is not None
+    assert m2.holder("j0000")["worker"] == "w2"
+    # the broken lease is a tombstone, not a deletion — forensics keep
+    # who held it and who broke it
+    tombs = [n for n in os.listdir(os.path.join(root, "leases"))
+             if ".expired." in n]
+    assert len(tombs) == 1 and ".expired.w2." in tombs[0]
+    rec.close()
+    events = _events(ev)
+    expired = [e for e in events if e["event"] == "lease_expired"]
+    assert len(expired) == 1
+    assert expired[0]["worker"] == "w1" and expired[0]["by"] == "w2"
+    assert expired[0]["age_s"] >= 2.0
+    acquired = [e for e in events if e["event"] == "lease_acquired"]
+    assert [(e["worker"], e["reclaim"]) for e in acquired] == \
+        [("w1", False), ("w2", True)]
+
+
+def test_heartbeat_refresh_keeps_lease_live(tmp_path):
+    m1 = LeaseManager(root := str(tmp_path), "w1", ttl_s=2.0)
+    m2 = LeaseManager(root, "w2", ttl_s=2.0)
+    lease = m1.claim("j0000")
+    old = time.time() - 10.0
+    os.utime(lease.path, (old, old))
+    assert not m1.live("j0000")
+    lease.refresh()                    # the beat that saves it
+    assert m1.live("j0000")
+    assert m2.claim("j0000") is None
+
+
+def test_torn_lease_blocks_while_fresh_reclaims_when_aged(tmp_path):
+    """A lease torn mid-write (SIGKILL between create and payload, or
+    an injected truncate) must not wedge the job forever — but a FRESH
+    torn lease still blocks: the writer may be alive and about to
+    heartbeat. Liveness is mtime-only, never payload-parseability."""
+    rfaults.install_from_spec("lease.write:truncate")
+    m1 = LeaseManager(root := str(tmp_path), "w1", ttl_s=2.0)
+    m2 = LeaseManager(root, "w2", ttl_s=2.0)
+    assert m1.claim("j0000") is not None
+    assert m1.holder("j0000") is None          # payload torn
+    assert m2.claim("j0000") is None           # fresh: still blocked
+    path = m1.path("j0000")
+    old = time.time() - 10.0
+    os.utime(path, (old, old))
+    ev = tmp_path / "events.jsonl"
+    rec = obs.Recorder(str(ev))
+    m3 = LeaseManager(root, "w3", ttl_s=2.0, recorder=rec)
+    assert m3.claim("j0000") is not None
+    rec.close()
+    expired = [e for e in _events(ev) if e["event"] == "lease_expired"]
+    assert expired[0]["worker"] == "unknown"   # torn payload: honest
+
+
+def test_lease_write_fault_fails_claim_then_recovers(tmp_path):
+    rfaults.install_from_spec("lease.write:once")
+    m1 = LeaseManager(str(tmp_path), "w1")
+    with pytest.raises(rfaults.InjectedFault):
+        m1.claim("j0000")
+    # the fault fired BEFORE the create: nothing on disk, retry lands
+    assert m1.holder("j0000") is None
+    assert m1.claim("j0000") is not None
+
+
+def _spool_job(root, job_id, cfg, tenant="t0"):
+    """Hand-spool one admitted job doc the way the front door does."""
+    path = os.path.join(root, "jobs", f"{job_id}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"job_id": job_id, "tenant": tenant, "admit_seq": 0,
+                   "submitted_ts": time.time(),
+                   "config": jnl.config_to_doc(cfg)}, f)
+
+
+@pytest.mark.slow
+def test_sigkill_resume_bit_identical(tmp_path):
+    """The crash-interchangeability contract: worker A is killed
+    mid-batch (emulated via the injected-sigterm drain at a segment
+    boundary — same on-disk state as a SIGKILL after the checkpoint
+    write), worker B reclaims and resumes from the sliced checkpoint,
+    and the artifact digest equals an uninterrupted run's exactly.
+    Slow tier (three full service runs — ~22 s cold); the real
+    cross-process SIGKILL (exit 137) leg runs in tools/fleet_check.sh
+    and the fast-tier lease matrix above stays tier-1."""
+    cfg = _cfg(seed=17)
+    # reference: one uninterrupted worker on its own root
+    ref_root = str(tmp_path / "ref")
+    ref_w = Worker(ref_root, worker="ref")
+    _spool_job(ref_root, "j0000", cfg)
+    assert ref_w.run_once() == 1
+    ref_art = json.load(open(
+        os.path.join(ref_root, "artifacts", "j0000.json")))
+    assert ref_art["result_sha256"]
+
+    # chaos root: worker A drains at the second segment boundary
+    root = str(tmp_path / "fleet")
+    wa = Worker(root, worker="wa")
+    _spool_job(root, "j0000", cfg)
+    rfaults.install_from_spec("sigterm:once@2")
+    assert wa.run_once() == 0        # no verdict: drained mid-job
+    assert wa.executed == []
+    assert os.path.exists(
+        jnl.journal_path_for(os.path.join(root, "run", "j0000")))
+    assert os.listdir(os.path.join(root, "ckpt", "j0000"))
+    # no terminal verdict published, lease released for the successor
+    assert wa.terminal("j0000") is None
+    assert wa.leases.holder("j0000") is None
+
+    # worker B (a different process in production) recovers and lands
+    # the SAME bits
+    rfaults.install_plan(None)
+    clear_drain()
+    wb = Worker(root, worker="wb")
+    assert wb.run_once() == 1
+    status = wb.terminal("j0000")
+    assert status["status"] == "done" and status["worker"] == "wb"
+    art = json.load(open(
+        os.path.join(root, "artifacts", "j0000.json")))
+    assert art["result_sha256"] == ref_art["result_sha256"]
+
+
+def test_worker_skips_published_verdicts(tmp_path):
+    """Re-scanning a root whose jobs are all terminal must not claim,
+    re-execute, or touch leases — the regression that once spun the
+    fleet at hundreds of claim cycles per second."""
+    root = str(tmp_path)
+    w = Worker(root, worker="w1")
+    _spool_job(root, "j0000", _cfg(seed=17))
+    assert w.run_once() == 1
+    w2 = Worker(root, worker="w2")
+    assert w2.run_once() == 0
+    assert w2.executed == []
+    assert os.listdir(os.path.join(root, "leases")) == []
+    assert w2.all_terminal()
 
 
 # ---------------------------------------------------------------------------

@@ -69,6 +69,14 @@ def extract_metrics(doc, out: dict | None = None) -> dict:
                 # under the same workload family and policy stack
                 name += (f"[family={doc['family']},"
                          f"policy={doc['policy']}]")
+            elif "tenants" in doc and "workers" in doc:
+                # fleet loadtest records (bench --fleet /
+                # tools/loadtest.py): fairness and queue-to-start only
+                # compare under the same tenant count AND worker fleet
+                # size — qualify on both so a 4-worker and a 16-worker
+                # record (or any kernel metric) never cross-gate
+                name += (f"[tenants={doc['tenants']},"
+                         f"workers={doc['workers']}]")
             elif "tenants" in doc:
                 # sweep-service records (bench --service): a 4-tenant
                 # and an 8-tenant efficiency measure different

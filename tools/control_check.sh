@@ -29,8 +29,12 @@ TD="$(mktemp -d)"
 trap 'rm -rf "$TD"' EXIT
 
 # one persistent XLA cache across the legs' processes: the recovered
-# process must not re-pay the drained process's compiles
-export JAX_COMPILATION_CACHE_DIR="$TD/jax-cache"
+# process must not re-pay the drained process's compiles. Stable path
+# (not in $TD) so repeat gate runs skip the cold compiles as well; the
+# bench leg's fixed-vs-adaptive ratio is measured from its own warmup
+# leg either way.
+export JAX_COMPILATION_CACHE_DIR="${GRAFT_GATE_JAX_CACHE:-${TMPDIR:-/tmp}/graft-gate-jax-cache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
 
 LEGS="${CONTROL_LEGS:-lint bench replay}"

@@ -1,0 +1,139 @@
+"""G009: HTTP handler hygiene in the fleet front door.
+
+``service/server.py``'s request handlers run on ``ThreadingHTTPServer``
+threads — one per in-flight request, concurrent with the admission pump
+and every other request. Three bug classes turn that into a
+correctness problem rather than a style one:
+
+- **blocking sweep execution on a request thread**: constructing a
+  ``SweepService`` or calling ``run_until_idle`` inside a handler runs
+  device work (minutes of XLA compile + sampling) while the client's
+  socket — and the server's accept backlog behind it — waits.
+  Execution belongs to the worker fleet; the front door only journals,
+  spools, and reads.
+- **bare ``time.time()``**: the server's quota buckets and journal
+  timestamps replay in tests on an injected clock (the G007
+  discipline); a handler reading the wall clock directly bypasses it.
+  Durations use ``time.monotonic()``, which stays legal.
+- **unjournaled state mutation**: handlers share the ``FrontDoor``
+  through ``self.server`` — a ``do_*`` method that assigns into or
+  mutates ``self.server...`` without any journaling call in sight is a
+  state change a server restart silently forgets (the WAL is the
+  recovery story; mutations the journal never saw don't survive it).
+
+Statically, inside any class that subclasses ``BaseHTTPRequestHandler``
+(or structurally looks like one: defines ``do_*`` methods): flag (a)
+calls whose dotted name contains ``SweepService`` or ends with
+``run_until_idle``; (b) ``time.time()`` calls; (c) within ``do_*``
+methods containing no call whose dotted name mentions ``journal`` or
+``submit`` (the FrontDoor's journaling entry points), any assignment to
+an attribute chain rooted at ``self.server`` or any mutating method
+call (``append``/``add``/``pop``/``update``/``setdefault``/
+``insert``/``remove``/``extend``/``clear``) on such a chain.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import dotted_name
+
+RULE_ID = "G009"
+
+_MUTATORS = ("append", "add", "pop", "update", "setdefault",
+             "insert", "remove", "extend", "clear")
+
+_DO_METHOD = re.compile(r"do_[A-Z]+$")
+
+
+def applies(module) -> bool:
+    return ("service/" in module.path
+            and module.path.endswith("server.py")
+            and not module.is_test)
+
+
+def _is_handler_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = dotted_name(base) or ""
+        if "BaseHTTPRequestHandler" in name:
+            return True
+    return any(isinstance(node, ast.FunctionDef)
+               and _DO_METHOD.match(node.name)
+               for node in cls.body)
+
+
+def _server_chain(node) -> bool:
+    """True when ``node`` is an attribute chain rooted at
+    ``self.server`` (the handler's shared-state door)."""
+    name = dotted_name(node) or ""
+    return name == "self.server" or name.startswith("self.server.")
+
+
+def _journals(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if "journal" in name or name.endswith(".submit"):
+                return True
+    return False
+
+
+def _check_mutations(fn: ast.FunctionDef, module, findings):
+    if _journals(fn):
+        return
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets
+                       if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute) and _server_chain(tgt):
+                    findings.append(module.finding(
+                        RULE_ID, node,
+                        f"{fn.name} assigns into self.server state "
+                        "with no journaling call in the handler — a "
+                        "restart forgets mutations the WAL never saw; "
+                        "route state changes through the FrontDoor's "
+                        "journaled entry points"))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in _MUTATORS
+              and _server_chain(node.func.value)):
+            findings.append(module.finding(
+                RULE_ID, node,
+                f"{fn.name} mutates self.server state "
+                f"(.{node.func.attr}) with no journaling call in the "
+                "handler — unjournaled mutations don't survive a "
+                "server restart"))
+
+
+def check(module, config):
+    findings = []
+    for cls in ast.walk(module.tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and _is_handler_class(cls)):
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if "SweepService" in name or name.endswith("run_until_idle"):
+                findings.append(module.finding(
+                    RULE_ID, node,
+                    f"{name}() runs sweep execution on a request "
+                    "thread — the front door only journals, spools, "
+                    "and reads; execution belongs to the worker "
+                    "fleet (service.worker)"))
+            elif name == "time.time":
+                findings.append(module.finding(
+                    RULE_ID, node,
+                    "time.time() inside an HTTP handler bypasses the "
+                    "injected clock the server replays on in tests; "
+                    "use the FrontDoor's clock for timestamps and "
+                    "time.monotonic() for durations"))
+        for fn in cls.body:
+            if (isinstance(fn, ast.FunctionDef)
+                    and _DO_METHOD.match(fn.name)):
+                _check_mutations(fn, module, findings)
+    return findings

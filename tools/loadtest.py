@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Fleet loadtest: queue-to-start latency + tenant fairness, measured.
+
+    python tools/loadtest.py --tenants 500 --simulate
+    python tools/loadtest.py --url http://127.0.0.1:8777 --tenants 8
+
+``--simulate`` runs the ROADMAP's 500-concurrent-tenant scenario with
+NO network, NO processes, and NO device work: the server's real
+admission classes (``service.server.TokenBucket`` / ``FairAdmission`` —
+imported, not reimplemented) are driven on a **virtual clock** through
+a deterministic discrete-event loop with K simulated workers of
+constant per-job service time. Deterministic in ``--seed``, finishes in
+milliseconds, and measures the only thing the simulation can honestly
+measure: the QUEUEING behavior of the admission design — who waits,
+for how long, and how evenly. Device throughput is ``bench.py``'s job;
+this record prices scheduling.
+
+``--url`` drives a LIVE front door instead (``service.client``):
+submits ``--jobs`` jobs per tenant, waits for all of them, and computes
+the same statistics from the server's per-job ``queue_to_start_s``
+(submission -> first lease claim, crash-resume keeps the first anchor).
+
+Headline metric: **Jain's fairness index** over per-tenant mean
+TURNAROUND (queue-to-start + service), ``(Σx)² / (n·Σx²)`` — 1.0 when
+every tenant is served alike, → 1/n when one tenant absorbs all the
+delay. Turnaround, not raw wait: waits on an uncontended fleet sit at
+the admission floor (~ms), where Jain degenerates into a ratio of
+noise; turnaround anchors the index at the service time tenants
+actually experience and converges to wait-fairness exactly when
+backlog makes waits dominate — the regime where fairness is at stake.
+Higher is better, which lets ``tools/bench_compare.py`` gate it like
+any throughput metric (qualified ``[tenants=N,workers=K]`` so it never
+cross-gates kernel numbers). The record also carries p50/p99
+queue-to-start and their ratio; the ROADMAP target (p99 ≤ 2×p50 at 500
+tenants) is enforceable inline via ``--require-p99-ratio 2
+--require-fairness 0.8`` (exit 1 on violation — the fleet-check gate
+uses this). Defaults model the target SLO regime: 16 workers at ~25%
+utilization (spread 4× the fleet's total service time), where queueing
+theory says waits stay at the floor — shrink ``--spread-s`` or
+``--workers`` to study backlog instead.
+
+The simulated record is tagged ``device: cpu`` + ``cpu_fallback`` so
+no comparison ever mistakes a scheduling simulation for silicon.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+from heapq import heapify, heappop, heappush
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, os.pardir))
+
+
+def jain_index(values) -> float:
+    """(Σx)² / (n·Σx²); 1.0 for a uniform vector, 1/n for one-hot."""
+    vals = list(values)
+    if not vals:
+        return 1.0
+    total = sum(vals)
+    sq = sum(v * v for v in vals)
+    if sq == 0.0:
+        return 1.0
+    return (total * total) / (len(vals) * sq)
+
+
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+class _VirtualClock:
+    """The injected clock: ``now`` advances only when the event loop
+    says so. TokenBucket refills against THIS, so quota behavior in
+    simulation is exactly the served behavior, faster."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def simulate(tenants: int, jobs: int, workers: int, service_s: float,
+             spread_s: float, admit_s: float, seed: int,
+             quota_rate=None, quota_burst: float = 10.0,
+             weights=None) -> dict:
+    """Discrete-event simulation over the REAL admission classes.
+
+    Submissions: each tenant submits ``jobs`` jobs at seeded-uniform
+    times in [0, spread_s). Admission: TokenBucket per tenant (when
+    ``quota_rate``), then FairAdmission — the server's own weighted
+    deficit round-robin. Service: K workers, constant ``service_s``
+    per job, earliest-free-first (a heap of free times — the idle-
+    worker poll loop's limit behavior). ``admit_s`` is the constant
+    pump/claim overhead floor every job pays even on an idle fleet.
+    """
+    from flipcomplexityempirical_tpu.service.server import (
+        FairAdmission, TokenBucket)
+
+    rng = random.Random(seed)
+    subs = sorted(
+        (rng.uniform(0.0, spread_s), f"t{t:03d}", j)
+        for t in range(tenants) for j in range(jobs))
+    clock = _VirtualClock()
+    buckets: dict = {}
+
+    def admit(t_sub, tenant, idx) -> bool:
+        clock.now = max(clock.now, t_sub)
+        if quota_rate is not None:
+            bucket = buckets.get(tenant)
+            if bucket is None:
+                bucket = TokenBucket(quota_rate, quota_burst,
+                                     clock=clock)
+                buckets[tenant] = bucket
+            if not bucket.take():
+                return False
+        adm.enqueue(tenant, (t_sub, idx))
+        return True
+
+    adm = FairAdmission(weights=weights)
+    free = [0.0] * workers
+    heapify(free)
+    waits: dict = {}
+    turnarounds: dict = {}
+    rejected: dict = {}
+    i = 0
+    while i < len(subs) or len(adm):
+        # feed the queue: everything submitted by the time the next
+        # worker frees, plus at least one submission when it is empty
+        # (an idle fleet waits for work, not the reverse)
+        while i < len(subs) and (subs[i][0] <= free[0]
+                                 or len(adm) == 0):
+            t_sub, tenant, idx = subs[i]
+            i += 1
+            if not admit(t_sub, tenant, idx):
+                rejected[tenant] = rejected.get(tenant, 0) + 1
+        if len(adm) == 0:
+            continue        # everything pending was quota-rejected
+        w_free = heappop(free)
+        tenant, (t_sub, _) = adm.pop()
+        start = max(w_free, t_sub) + admit_s
+        waits.setdefault(tenant, []).append(start - t_sub)
+        turnarounds.setdefault(tenant, []).append(
+            start - t_sub + service_s)
+        heappush(free, start + service_s)
+    return {"waits": waits, "turnarounds": turnarounds,
+            "rejected": rejected, "makespan_s": max(free)}
+
+
+def live(url: str, tenants: int, jobs: int, workload: str,
+         overrides: dict, timeout_s: float) -> dict:
+    """Drive a served front door: submit jobs×tenants, wait, read the
+    server's queue_to_start_s per job."""
+    from flipcomplexityempirical_tpu.service.client import (
+        ClientError, ServiceClient)
+
+    submitted: dict = {}          # job_id -> tenant
+    rejected: dict = {}
+    clients = {f"t{t:03d}": ServiceClient(url, tenant=f"t{t:03d}")
+               for t in range(tenants)}
+    for j in range(jobs):
+        for tenant, client in clients.items():
+            try:
+                out = client.submit(workload=workload,
+                                    overrides=overrides)
+                submitted[out["job_id"]] = tenant
+            except ClientError as e:
+                if e.status != 429:
+                    raise
+                rejected[tenant] = rejected.get(tenant, 0) + 1
+    any_client = next(iter(clients.values()))
+    done = any_client.wait_all(list(submitted), timeout_s=timeout_s)
+    waits: dict = {}
+    turnarounds: dict = {}
+    for job_id, doc in done.items():
+        tenant = submitted[job_id]
+        q2s = doc.get("queue_to_start_s")
+        if q2s is not None:
+            waits.setdefault(tenant, []).append(q2s)
+        if (doc.get("finished_ts") is not None
+                and doc.get("submitted_ts") is not None):
+            turnarounds.setdefault(tenant, []).append(
+                doc["finished_ts"] - doc["submitted_ts"])
+    return {"waits": waits, "turnarounds": turnarounds,
+            "rejected": rejected, "statuses": done}
+
+
+def build_record(waits: dict, turnarounds: dict, rejected: dict,
+                 tenants: int, workers: int, jobs: int, mode: str,
+                 extra=None) -> dict:
+    all_waits = sorted(w for ws in waits.values() for w in ws)
+    per_tenant_mean = [sum(ts) / len(ts)
+                       for ts in turnarounds.values() if ts]
+    p50 = _pctl(all_waits, 0.5)
+    p99 = _pctl(all_waits, 0.99)
+    record = {
+        "metric": "fleet_fairness_jain",
+        "value": round(jain_index(per_tenant_mean), 4),
+        "unit": "ratio",
+        "mode": mode,
+        "tenants": tenants,
+        "workers": workers,
+        "jobs_per_tenant": jobs,
+        "jobs_measured": len(all_waits),
+        "p50_queue_to_start_s": round(p50, 4),
+        "p99_queue_to_start_s": round(p99, 4),
+        "p99_over_p50": round(p99 / p50, 3) if p50 > 0 else None,
+        "max_queue_to_start_s": round(all_waits[-1], 4)
+                                if all_waits else None,
+        "quota_rejected": sum(rejected.values()),
+        # a scheduling measurement, never silicon:
+        "device": "cpu",
+        "cpu_fallback": True,
+    }
+    if extra:
+        record.update(extra)
+    return record
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fleet loadtest: queue-to-start + Jain fairness")
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--simulate", action="store_true",
+                      help="virtual-clock discrete-event run over the "
+                           "server's own admission classes")
+    mode.add_argument("--url", default=None,
+                      help="drive a live front door instead")
+    ap.add_argument("--tenants", type=int, default=500)
+    ap.add_argument("--jobs", type=int, default=2,
+                    help="jobs per tenant")
+    ap.add_argument("--workers", type=int, default=16)
+    ap.add_argument("--service-s", type=float, default=1.0,
+                    help="simulate: constant per-job service time")
+    ap.add_argument("--spread-s", type=float, default=None,
+                    help="simulate: submissions arrive uniformly over "
+                         "this window (default: 4x the fleet's total "
+                         "service time — the ~25%%-utilization SLO "
+                         "regime; shrink it to study backlog)")
+    ap.add_argument("--admit-s", type=float, default=0.002,
+                    help="simulate: constant admission+claim overhead "
+                         "floor per job")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--quota-rate", type=float, default=None)
+    ap.add_argument("--quota-burst", type=float, default=10.0)
+    ap.add_argument("--workload", default="frank",
+                    help="live: catalog workload to submit")
+    ap.add_argument("--set", dest="overrides", action="append",
+                    metavar="K=V", help="live: workload override")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--require-p99-ratio", type=float, default=None,
+                    metavar="R", help="exit 1 unless p99 <= R x p50")
+    ap.add_argument("--require-fairness", type=float, default=None,
+                    metavar="J", help="exit 1 unless Jain >= J")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the record JSON here")
+    args = ap.parse_args(argv)
+
+    if args.simulate:
+        spread = args.spread_s
+        if spread is None:
+            spread = (4.0 * args.tenants * args.jobs * args.service_s
+                      / max(1, args.workers))
+        sim = simulate(args.tenants, args.jobs, args.workers,
+                       args.service_s, spread, args.admit_s,
+                       args.seed, quota_rate=args.quota_rate,
+                       quota_burst=args.quota_burst)
+        record = build_record(
+            sim["waits"], sim["turnarounds"], sim["rejected"],
+            args.tenants, args.workers, args.jobs, "simulate",
+            extra={"service_s": args.service_s,
+                   "spread_s": round(spread, 3),
+                   "admit_s": args.admit_s, "seed": args.seed,
+                   "makespan_s": round(sim["makespan_s"], 3)})
+    else:
+        overrides = {}
+        for pair in args.overrides or ():
+            k, v = pair.split("=", 1)
+            try:
+                overrides[k] = json.loads(v)
+            except ValueError:
+                overrides[k] = v
+        res = live(args.url, args.tenants, args.jobs, args.workload,
+                   overrides, args.timeout)
+        record = build_record(res["waits"], res["turnarounds"],
+                              res["rejected"], args.tenants,
+                              args.workers, args.jobs, "live",
+                              extra={"url": args.url})
+
+    print(json.dumps(record))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+    rc = 0
+    ratio = record["p99_over_p50"]
+    if (args.require_p99_ratio is not None and ratio is not None
+            and ratio > args.require_p99_ratio):
+        print(f"loadtest: p99/p50 {ratio} exceeds "
+              f"{args.require_p99_ratio}", file=sys.stderr)
+        rc = 1
+    if (args.require_fairness is not None
+            and record["value"] < args.require_fairness):
+        print(f"loadtest: Jain {record['value']} below "
+              f"{args.require_fairness}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

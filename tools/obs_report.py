@@ -18,7 +18,14 @@ and each run's R-hat trajectory from its ``diag`` stream. A Timing
 section renders the tracing subsystem's output (obs.trace spans +
 obs.metrics snapshots): per-phase wall-clock breakdown, the slowest
 individual spans, and each run's p50/p95/p99 chunk-latency and flips/s
-histograms. A trailing sweep section summarizes driver progress events.
+histograms. A Fleet section summarizes worker-fleet streams (PR 17):
+per-worker lease claims/reclaims, worker start/exit pairing (a SIGKILL
+leaves a start with no exit), lease expirations, quota rejections by
+tenant, http request status mix, and p50/p99 queue-to-start measured
+job_submitted -> first lease_acquired; ``--strict`` also fails on a
+lease-expiry STORM (more than 2 expirations for one job — lease churn,
+not crash recovery). A trailing sweep section summarizes driver
+progress events.
 
 ``--check`` validates every line against the event schema
 (obs.events.EVENT_FIELDS envelope + per-type core fields) AND the span
@@ -543,6 +550,130 @@ def report_control(events, out):
               f"| {shown or '-'} |", file=out)
 
 
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def lease_storms(events, threshold: int = 2) -> dict:
+    """``{job_id: n_expirations}`` for jobs whose lease expired MORE
+    than ``threshold`` times. One expiration per job is the designed
+    crash story (a SIGKILLed worker's lease reclaimed once); two can
+    happen when the reclaimer itself dies; more means the TTL is
+    shorter than the heartbeat can sustain (or a reclaim livelock) —
+    the fleet is churning leases instead of running jobs. ``--strict``
+    fails on any storm."""
+    per_job: dict = {}
+    for e in events:
+        if e["event"] == "lease_expired" and e.get("job_id"):
+            per_job[e["job_id"]] = per_job.get(e["job_id"], 0) + 1
+    return {j: n for j, n in per_job.items() if n > threshold}
+
+
+def report_fleet(events, out):
+    """The worker-fleet section (PR 17): per-worker job counts from
+    lease_acquired, lease expirations (the crash-reclaim story), quota
+    rejections by tenant, worker start/exit pairing (a SIGKILL leaves a
+    start with no exit), and p50/p99 queue-to-start measured
+    job_submitted -> first lease_acquired per job. Rendered only when
+    the stream carries fleet events — single-process sweeps stay
+    byte-identical."""
+    acquired = [e for e in events if e["event"] == "lease_acquired"]
+    expired = [e for e in events if e["event"] == "lease_expired"]
+    quota = [e for e in events if e["event"] == "quota_rejected"]
+    started = [e for e in events if e["event"] == "worker_started"]
+    exited = [e for e in events if e["event"] == "worker_exited"]
+    requests = [e for e in events if e["event"] == "http_request"]
+    if not (acquired or expired or quota or started or exited
+            or requests):
+        return
+
+    print("\n## Fleet", file=out)
+    if requests:
+        by_status: dict = {}
+        for e in requests:
+            by_status[e["status"]] = by_status.get(e["status"], 0) + 1
+        durs = sorted(e.get("dur_s", 0.0) for e in requests)
+        print(f"{len(requests)} http request(s): "
+              + ", ".join(f"{n}x {s}"
+                          for s, n in sorted(by_status.items()))
+              + f"; p50 {_pctl(durs, 0.5):.4f}s "
+              f"p99 {_pctl(durs, 0.99):.4f}s", file=out)
+
+    if acquired or started or exited:
+        by_worker: dict = {}
+        for e in started:
+            by_worker.setdefault(e.get("worker", "?"),
+                                 {"claims": 0, "reclaims": 0,
+                                  "started": 0, "exit": None})
+        for e in acquired:
+            w = by_worker.setdefault(e.get("worker", "?"),
+                                     {"claims": 0, "reclaims": 0,
+                                      "started": 0, "exit": None})
+            w["claims"] += 1
+            if e.get("reclaim"):
+                w["reclaims"] += 1
+        for e in started:
+            by_worker[e.get("worker", "?")]["started"] += 1
+        for e in exited:
+            w = by_worker.setdefault(e.get("worker", "?"),
+                                     {"claims": 0, "reclaims": 0,
+                                      "started": 0, "exit": None})
+            w["exit"] = (f"{e.get('reason', '?')}"
+                         f"/{e.get('n_executed', '?')} job(s)")
+        print("\n| worker | claims | reclaims | exit |", file=out)
+        print("|---|---|---|---|", file=out)
+        for name in sorted(by_worker):
+            w = by_worker[name]
+            exit_cell = w["exit"] or (
+                "NO EXIT (SIGKILL?)" if w["started"] else "-")
+            print(f"| {name} | {w['claims']} | {w['reclaims']} "
+                  f"| {exit_cell} |", file=out)
+
+    # queue-to-start: submission to FIRST claim (reclaims after a crash
+    # keep the original anchor, matching the started/ marker on disk)
+    submitted_ts = {}
+    for e in events:
+        if e["event"] == "job_submitted" and e.get("job_id"):
+            submitted_ts.setdefault(e["job_id"], e["ts"])
+    first_claim = {}
+    for e in acquired:
+        if e.get("job_id") in submitted_ts:
+            first_claim.setdefault(e["job_id"], e["ts"])
+    waits = sorted(first_claim[j] - submitted_ts[j]
+                   for j in first_claim)
+    if waits:
+        print(f"\nqueue-to-start over {len(waits)} job(s): "
+              f"p50 {_pctl(waits, 0.5):.3f}s "
+              f"p99 {_pctl(waits, 0.99):.3f}s "
+              f"max {waits[-1]:.3f}s", file=out)
+
+    if expired:
+        by_job: dict = {}
+        for e in expired:
+            by_job.setdefault(e.get("job_id", "?"), []).append(e)
+        print(f"\n{len(expired)} lease expiration(s):", file=out)
+        for job_id in sorted(by_job):
+            es = by_job[job_id]
+            detail = "; ".join(
+                f"{e.get('worker', '?')} -> {e.get('by', '?')} "
+                f"(age {e.get('age_s', '?')}s)" for e in es)
+            storm = "  ← STORM" if len(es) > 2 else ""
+            print(f"- {job_id}: {detail}{storm}", file=out)
+
+    if quota:
+        by_tenant: dict = {}
+        for e in quota:
+            by_tenant[e.get("tenant", "?")] = (
+                by_tenant.get(e.get("tenant", "?"), 0) + 1)
+        print("\nquota rejections: "
+              + ", ".join(f"{t}={n}"
+                          for t, n in sorted(by_tenant.items())),
+              file=out)
+
+
 def _namespaced_heartbeat_path(path: str, tag: str) -> str:
     # mirror of experiments.driver.heartbeat_path_for (this tool must
     # stay importable without jax): heartbeat.json + 2B30P10 ->
@@ -698,6 +829,7 @@ def main(argv=None):
     report_timing(events, runs, out)
     report_resilience(events, out)
     report_control(events, out)
+    report_fleet(events, out)
     report_sweep(events, out)
     hb_error = None
     if args.heartbeat:
@@ -720,6 +852,15 @@ def main(argv=None):
         if bad_kinds:
             print("--strict: " + ", ".join(bad_kinds)
                   + " event(s) in stream", file=sys.stderr)
+            return 2
+        storms = lease_storms(events)
+        if storms:
+            print("--strict: lease-expiry storm — "
+                  + ", ".join(f"{j} expired {n}x"
+                              for j, n in sorted(storms.items()))
+                  + " (> 2 expirations for one job: the fleet is "
+                  "churning leases, not running jobs)",
+                  file=sys.stderr)
             return 2
         if hb_error:
             print(f"--strict: {hb_error}", file=sys.stderr)

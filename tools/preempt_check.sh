@@ -28,8 +28,12 @@ trap 'rm -rf "$TD"' EXIT
 # one persistent XLA cache across every leg: a recovered process must
 # not re-pay the drained process's compiles (the PR 9 on-disk cache is
 # exactly the restart story this gate exercises), and it keeps the
-# 5-process gate inside the tier-1 time budget.
-export JAX_COMPILATION_CACHE_DIR="$TD/jax-cache"
+# 5-process gate inside the tier-1 time budget. The cache lives at a
+# STABLE path (not in $TD) so repeat gate runs — tier-1 wraps this
+# script — skip the cold compiles too; nothing here asserts on XLA's
+# cache behavior, only on bit-identity of the results.
+export JAX_COMPILATION_CACHE_DIR="${GRAFT_GATE_JAX_CACHE:-${TMPDIR:-/tmp}/graft-gate-jax-cache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
 
 FAMILIES="${PREEMPT_FAMILIES:-frank hex}"

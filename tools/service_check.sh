@@ -19,6 +19,14 @@ PY="${PYTHON:-python}"
 TD="$(mktemp -d)"
 trap 'rm -rf "$TD"' EXIT
 
+# stable XLA cache across gate runs (tier-1 wraps this script): the
+# compile_cache_miss assertions below count the service's OWN on-disk
+# CompileCache index — a different layer — so XLA cache warmth never
+# changes them, only the wall clock
+export JAX_COMPILATION_CACHE_DIR="${GRAFT_GATE_JAX_CACHE:-${TMPDIR:-/tmp}/graft-gate-jax-cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 JAX_PLATFORMS=cpu "$PY" - "$TD" <<'PYEOF'
 import json
 import os
