@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test obs-check mesh-check chaos-check bitpack-check \
 	service-check preempt-check control-check workload-check \
-	dense-check fleet-check lint
+	dense-check fleet-check obsfleet-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -77,6 +77,16 @@ dense-check:
 # execution, Jain fairness >= 0.8, schema-valid event streams
 fleet-check:
 	PYTHON=$(PYTHON) tools/fleet_check.sh
+
+# fleet observability gate (ISSUE 18): 2-worker fleet smoke over the
+# canonical $ROOT/events/ layout — mid-run /v1/metrics + /v1/fleet
+# scrape, on-demand profile marker honored at a segment boundary and
+# published as an artifact, per-worker heartbeat docs, the
+# trace_export --fleet end-to-end trace-parenting gate, the SLO
+# section with --strict tripping on an injected lease-expiry storm,
+# and the <= 2% collector-overhead microbench gate
+obsfleet-check:
+	PYTHON=$(PYTHON) tools/obsfleet_check.sh
 
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

@@ -27,7 +27,7 @@ from .recorder import (NULL, JitWatch, NullRecorder, Recorder, aot_cost,
                        dict_nbytes, from_spec, jit_cache_size,
                        per_host_path, profile_region, resolve_recorder,
                        set_default_recorder)
-from .trace import Span, emit_span_at, span, traced
+from .trace import Span, adopt, emit_span_at, span, traced
 
 __all__ = [
     "EVENT_FIELDS", "SCHEMA_VERSION", "SWEEP_STATUSES",
@@ -36,8 +36,8 @@ __all__ = [
     "default_recorder", "set_default_recorder", "resolve_recorder",
     "from_spec", "per_host_path", "profile_region", "jit_cache_size",
     "dict_nbytes", "aot_cost", "device_memory_snapshot",
-    "Span", "span", "traced", "emit_span_at",
-    "Histogram", "MetricsRegistry",
+    "Span", "span", "traced", "emit_span_at", "adopt",
+    "Histogram", "MetricsRegistry", "FleetCollector",
 ]
 
 
@@ -47,4 +47,7 @@ def __getattr__(name):
     if name == "ChainMonitor":
         from .monitor import ChainMonitor
         return ChainMonitor
+    if name == "FleetCollector":
+        from .aggregate import FleetCollector
+        return FleetCollector
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

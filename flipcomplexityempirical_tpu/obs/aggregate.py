@@ -1,0 +1,335 @@
+"""Live fleet aggregation: incremental tailing of every event stream
+under a shared fleet root (ISSUE 18).
+
+``FleetCollector`` is the read side of the fleet observability plane.
+A fleet run (service.server + N service.worker processes) appends
+schema-versioned JSONL to ``<root>/events/<name>.jsonl`` — one file per
+process, one writer per file (the journal's discipline). The collector
+tails all of them *incrementally*:
+
+* **File-offset checkpoints.** Each ``poll()`` reads only bytes past
+  the last checkpointed offset per stream, folds the new events into
+  its aggregate state, and atomically rewrites
+  ``<root>/events/.collector.json`` (tmp + fsync + rename — the same
+  recipe as every other atomic doc in the fleet root). A restarted
+  collector — the server process bounced — resumes from the checkpoint
+  without re-counting a single event.
+* **Torn-tail tolerant.** Only complete, newline-terminated lines are
+  consumed; a line still being written (or torn by a SIGKILL) stays in
+  the file past the offset and is re-read whole on the next poll, the
+  journal reader's tolerance applied to live tailing. A stream that
+  SHRANK (rotation, truncation) resets to offset 0 rather than reading
+  garbage from the middle of a new file.
+* **Host-side only.** The collector reads files and parses JSON;
+  it never touches jax, device memory, or the run loop (PROFILE.md's
+  no-extra-device-syncs rule extends to observers). The injected
+  ``clock`` keeps staleness math testable on a virtual clock.
+
+Aggregate state feeds the server's two read-only surfaces:
+``prometheus_text()`` renders the Prometheus text exposition served at
+``GET /v1/metrics`` (per-worker counters/gauges/histogram percentiles
+from the newest ``metrics_snapshot`` per stream, plus fleet rollups),
+and ``fleet_doc()`` the JSON topology at ``GET /v1/fleet`` (workers,
+job stages, per-stream tailing positions). Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+_CKPT_NAME = ".collector.json"
+_STATE_V = 1
+
+# stream-derived stages stop at "running": terminal stages live in the
+# server's status files (the authoritative merge happens in /v1/fleet),
+# because worker-internal sweep events reuse the fleet's job-id space
+_STAGE_QUEUED = "queued"
+_STAGE_RUNNING = "running"
+
+
+def _atomic_write(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, separators=(",", ":"), sort_keys=True)
+        f.flush()
+        try:
+            os.fsync(f.fileno())
+        except OSError:
+            pass
+    os.replace(tmp, path)
+
+
+class FleetCollector:
+    """Incremental aggregator over ``<root>/events/*.jsonl``.
+
+    ``poll()`` is the only mutator; everything else renders the state
+    it left behind. Thread-unsafe by design — the server serializes
+    access behind its own lock (one collector per server process, the
+    same one-writer-per-file discipline the checkpoint itself needs).
+
+    ``checkpoint=False`` reads without ever writing the checkpoint file
+    (tools pointed at a fixture directory they must not dirty).
+    """
+
+    def __init__(self, root, clock=time.time, checkpoint=True):
+        self.root = root
+        self.events_dir = os.path.join(root, "events")
+        self.clock = clock
+        self.checkpoint = checkpoint
+        self._ckpt_path = os.path.join(self.events_dir, _CKPT_NAME)
+        self.state = {"v": _STATE_V, "streams": {}, "jobs": {},
+                      "workers": {}}
+        if checkpoint:
+            try:
+                with open(self._ckpt_path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                if doc.get("v") == _STATE_V:
+                    self.state = doc
+            except (OSError, ValueError):
+                pass        # fresh or torn checkpoint: start from zero
+
+    # -- tailing -------------------------------------------------------
+
+    def _stream_names(self):
+        try:
+            names = os.listdir(self.events_dir)
+        except OSError:
+            return []
+        return sorted(n for n in names
+                      if n.endswith(".jsonl") and not n.startswith("."))
+
+    def poll(self) -> dict:
+        """Tail every stream from its checkpointed offset, fold new
+        events, persist the checkpoint; returns a small summary of the
+        increment ({"events": n, "streams": k})."""
+        new_events = 0
+        for name in self._stream_names():
+            path = os.path.join(self.events_dir, name)
+            st = self.state["streams"].setdefault(
+                name, {"offset": 0, "events": {}, "last_ts": None,
+                       "ident": {}, "snapshot": None, "malformed": 0})
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size < st["offset"]:
+                st["offset"] = 0        # rotated/truncated: re-read
+            if size == st["offset"]:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(st["offset"])
+                    buf = f.read(size - st["offset"])
+            except OSError:
+                continue
+            # consume only complete lines; a torn tail waits for the
+            # writer to finish it
+            cut = buf.rfind(b"\n")
+            if cut < 0:
+                continue
+            for raw in buf[:cut].split(b"\n"):
+                if not raw.strip():
+                    continue
+                try:
+                    ev = json.loads(raw)
+                except ValueError:
+                    st["malformed"] += 1
+                    continue
+                if not isinstance(ev, dict) or "event" not in ev:
+                    st["malformed"] += 1
+                    continue
+                self._fold(name, st, ev)
+                new_events += 1
+            st["offset"] += cut + 1
+        if self.checkpoint:
+            try:
+                _atomic_write(self._ckpt_path, self.state)
+            except OSError:
+                pass        # a read-only root degrades to re-counting
+        return {"events": new_events,
+                "streams": len(self.state["streams"])}
+
+    def _fold(self, stream: str, st: dict, ev: dict) -> None:
+        kind = ev["event"]
+        st["events"][kind] = st["events"].get(kind, 0) + 1
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            if st["last_ts"] is None or ts > st["last_ts"]:
+                st["last_ts"] = ts
+        for key in ("pid", "worker_name"):
+            if key in ev:
+                st["ident"][key] = ev[key]
+        if kind == "metrics_snapshot":
+            st["snapshot"] = {"counters": ev.get("counters") or {},
+                              "gauges": ev.get("gauges") or {},
+                              "histograms": ev.get("histograms") or {},
+                              "ts": ts}
+            return
+        jobs = self.state["jobs"]
+        workers = self.state["workers"]
+        if kind == "job_submitted":
+            # only the SERVER's submission event carries the fleet
+            # stage; a worker-internal sweep-queue job_submitted reuses
+            # the same id space (its service's own j0000...) but never
+            # carries a trace_id — folding it would alias fleet jobs
+            if "trace_id" in ev:
+                job = jobs.setdefault(ev.get("job_id"), {})
+                job.setdefault("stage", _STAGE_QUEUED)
+                job["tenant"] = ev.get("tenant")
+                job["trace_id"] = ev.get("trace_id")
+                job["submitted_ts"] = ts
+        elif kind == "lease_acquired":
+            job = jobs.setdefault(ev.get("job_id"), {})
+            job["stage"] = _STAGE_RUNNING
+            job["worker"] = ev.get("worker")
+            job.setdefault("started_ts", ts)
+            if ev.get("reclaim"):
+                job["reclaims"] = job.get("reclaims", 0) + 1
+        elif kind == "lease_expired":
+            job = jobs.setdefault(ev.get("job_id"), {})
+            job["expired"] = job.get("expired", 0) + 1
+        elif kind == "worker_started":
+            w = workers.setdefault(ev.get("worker"), {})
+            w.update({"stream": stream, "started_ts": ts,
+                      "pid": ev.get("pid"), "exited": False})
+        elif kind == "worker_exited":
+            w = workers.setdefault(ev.get("worker"), {})
+            w.update({"exited": True, "reason": ev.get("reason"),
+                      "exited_ts": ts})
+        elif kind == "profile_captured":
+            job = jobs.setdefault(ev.get("job_id"), {})
+            job["profiled_segments"] = ev.get("segments")
+
+    # -- render --------------------------------------------------------
+
+    def fleet_doc(self) -> dict:
+        """JSON topology for ``GET /v1/fleet`` — live view of whatever
+        the streams said so far (the server merges in its own queue
+        depth, which never transits a stream)."""
+        now = self.clock()
+        streams = {}
+        for name, st in sorted(self.state["streams"].items()):
+            streams[name] = {
+                "offset": st["offset"],
+                "events": sum(st["events"].values()),
+                "malformed": st["malformed"],
+                "ident": st["ident"],
+                "idle_s": (None if st["last_ts"] is None
+                           else max(0.0, now - st["last_ts"])),
+            }
+        stages: dict = {}
+        for job in self.state["jobs"].values():
+            stage = job.get("stage") or "unknown"
+            stages[stage] = stages.get(stage, 0) + 1
+        return {"workers": self.state["workers"],
+                "jobs": self.state["jobs"],
+                "stages": stages,
+                "streams": streams}
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (version 0.0.4) for
+        ``GET /v1/metrics``: per-stream event counts, the newest
+        MetricsRegistry snapshot per stream (counters, gauges, and
+        histogram count/sum/percentiles), and fleet rollups."""
+        lines = []
+
+        def sample(name, labels, value):
+            if value is None:
+                return
+            if labels:
+                body = ",".join(f'{k}="{v}"'
+                                for k, v in sorted(labels.items()))
+                lines.append(f"{name}{{{body}}} {_num(value)}")
+            else:
+                lines.append(f"{name} {_num(value)}")
+
+        streams = self.state["streams"]
+        lines.append("# HELP graft_events_total events consumed per "
+                     "stream, by type")
+        lines.append("# TYPE graft_events_total counter")
+        for sname, st in sorted(streams.items()):
+            stream = _stream_label(sname)
+            for kind, n in sorted(st["events"].items()):
+                sample("graft_events_total",
+                       {"stream": stream, "event": kind}, n)
+        lines.append("# HELP graft_stream_offset_bytes checkpointed "
+                     "tail offset per stream")
+        lines.append("# TYPE graft_stream_offset_bytes gauge")
+        for sname, st in sorted(streams.items()):
+            sample("graft_stream_offset_bytes",
+                   {"stream": _stream_label(sname)}, st["offset"])
+
+        # newest per-stream MetricsRegistry snapshot
+        lines.append("# HELP graft_counter MetricsRegistry counters "
+                     "(newest snapshot per stream)")
+        lines.append("# TYPE graft_counter gauge")
+        roll_counters: dict = {}
+        for sname, st in sorted(streams.items()):
+            snap = st.get("snapshot")
+            if not snap:
+                continue
+            stream = _stream_label(sname)
+            for k, v in sorted(snap["counters"].items()):
+                sample("graft_counter", {"stream": stream, "name": k}, v)
+                roll_counters[k] = roll_counters.get(k, 0) + v
+        lines.append("# HELP graft_gauge MetricsRegistry gauges "
+                     "(newest snapshot per stream)")
+        lines.append("# TYPE graft_gauge gauge")
+        for sname, st in sorted(streams.items()):
+            snap = st.get("snapshot")
+            if not snap:
+                continue
+            stream = _stream_label(sname)
+            for k, v in sorted(snap["gauges"].items()):
+                sample("graft_gauge", {"stream": stream, "name": k}, v)
+        lines.append("# HELP graft_histogram MetricsRegistry histogram "
+                     "digests (newest snapshot per stream)")
+        lines.append("# TYPE graft_histogram gauge")
+        for sname, st in sorted(streams.items()):
+            snap = st.get("snapshot")
+            if not snap:
+                continue
+            stream = _stream_label(sname)
+            for k, h in sorted(snap["histograms"].items()):
+                for stat in ("count", "sum", "p50", "p95", "p99"):
+                    sample("graft_histogram",
+                           {"stream": stream, "name": k, "stat": stat},
+                           h.get(stat))
+
+        # fleet rollups
+        lines.append("# HELP graft_fleet_counter fleet-wide rollup of "
+                     "MetricsRegistry counters")
+        lines.append("# TYPE graft_fleet_counter gauge")
+        for k, v in sorted(roll_counters.items()):
+            sample("graft_fleet_counter", {"name": k}, v)
+        workers = self.state["workers"]
+        lines.append("# HELP graft_fleet_workers fleet worker "
+                     "processes by liveness")
+        lines.append("# TYPE graft_fleet_workers gauge")
+        live = sum(1 for w in workers.values() if not w.get("exited"))
+        sample("graft_fleet_workers", {"state": "live"}, live)
+        sample("graft_fleet_workers", {"state": "exited"},
+               len(workers) - live)
+        lines.append("# HELP graft_fleet_jobs fleet jobs by stage")
+        lines.append("# TYPE graft_fleet_jobs gauge")
+        stages: dict = {}
+        for job in self.state["jobs"].values():
+            stage = job.get("stage") or "unknown"
+            stages[stage] = stages.get(stage, 0) + 1
+        for stage, n in sorted(stages.items()):
+            sample("graft_fleet_jobs", {"stage": stage}, n)
+        return "\n".join(lines) + "\n"
+
+
+def _stream_label(name: str) -> str:
+    return name[:-len(".jsonl")] if name.endswith(".jsonl") else name
+
+
+def _num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))

@@ -162,6 +162,12 @@ Core field semantics:
   is the stable worker id; ``reason`` on exit is ``idle`` / ``drain``
   / ``done`` / an error class. A SIGKILLed worker has a start with no
   exit — obs_report's Fleet section surfaces the asymmetry.
+- ``profile_captured``: the owning worker honored an on-demand
+  profiling marker (``POST /v1/profile/<job>``) at a segment boundary
+  and closed the capture. ``segments`` counts the boundaries actually
+  bracketed by ``jax.profiler.trace``; ``ok=False`` (extras carry the
+  error string) means capture degraded to a graceful no-op — e.g. no
+  profiler backend on CPU — while the run itself proceeded untouched.
 
 Adding a new event *type* (as ``diag``/``anomaly`` were added) does NOT
 bump SCHEMA_VERSION: readers fold by type and validation rejects only
@@ -346,6 +352,12 @@ EVENT_REGISTRY = {
         "fields": ("worker", "reason"),
         "doc": "fleet worker stopped: idle / drain / done / error "
                "class (a SIGKILL leaves no exit event)",
+    },
+    "profile_captured": {
+        "fields": ("job_id", "segments", "ok"),
+        "doc": "on-demand device profile finished: segments actually "
+               "bracketed by jax.profiler.trace (ok=False extras "
+               "carry the error when capture degraded to a no-op)",
     },
 }
 

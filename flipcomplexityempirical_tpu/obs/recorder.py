@@ -47,6 +47,7 @@ class NullRecorder:
     anomaly_hook = None
     metrics_hook = None
     run_meta: dict = {}
+    ident: dict = {}
 
     def __bool__(self):
         return False
@@ -102,7 +103,7 @@ class Recorder:
     anomaly_hook = None
     metrics_hook = None
 
-    def __init__(self, path=None, stream=None):
+    def __init__(self, path=None, stream=None, ident=None):
         if path is None and stream is None:
             raise ValueError("Recorder needs a path and/or a stream "
                              "(use obs.NULL for the no-op recorder)")
@@ -111,6 +112,12 @@ class Recorder:
         # runner's run_start then carries it without the runners
         # knowing). Explicit emit kwargs win on collision.
         self.run_meta: dict = {}
+        # Opt-in process identity stamped into EVERY event (fleet
+        # processes pass e.g. {"pid": ..., "worker_name": ...} so a
+        # multi-stream merge never needs filename heuristics). Additive:
+        # the default empty dict keeps single-process streams
+        # byte-compatible; explicit emit kwargs win on collision.
+        self.ident: dict = dict(ident or {})
         self.path = path
         if path:
             # the sweep CLI defaults the stream into its --out directory,
@@ -152,6 +159,8 @@ class Recorder:
                "event": event}
         if event == "run_start" and self.run_meta:
             obj.update(self.run_meta)
+        if self.ident:
+            obj.update(self.ident)
         obj.update(fields)
         line = json.dumps(obj, separators=(",", ":"), default=_jsonable)
         if self._file is not None:
@@ -211,17 +220,20 @@ def per_host_path(path, index=None):
     return f"{root}.host{idx}{ext}"
 
 
-def from_spec(spec, per_host=False):
+def from_spec(spec, per_host=False, ident=None):
     """CLI convenience: ``None``/empty -> NULL, ``"-"`` -> stderr
     stream, anything else -> append-to-file Recorder (the ``--events``
     flag of bench.py and experiments/__main__.py). A ``.gz`` path gets a
     gzip sink; ``per_host=True`` routes multi-host processes through
-    ``per_host_path`` (sharded runs — see distribute.sharded)."""
+    ``per_host_path`` (sharded runs — see distribute.sharded);
+    ``ident`` stamps process identity into every event (the fleet
+    CLIs — see Recorder)."""
     if not spec:
         return NULL
     if spec == "-":
-        return Recorder(stream=sys.stderr)
-    return Recorder(path=per_host_path(spec) if per_host else spec)
+        return Recorder(stream=sys.stderr, ident=ident)
+    return Recorder(path=per_host_path(spec) if per_host else spec,
+                    ident=ident)
 
 
 _default = NULL

@@ -1,0 +1,239 @@
+"""Declarative fleet SLOs evaluated as burn rates (ISSUE 18).
+
+An SLO spec is a plain dict — ``name``, ``kind`` (which evaluator
+runs), ``target``, and evaluator-specific knobs. :func:`evaluate` folds
+a fleet event timeline (merged or per-stream JSONL events, already
+parsed) through every spec and returns one result row per spec:
+
+    {"name", "kind", "target", "value", "burn", "ok", "count",
+     "detail"}
+
+``burn`` is the burn *rate*: observed badness over allowed badness,
+normalized so ``burn <= 1.0`` means the objective holds and ``burn ==
+2.0`` means the error budget is being consumed at twice the sustainable
+pace. ``obs_report.py`` renders the rows as the "SLO" section and
+``--strict`` turns any ``ok=False`` row into a nonzero exit. Specs
+with fewer than ``min_count`` observations pass vacuously (``burn
+0.0``) — a two-job smoke must not trip a tail-latency objective that
+needs a population.
+
+The four defaults are the fleet's serving objectives:
+
+* ``queue_to_start_tail`` — p99/p50 of queue-to-start (submission to
+  first lease claim) ≤ ``target``. Tail fairness: an even fleet keeps
+  the ratio near 1; stragglers blow the p99 first (ROADMAP's 500-tenant
+  axis measures the same ratio via tools/loadtest.py).
+* ``lease_expiry_rate`` — lease expirations per minute, taken over the
+  worst ``window_s`` window of the timeline (a storm is a burst, not
+  an average), ≤ ``target``.
+* ``throughput_floor`` — per kernel path, the slowest run's flips/s
+  must stay ≥ ``target`` × that path's median (self-referential floor:
+  no hardware constants, trips on a straggler run, not a slow machine).
+  The first run of each (process, path, shape) group is warmup — it
+  pays that specialization's jit compile — and is excluded; the
+  objective judges steady-state serving.
+* ``compile_cache_hit_ratio`` — cache hits / repeat probes ≥
+  ``target``; a fleet that recompiles per job starves the accelerator
+  on host time. Each key's first-seen miss is compulsory (no cache hits
+  a key it has never seen) and excluded.
+
+Stdlib-only, no intra-package imports: tools/obs_report.py loads this
+module by file path (like obs/events.py), outside the package.
+"""
+
+from __future__ import annotations
+
+__all__ = ["DEFAULT_SLOS", "evaluate"]
+
+DEFAULT_SLOS = (
+    {"name": "queue_to_start_tail", "kind": "queue_tail_ratio",
+     "target": 8.0, "min_count": 4},
+    {"name": "lease_expiry_rate", "kind": "lease_expiry_rate",
+     "target": 2.0, "window_s": 60.0, "min_count": 0},
+    {"name": "throughput_floor", "kind": "throughput_floor",
+     "target": 0.2, "min_count": 2},
+    {"name": "compile_cache_hit_ratio", "kind": "cache_hit_ratio",
+     "target": 0.25, "min_count": 4},
+)
+
+
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def _queue_tail_ratio(events, spec):
+    """(value, count, detail): p99/p50 over per-job queue-to-start."""
+    submitted: dict = {}
+    started: dict = {}
+    for e in events:
+        jid = e.get("job_id")
+        if jid is None:
+            continue
+        if e.get("event") == "job_submitted":
+            ts = e.get("ts")
+            if ts is not None and (jid not in submitted
+                                   or ts < submitted[jid]):
+                submitted[jid] = ts
+        elif e.get("event") == "lease_acquired" and jid not in started:
+            started[jid] = e.get("ts")
+    waits = sorted(started[j] - submitted[j] for j in started
+                   if j in submitted and started[j] is not None
+                   and started[j] >= submitted[j])
+    if not waits:
+        return None, 0, "no queue-to-start pairs"
+    p50, p99 = _pctl(waits, 0.5), _pctl(waits, 0.99)
+    if p50 <= 0.0:
+        # sub-resolution waits: an idle fleet's p50 rounds to ~0;
+        # a ratio over it is noise, not a tail
+        return 1.0, len(waits), f"p50~0s over {len(waits)} jobs"
+    return (p99 / p50, len(waits),
+            f"p50={p50:.3f}s p99={p99:.3f}s over {len(waits)} jobs")
+
+
+def _lease_expiry_rate(events, spec):
+    """(value, count, detail): expirations/min, worst window."""
+    window = float(spec.get("window_s", 60.0))
+    times = sorted(e["ts"] for e in events
+                   if e.get("event") == "lease_expired"
+                   and e.get("ts") is not None)
+    if not times:
+        return 0.0, 0, "no lease expirations"
+    worst, lo = 0, 0
+    for hi in range(len(times)):
+        while times[hi] - times[lo] > window:
+            lo += 1
+        worst = max(worst, hi - lo + 1)
+    rate = worst / (window / 60.0)
+    return (rate, len(times),
+            f"{len(times)} total, worst {worst}/{window:.0f}s window")
+
+
+def _throughput_floor(events, spec):
+    """(value, count, detail): min over paths of (slowest run flips/s
+    over the path's median), after warmup exclusion — the FIRST run of
+    each (process, path, shape) group pays that specialization's jit
+    compile, which is cold-start cost, not a straggler (the objective
+    is about steady-state serving)."""
+    groups: dict = {}
+    for e in events:
+        if e.get("event") != "run_end":
+            continue
+        fps = e.get("flips_per_s")
+        path = e.get("kernel_path") or e.get("path")
+        if isinstance(fps, (int, float)) and fps > 0 and path:
+            proc = e.get("worker_name") or e.get("pid")
+            shape = (path, proc, e.get("chains"), e.get("n_yields"))
+            groups.setdefault(shape, []).append(
+                (e.get("ts") or 0.0, float(fps)))
+    per_path: dict = {}
+    warmups = 0
+    for (path, *_shape), runs in groups.items():
+        runs.sort()
+        warmups += 1
+        for _ts, fps in runs[1:]:
+            per_path.setdefault(path, []).append(fps)
+    n = sum(len(v) for v in per_path.values())
+    if not per_path:
+        if groups:
+            return (None, 0, f"only warmup runs ({warmups} group(s) "
+                             "of one)")
+        return None, 0, "no run_end throughput samples"
+    worst, worst_path = None, None
+    for path, vals in sorted(per_path.items()):
+        vals.sort()
+        ratio = vals[0] / _pctl(vals, 0.5)
+        if worst is None or ratio < worst:
+            worst, worst_path = ratio, path
+    return (worst, n,
+            f"slowest/median={worst:.3f} on {worst_path} "
+            f"({n} steady-state runs, {len(per_path)} path(s), "
+            f"{warmups} warmup(s) excluded)")
+
+
+def _cache_hit_ratio(events, spec):
+    """(value, count, detail): compile-cache hits over repeat probes.
+    Each key's FIRST probe is a compulsory miss — no cache can hit a
+    key it has never seen — so cold-start misses are excluded and the
+    ratio judges only probes the cache had a chance to serve. Probes
+    without a ``key`` field (older streams) count as repeats."""
+    hits = 0
+    repeats = 0
+    cold = 0
+    seen: set = set()
+    for e in events:
+        ev = e.get("event")
+        if ev not in ("compile_cache_hit", "compile_cache_miss"):
+            continue
+        key = e.get("key")
+        first = key is not None and key not in seen
+        if key is not None:
+            seen.add(key)
+        if first and ev == "compile_cache_miss":
+            cold += 1          # compulsory; a first-seen HIT still
+            continue           # counts (persistent index pre-warm)
+        repeats += 1
+        if ev == "compile_cache_hit":
+            hits += 1
+    if repeats == 0:
+        if cold:
+            return None, 0, f"only cold misses ({cold} first-seen key(s))"
+        return None, 0, "no compile-cache probes"
+    return (hits / repeats, repeats,
+            f"{hits} hit(s) / {repeats} repeat probe(s) "
+            f"({cold} cold)")
+
+
+_EVALUATORS = {
+    "queue_tail_ratio": _queue_tail_ratio,
+    "lease_expiry_rate": _lease_expiry_rate,
+    "throughput_floor": _throughput_floor,
+    "cache_hit_ratio": _cache_hit_ratio,
+}
+
+
+def _burn(kind, value, target):
+    """Normalize to a burn rate: >1.0 means the objective is violated.
+    Ratio-above-target objectives burn as value/target; floor-below-
+    target objectives burn as target/value; hit-ratio burns as the
+    consumed fraction of the error budget (1-target)."""
+    if value is None:
+        return 0.0
+    if kind in ("queue_tail_ratio", "lease_expiry_rate"):
+        return value / target if target > 0 else 0.0
+    if kind == "throughput_floor":
+        return target / value if value > 0 else float("inf")
+    if kind == "cache_hit_ratio":
+        budget = 1.0 - target
+        return (1.0 - value) / budget if budget > 0 else 0.0
+    raise ValueError(f"unknown SLO kind {kind!r}")
+
+
+def evaluate(events, specs=DEFAULT_SLOS):
+    """Evaluate every spec over one event timeline; returns the result
+    rows in spec order. ``events`` is an iterable of parsed event dicts
+    (any mix of fleet streams; ordering does not matter)."""
+    events = list(events)
+    results = []
+    for spec in specs:
+        kind = spec["kind"]
+        fn = _EVALUATORS.get(kind)
+        if fn is None:
+            raise ValueError(f"unknown SLO kind {kind!r}")
+        value, count, detail = fn(events, spec)
+        target = float(spec["target"])
+        min_count = int(spec.get("min_count", 0))
+        if count < min_count:
+            burn, ok = 0.0, True
+            detail += f" — vacuous (n={count} < {min_count})"
+        else:
+            burn = _burn(kind, value, target)
+            ok = burn <= 1.0
+        results.append({"name": spec["name"], "kind": kind,
+                        "target": target, "value": value,
+                        "burn": burn, "ok": ok, "count": count,
+                        "detail": detail})
+    return results

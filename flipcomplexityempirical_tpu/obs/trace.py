@@ -21,6 +21,12 @@ span stack is per-thread (``threading.local``), and each ``span_begin``
 carries a compact ``tid`` so the exporter can lay threads on separate
 tracks.
 
+Cross-process (the fleet): :func:`adopt` scopes a foreign trace context
+onto the current thread so a worker's spans join the submitting
+request's trace — same ``trace_id``, per-stream lineage, the foreign
+parent attached as an additive ``ctx_parent_id`` field (details on
+:func:`adopt`).
+
 Hot-path contract (mirrors the rest of obs — see PROFILE.md):
 
 * ``span(rec, ...)`` with a falsy recorder returns a shared no-op span —
@@ -39,6 +45,7 @@ Hot-path contract (mirrors the rest of obs — see PROFILE.md):
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import itertools
 import threading
@@ -47,7 +54,7 @@ import uuid
 
 from .recorder import resolve_recorder
 
-__all__ = ["span", "traced", "emit_span_at", "Span"]
+__all__ = ["span", "traced", "emit_span_at", "adopt", "Span"]
 
 _ANNOTATION_CLS = None
 _ANNOTATION_FAILED = False
@@ -92,6 +99,11 @@ class _TraceState:
             st = self.local.stack = []
         return st
 
+    def adopted(self):
+        """Innermost adopted trace context on this thread, or None."""
+        lst = getattr(self.local, "adopted", None)
+        return lst[-1] if lst else None
+
     def tid(self):
         ident = threading.get_ident()
         with self._tid_lock:
@@ -106,6 +118,58 @@ def _state(rec) -> _TraceState:
     if st is None:
         st = rec._trace_state = _TraceState()
     return st
+
+
+class _Adopted:
+    """Live adoption scope; see :func:`adopt`."""
+
+    __slots__ = ("_st", "_ctx")
+
+    def __init__(self, st, ctx):
+        self._st = st
+        self._ctx = ctx
+
+    def __enter__(self):
+        lst = getattr(self._st.local, "adopted", None)
+        if lst is None:
+            lst = self._st.local.adopted = []
+        lst.append(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        lst = getattr(self._st.local, "adopted", None)
+        if lst and self._ctx in lst:
+            lst.remove(self._ctx)
+        return False
+
+
+def adopt(rec, ctx):
+    """Adopt a foreign trace context on this thread for the duration of
+    the ``with`` block — the fleet's cross-process trace propagation.
+
+    ``ctx`` is ``{"trace_id": ..., "span_id": ...}`` as minted by the
+    front door at submit time and carried through the WAL record, spool
+    job doc, and lease file. While the scope is active, spans begun on
+    this thread (and :func:`emit_span_at` back-stamps) carry
+    ``ctx["trace_id"]`` instead of the stream's own trace id, so every
+    worker-side span of one submission shares the submit span's trace.
+
+    Lineage stays per-stream: ``parent_id`` always references a span in
+    the SAME stream (``validate_spans``'s contract), so a top-level
+    adopted span keeps ``parent_id=None`` and instead attaches the
+    foreign parent as an ADDITIVE ``ctx_parent_id`` field — the submit
+    span's id in the server stream. ``trace_export --fleet`` joins the
+    two streams on (trace_id, ctx_parent_id) and renders the link as a
+    Perfetto flow; single-stream tooling ignores the extra field.
+
+    Falsy recorder or a ctx without ``trace_id`` yields a no-op scope,
+    so call sites need no guards. Nesting is allowed; the innermost
+    adoption wins. The scope is thread-local: spawn-per-job worker
+    threads adopt independently.
+    """
+    if not rec or not ctx or not ctx.get("trace_id"):
+        return contextlib.nullcontext(dict(ctx or {}))
+    return _Adopted(_state(rec), dict(ctx))
 
 
 class _NullSpan:
@@ -183,10 +247,17 @@ class Span:
         stack = st.stack()
         self.parent_id = stack[-1].span_id if stack else None
         self.span_id = next(st.ids)
-        self.trace_id = st.trace_id
+        ctx = st.adopted()
+        extra = {}
+        if ctx is not None:
+            self.trace_id = ctx["trace_id"]
+            if self.parent_id is None and ctx.get("span_id") is not None:
+                extra["ctx_parent_id"] = ctx["span_id"]
+        else:
+            self.trace_id = st.trace_id
         self.rec.emit("span_begin", name=self.name, span_id=self.span_id,
                       trace_id=self.trace_id, parent_id=self.parent_id,
-                      tid=st.tid(), **self.args)
+                      tid=st.tid(), **extra, **self.args)
         stack.append(self)
         if self.annotate:
             ann = _annotation(self.name)
@@ -279,14 +350,20 @@ def emit_span_at(rec, name, ts_begin, dur_s, parent_id=None,
     if not rec:
         return None
     st = _state(rec)
+    ctx = st.adopted()
+    trace_id = ctx["trace_id"] if ctx is not None else st.trace_id
+    extra = {}
     if parent_id is None:
         stack = st.stack()
         parent_id = stack[-1].span_id if stack else None
+        if (parent_id is None and ctx is not None
+                and ctx.get("span_id") is not None):
+            extra["ctx_parent_id"] = ctx["span_id"]
     sid = next(st.ids)
     rec.emit("span_begin", ts=ts_begin, name=name, span_id=sid,
-             trace_id=st.trace_id, parent_id=parent_id, tid=st.tid(),
-             **args)
+             trace_id=trace_id, parent_id=parent_id, tid=st.tid(),
+             **extra, **args)
     rec.emit("span_end", ts=ts_begin + float(dur_s), name=name,
-             span_id=sid, trace_id=st.trace_id, dur_s=float(dur_s),
+             span_id=sid, trace_id=trace_id, dur_s=float(dur_s),
              **(end_args or {}))
     return sid

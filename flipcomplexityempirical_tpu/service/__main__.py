@@ -207,7 +207,9 @@ def _fleet_main(argv) -> int:
     sp.add_argument("--ready-file", default=None,
                     help="write {host, port, url, pid} JSON once bound "
                          "(default ROOT/server.json)")
-    sp.add_argument("--events", default=None)
+    sp.add_argument("--events", default=None,
+                    help="obs JSONL stream (default "
+                         "ROOT/events/server.jsonl; 'none' disables)")
     sp.add_argument("--quota-rate", type=float, default=None,
                     metavar="R", help="per-tenant submissions/s "
                     "(default: unlimited)")
@@ -229,7 +231,9 @@ def _fleet_main(argv) -> int:
     wp.add_argument("--idle-timeout", type=float, default=None,
                     help="exit after this long with nothing claimable "
                          "(default: poll forever)")
-    wp.add_argument("--events", default=None)
+    wp.add_argument("--events", default=None,
+                    help="obs JSONL stream (default "
+                         "ROOT/events/<name>.jsonl; 'none' disables)")
     wp.add_argument("--compile-cache", default=None)
     wp.add_argument("--retries", type=int, default=3)
     wp.add_argument("--quarantine-after", type=int, default=2)
@@ -266,7 +270,18 @@ def _fleet_main(argv) -> int:
         os.makedirs(args.root, exist_ok=True)
         ready = args.ready_file or os.path.join(args.root,
                                                 "server.json")
-        with from_spec(args.events) as rec:
+        # canonical fleet stream layout: every process appends to its
+        # own ROOT/events/<name>.jsonl (one writer per file); the
+        # FleetCollector behind /v1/metrics tails exactly this dir
+        events = args.events
+        if events is None:
+            events = os.path.join(args.root, "events", "server.jsonl")
+            os.makedirs(os.path.dirname(events), exist_ok=True)
+        elif events == "none":
+            events = None
+        with from_spec(events,
+                       ident={"pid": os.getpid(),
+                              "worker_name": "server"}) as rec:
             return serve(args.root, host=args.host, port=args.port,
                          recorder=rec, ready_file=ready,
                          quota_rate=args.quota_rate,
@@ -282,13 +297,22 @@ def _fleet_main(argv) -> int:
             else rfaults.install_from_env()
         policy = RetryPolicy(max_retries=args.retries,
                              quarantine_after=args.quarantine_after)
-        with from_spec(args.events) as rec:
+        name = args.name or f"w{os.getpid()}"
+        events = args.events
+        if events is None:
+            events = os.path.join(args.root, "events", f"{name}.jsonl")
+            os.makedirs(os.path.dirname(events), exist_ok=True)
+        elif events == "none":
+            events = None
+        with from_spec(events,
+                       ident={"pid": os.getpid(),
+                              "worker_name": name}) as rec:
             compile_cache = None
             if args.compile_cache:
                 enable_persistent_cache(args.compile_cache)
                 compile_cache = CompileCache(args.compile_cache,
                                              recorder=rec)
-            worker = Worker(args.root, worker=args.name,
+            worker = Worker(args.root, worker=name,
                             ttl_s=args.ttl, hb_s=args.hb,
                             poll_s=args.poll,
                             idle_timeout_s=args.idle_timeout,

@@ -1,0 +1,174 @@
+"""On-demand device profiling for fleet jobs (ISSUE 18).
+
+``POST /v1/profile/<job>`` drops an atomic marker doc under
+``<root>/profile/<job>.json``. The worker that owns the job's lease
+installs a :class:`ProfileWatcher` for the duration of the job; the
+scheduler's segment loop calls :func:`segment_boundary` at every
+segment edge (right next to the drain check — the one place the run is
+guaranteed host-side and checkpoint-consistent). The watcher:
+
+1. sees the marker at a boundary -> opens ``jax.profiler.start_trace``
+   into ``<root>/profile/<job>.trace/``;
+2. counts the requested number of segment boundaries;
+3. stops the trace and publishes ``<root>/artifacts/<job>.profile.json``
+   (atomic), removes the marker, and emits ``profile_captured`` — the
+   capture is then fetchable via ``GET /v1/profile/<job>``.
+
+Degradation is graceful by construction: a jax without a usable
+profiler backend (CPU CI, missing tensorboard plugin) records
+``ok=False`` with the error string and the run proceeds untouched; a
+job that finishes before K segments publishes the segments it actually
+bracketed. The marker probe is an ``os.path.exists`` per segment —
+host-side file work only, in keeping with PROFILE.md's
+no-extra-device-syncs rule (the profiler trace itself is the payload
+the user explicitly requested).
+
+The process-global watcher slot mirrors ``lifecycle``'s drain flag: the
+scheduler consults it without threading a handle through SweepService's
+API, and the worker installs/uninstalls around each job. One job runs
+per worker process at a time, so one slot suffices.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from .. import obs
+
+PROFILE_DIR = "profile"
+_ARTIFACTS_DIR = "artifacts"
+
+_LOCK = threading.Lock()
+_WATCHER: Optional["ProfileWatcher"] = None
+
+
+def install(watcher) -> Optional["ProfileWatcher"]:
+    """Install (or, with None, clear) the process-global watcher;
+    returns the previous one so callers can restore it."""
+    global _WATCHER
+    with _LOCK:
+        prev = _WATCHER
+        _WATCHER = watcher
+    return prev
+
+
+def segment_boundary(tag=None) -> None:
+    """The scheduler's hook: called at every segment edge of the run
+    loop (service.scheduler._run_batch, and around a solo dispatch).
+    No-op unless a worker installed a watcher."""
+    w = _WATCHER
+    if w is not None:
+        w.at_segment_boundary(tag)
+
+
+# local copies of the fleet-root helpers: worker.py imports this module
+# (and scheduler.py calls into it), so importing them back from
+# worker.py would be a cycle
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_json_atomic(path: str, doc: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class ProfileWatcher:
+    """Per-job marker watcher; the worker installs one around each
+    claimed job and calls :meth:`finish` when the job leaves its hands
+    (terminal, drained, or crashed out of the try block).
+
+    Single-threaded by contract: every method runs on the worker's job
+    thread (the scheduler loop IS that thread), so no locking."""
+
+    def __init__(self, root: str, job_id: str, worker: str,
+                 recorder=None, clock=time.time):
+        self.root = root
+        self.job_id = job_id
+        self.worker = worker
+        self._rec = obs.resolve_recorder(recorder)
+        self._clock = clock
+        self.marker_path = os.path.join(root, PROFILE_DIR,
+                                        f"{job_id}.json")
+        self._active: Optional[dict] = None
+
+    def at_segment_boundary(self, tag=None) -> None:
+        if self._active is None:
+            if not os.path.exists(self.marker_path):
+                return
+            doc = _read_json(self.marker_path)
+            if doc is None:
+                return      # torn mid-replace; next boundary rereads
+            self._start(doc)
+            return
+        self._active["segments_done"] += 1
+        if self._active["segments_done"] >= self._active["segments"]:
+            self._stop_and_publish()
+
+    def finish(self) -> None:
+        """Close out an in-flight capture at job exit: publish whatever
+        was actually bracketed (a short job beats a lost capture)."""
+        if self._active is not None:
+            self._stop_and_publish()
+
+    # -- internals ----------------------------------------------------
+
+    def _start(self, marker: dict) -> None:
+        segments = marker.get("segments")
+        if not isinstance(segments, int) or segments < 1:
+            segments = 1
+        trace_dir = os.path.join(self.root, PROFILE_DIR,
+                                 f"{self.job_id}.trace")
+        active = {"segments": segments, "segments_done": 0,
+                  "trace_dir": trace_dir, "ok": False, "error": None,
+                  "started_ts": self._clock()}
+        try:
+            import jax
+
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            active["ok"] = True
+        except Exception as e:      # no profiler backend: degrade
+            active["error"] = f"{type(e).__name__}: {e}"
+        self._active = active
+
+    def _stop_and_publish(self) -> None:
+        active, self._active = self._active, None
+        if active["ok"]:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:
+                active["ok"] = False
+                active["error"] = f"{type(e).__name__}: {e}"
+        _write_json_atomic(
+            os.path.join(self.root, _ARTIFACTS_DIR,
+                         f"{self.job_id}.profile.json"),
+            {"job_id": self.job_id, "worker": self.worker,
+             "segments": active["segments_done"],
+             "requested_segments": active["segments"],
+             "trace_dir": active["trace_dir"] if active["ok"] else None,
+             "ok": active["ok"], "error": active["error"],
+             "started_ts": active["started_ts"],
+             "captured_ts": self._clock()})
+        try:
+            os.remove(self.marker_path)
+        except OSError:
+            pass
+        self._rec.emit("profile_captured", job_id=self.job_id,
+                       segments=active["segments_done"],
+                       ok=active["ok"], error=active["error"],
+                       worker=self.worker)

@@ -68,6 +68,7 @@ from ..sampling.board_runner import finalize_board_run, run_board_segment
 from .cache import CompileCache
 from . import journal as jnl
 from . import lifecycle
+from . import profiling
 from . import queue as q
 
 
@@ -546,6 +547,10 @@ class SweepService:
                        jobs=[job.job_id], chains=chains,
                        fingerprint=job.fingerprint, kernel_path=path)
         t0 = time.perf_counter()
+        # A solo run is one opaque dispatch: bracket it with the two
+        # profiling boundaries it has (start + end), so an on-demand
+        # capture still covers the whole dispatch.
+        profiling.segment_boundary(batch_id)
         # One watchdog window for the whole solo run (the driver owns
         # the segment loop; a solo run is one opaque dispatch span from
         # the service's point of view).
@@ -561,6 +566,7 @@ class SweepService:
                                     recorder=self._rec,
                                     control=self.control)
         wall = time.perf_counter() - t0
+        profiling.segment_boundary(batch_id)
         data["seconds"] = wall
         self.batch_stats.append(BatchStats(
             batch_id=batch_id, jobs=[job.job_id], chains=chains,
@@ -643,6 +649,9 @@ class SweepService:
         while done < total and active:
             check_deadline()
             lifecycle.check_drain(batch_id)
+            # on-demand profiling hook: same cadence as the drain
+            # check — segment edges are the only host-side points
+            profiling.segment_boundary(batch_id)
             rfaults.fault_point("segment.step", tag=batch_id, done=done)
             n = min(every, total - done)
             seg_t0 = time.perf_counter()

@@ -21,6 +21,17 @@ GET      /v1/workloads          catalog names a tenant may submit
 GET      /v1/healthz            liveness + drain flag
 POST     /v1/drain              graceful fleet drain (marker +
                                 in-process flag, journaled)
+GET      /v1/metrics            Prometheus text exposition: per-
+                                worker + fleet-rollup counters /
+                                gauges / histogram percentiles from
+                                the FleetCollector (ISSUE 18)
+GET      /v1/fleet              JSON live topology: workers, job
+                                stages, stream tails, queue depth
+POST     /v1/profile/<id>       drop the on-demand profiling marker
+                                the owning worker honors at its next
+                                segment boundary
+GET      /v1/profile/<id>       profiling request + published
+                                capture artifact, read-only
 =======  =====================  ==================================
 
 **Handler hygiene (the graftlint G009 contract).** Request threads
@@ -60,13 +71,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import obs
+from ..obs.aggregate import FleetCollector
 from ..resilience import faults as rfaults
 from ..workloads import registry as wreg
 from . import journal as jnl
 from . import lifecycle
-from .worker import (ARTIFACTS_DIR, JOBS_DIR, STARTED_DIR, STATUS_DIR,
-                     LeaseManager, _read_json, _write_json_atomic,
-                     fleet_dirs)
+from .worker import (ARTIFACTS_DIR, JOBS_DIR, PROFILE_DIR, STARTED_DIR,
+                     STATUS_DIR, LeaseManager, _read_json,
+                     _write_json_atomic, fleet_dirs)
 
 
 class FrontDoorError(RuntimeError):
@@ -202,6 +214,12 @@ class FrontDoor:
         self._admit_seq = 0
         self._stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
+        # live observability: one collector per server (the checkpoint
+        # file has one writer), serialized behind its own lock because
+        # /v1/metrics and /v1/fleet arrive on concurrent handler
+        # threads. Host-side file tailing only — never device work.
+        self._collector = FleetCollector(root, clock=clock)
+        self._collector_lock = threading.Lock()
         self._recover()
 
     # -- restart recovery ---------------------------------------------
@@ -221,6 +239,7 @@ class FrontDoor:
                     "tenant": record.get("tenant", "default"),
                     "submitted_ts": record.get("ts"),
                     "config": record.get("config"),
+                    "trace": record.get("trace"),
                 }
             elif kind == "job_admitted":
                 admitted.add(record["job_id"])
@@ -303,27 +322,43 @@ class FrontDoor:
         bucket = self._bucket(tenant)
         if bucket is not None and not bucket.take():
             self._rec.emit("quota_rejected", tenant=tenant,
-                           path="/v1/jobs", rate=self.quota_rate)
+                           path="/v1/jobs", rate=self.quota_rate,
+                           trace_id=None)
             raise QuotaExceeded(
                 f"tenant {tenant!r} exceeded {self.quota_rate:g} "
                 "submissions/s")
         with self._cond:
             job_id = f"j{len(self._jobs):04d}"
             doc = jnl.config_to_doc(config)
+            # Mint the submission's trace identity: deterministic in
+            # the job id (recovery re-mints the same trace), carried by
+            # the WAL record, spool doc, and lease file; workers adopt
+            # it (obs.adopt) so their run spans join THIS trace. The
+            # submit span is the fleet-wide root every worker-side span
+            # hangs under (via ctx_parent_id) in trace_export --fleet.
+            trace = {"trace_id": f"job:{job_id}"}
+            with obs.adopt(self._rec, trace):
+                sp = obs.span(self._rec, "submit", job_id=job_id,
+                              tenant=tenant, tag=config.tag).begin()
+            if sp:
+                trace["span_id"] = sp.span_id
             # WAL before any mutation the record describes
             self.journal.append("job_submitted", job_id=job_id,
                                 tag=config.tag, tenant=tenant,
-                                config=doc)
+                                config=doc, trace=trace)
             self._jobs[job_id] = {
                 "job_id": job_id, "tag": config.tag, "tenant": tenant,
                 "submitted_ts": self._clock(), "config": doc,
+                "trace": trace,
             }
             self._admission.enqueue(tenant, job_id)
             self._cond.notify()
         self._rec.emit("job_submitted", job_id=job_id, tag=config.tag,
-                       tenant=tenant, fingerprint=config.fingerprint())
+                       tenant=tenant, fingerprint=config.fingerprint(),
+                       trace_id=trace["trace_id"])
+        sp.end()
         return {"job_id": job_id, "tag": config.tag,
-                "tenant": tenant,
+                "tenant": tenant, "trace_id": trace["trace_id"],
                 "fingerprint": config.fingerprint()}
 
     # -- the admission pump -------------------------------------------
@@ -351,6 +386,7 @@ class FrontDoor:
                  "tag": info["tag"], "admit_seq": admit_seq,
                  "submitted_ts": info["submitted_ts"],
                  "admitted_ts": self._clock(),
+                 "trace": info.get("trace"),
                  "config": info["config"]})
 
     def pump_idle(self) -> bool:
@@ -415,12 +451,74 @@ class FrontDoor:
         return {"ok": True, "draining": self.draining,
                 "n_jobs": len(self._jobs)}
 
+    def metrics_text(self) -> str:
+        """The /v1/metrics body: poll the collector (host-side file
+        tailing only), render Prometheus text exposition."""
+        with self._collector_lock:
+            self._collector.poll()
+            return self._collector.prometheus_text()
+
+    def fleet_status(self) -> dict:
+        """The /v1/fleet body: the collector's stream-derived topology
+        merged with what only the server knows — authoritative per-job
+        stage (status files beat stream inference) and the live
+        admission-queue depth (which never transits a stream)."""
+        with self._collector_lock:
+            self._collector.poll()
+            doc = self._collector.fleet_doc()
+        status = self.jobs_status()
+        for j in status["jobs"]:
+            entry = doc["jobs"].setdefault(j["job_id"], {})
+            entry["stage"] = j["status"]
+            if j.get("worker") is not None:
+                entry["worker"] = j["worker"]
+        doc["stages"] = status["counts"]
+        with self._cond:
+            doc["queue_depth"] = len(self._admission)
+        doc["draining"] = self.draining
+        return doc
+
+    def profile_request(self, job_id: str, body: dict) -> dict:
+        """POST /v1/profile/<job>: journal the request (write-ahead,
+        like every other accepted mutation), then drop the atomic
+        marker the owning worker honors at its next segment boundary.
+        The handler thread touches files only — capture itself happens
+        in the worker process (G009: no device work here)."""
+        if job_id not in self._jobs:
+            raise NotFound(f"unknown job {job_id!r}")
+        segments = body.get("segments", 3)
+        if not isinstance(segments, int) or not 1 <= segments <= 1000:
+            raise BadRequest("segments must be an int in [1, 1000]")
+        self.journal.append("profile_requested", job_id=job_id,
+                            segments=segments)
+        _write_json_atomic(
+            os.path.join(self.dirs[PROFILE_DIR], f"{job_id}.json"),
+            {"job_id": job_id, "segments": segments,
+             "requested_ts": self._clock()})
+        return {"job_id": job_id, "segments": segments,
+                "profiling": "requested"}
+
+    def profile_status(self, job_id: str) -> dict:
+        """GET /v1/profile/<job>: pending marker + published capture
+        (the worker's ``<job>.profile.json`` artifact), read-only."""
+        if job_id not in self._jobs:
+            raise NotFound(f"unknown job {job_id!r}")
+        marker = _read_json(os.path.join(self.dirs[PROFILE_DIR],
+                                         f"{job_id}.json"))
+        capture = _read_json(os.path.join(self.dirs[ARTIFACTS_DIR],
+                                          f"{job_id}.profile.json"))
+        return {"job_id": job_id, "requested": marker,
+                "captured": capture}
+
     def observe_request(self, method: str, path: str, status: int,
                         tenant: Optional[str], dur_s: float,
                         job_id: Optional[str] = None) -> None:
+        info = self._jobs.get(job_id) if job_id else None
+        trace = (info or {}).get("trace") or {}
         self._rec.emit("http_request", method=method, path=path,
                        status=status, tenant=tenant,
-                       dur_s=round(dur_s, 6), job_id=job_id)
+                       dur_s=round(dur_s, 6), job_id=job_id,
+                       trace_id=trace.get("trace_id"))
 
 
 class FleetHTTPServer(ThreadingHTTPServer):
@@ -468,12 +566,25 @@ class FrontDoorHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+    def _reply_text(self, status: int, text: str,
+                    content_type: str = "text/plain; version=0.0.4; "
+                                        "charset=utf-8") -> None:
+        # Prometheus scrapers want the text exposition content type,
+        # not JSON — everything else about the reply is the same
+        payload = text.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     # -- routes -------------------------------------------------------
 
     def _route(self, method: str) -> None:
         t0 = time.monotonic()
         tenant = None
         job_id = None
+        raw_text = False
         try:
             rfaults.fault_point("http.accept", path=self.path)
             front = self.server.front
@@ -508,14 +619,34 @@ class FrontDoorHandler(BaseHTTPRequestHandler):
             elif method == "GET" and parts == ["v1", "healthz"]:
                 out = front.healthz()
                 status = 200
+            elif method == "GET" and parts == ["v1", "metrics"]:
+                out = front.metrics_text()
+                raw_text = True
+                status = 200
+            elif method == "GET" and parts == ["v1", "fleet"]:
+                out = front.fleet_status()
+                status = 200
+            elif (method == "POST" and len(parts) == 3
+                  and parts[:2] == ["v1", "profile"]):
+                job_id = parts[2]
+                out = front.profile_request(job_id, self._body())
+                status = 200
+            elif (method == "GET" and len(parts) == 3
+                  and parts[:2] == ["v1", "profile"]):
+                job_id = parts[2]
+                out = front.profile_status(job_id)
+                status = 200
             else:
                 raise NotFound(f"no route {method} {self.path}")
         except FrontDoorError as e:
-            status, out = e.status, {"error": e.message}
+            status, out, raw_text = e.status, {"error": e.message}, False
         except rfaults.InjectedFault as e:
-            status, out = 503, {"error": str(e)}
+            status, out, raw_text = 503, {"error": str(e)}, False
         try:
-            self._reply(status, out)
+            if raw_text:
+                self._reply_text(status, out)
+            else:
+                self._reply(status, out)
         finally:
             self.server.front.observe_request(
                 method, self.path, status, tenant,

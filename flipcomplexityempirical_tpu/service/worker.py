@@ -20,6 +20,9 @@ Fleet root layout (everything the fleet shares is a file)::
       artifacts/<id>.json  result summary + array sha256 (DONE jobs)
       run/<id>/            the job's own SweepService outdir + journal
       ckpt/                shared sliced checkpoints (resume points)
+      events/<name>.jsonl  per-process obs streams (server + workers)
+      workers/<name>.json  per-worker heartbeat docs (pid, status, job)
+      profile/<id>.json    on-demand profiling markers (service.profiling)
       DRAIN                fleet-wide drain marker (lifecycle)
 
 **The lease protocol.** A job may be executed by at most one worker at
@@ -67,7 +70,9 @@ from ..resilience import faults as rfaults
 from ..resilience.supervisor import RetryPolicy
 from . import journal as jnl
 from . import lifecycle
+from . import profiling
 from . import queue as q
+from .profiling import PROFILE_DIR
 from .scheduler import SweepService
 
 JOBS_DIR = "jobs"
@@ -77,13 +82,16 @@ STATUS_DIR = "status"
 ARTIFACTS_DIR = "artifacts"
 RUN_DIR = "run"
 CKPT_DIR = "ckpt"
+EVENTS_DIR = "events"
+WORKERS_DIR = "workers"
 
 
 def fleet_dirs(root: str) -> dict:
     """Ensure and return the shared fleet subdirectories."""
     dirs = {name: os.path.join(root, name)
             for name in (JOBS_DIR, LEASES_DIR, STARTED_DIR, STATUS_DIR,
-                         ARTIFACTS_DIR, RUN_DIR, CKPT_DIR)}
+                         ARTIFACTS_DIR, RUN_DIR, CKPT_DIR, EVENTS_DIR,
+                         WORKERS_DIR, PROFILE_DIR)}
     for d in dirs.values():
         os.makedirs(d, exist_ok=True)
     return dirs
@@ -189,13 +197,16 @@ class LeaseManager:
         self._clock = clock
         self._rec = obs.resolve_recorder(recorder)
         self._tomb_seq = 0
+        # trace context per held job: rides every lease payload so the
+        # lease file itself witnesses which distributed trace owns it
+        self._traces: dict = {}
 
     def path(self, job_id: str) -> str:
         return os.path.join(self.dir, f"{job_id}.lease")
 
     def holder(self, job_id: str) -> Optional[dict]:
-        """The lease payload ({worker, pid, ts}), or None when the
-        lease is missing or torn."""
+        """The lease payload ({worker, pid, ts[, trace]}), or None when
+        the lease is missing or torn."""
         return _read_json(self.path(job_id))
 
     def age_s(self, job_id: str) -> Optional[float]:
@@ -212,11 +223,15 @@ class LeaseManager:
         age = self.age_s(job_id)
         return age is not None and age <= self.ttl_s
 
-    def _payload(self) -> dict:
-        return {"worker": self.worker, "pid": os.getpid(),
-                "ts": self._clock()}
+    def _payload(self, job_id: str) -> dict:
+        doc = {"worker": self.worker, "pid": os.getpid(),
+               "ts": self._clock()}
+        trace = self._traces.get(job_id)
+        if trace:
+            doc["trace"] = trace
+        return doc
 
-    def _create(self, path: str) -> bool:
+    def _create(self, path: str, job_id: str) -> bool:
         """One O_EXCL create attempt; False when somebody else holds
         the name. The ``lease.write`` fault site raises *before* the
         create (a claim that never lands) and its truncate rules tear
@@ -228,19 +243,26 @@ class LeaseManager:
         except FileExistsError:
             return False
         with os.fdopen(fd, "w", encoding="utf-8") as f:
-            json.dump(self._payload(), f)
+            json.dump(self._payload(job_id), f)
             f.flush()
             os.fsync(f.fileno())
         rfaults.corrupt_file("lease.write", path)
         return True
 
-    def claim(self, job_id: str) -> Optional[Lease]:
+    def claim(self, job_id: str,
+              trace: Optional[dict] = None) -> Optional[Lease]:
         """Try to acquire ``job_id``'s lease. Returns a Lease, or None
         when a live peer holds it (or we lost a reclaim race —
-        indistinguishable, and equally retriable next scan)."""
+        indistinguishable, and equally retriable next scan). ``trace``
+        is the job's submit-time trace context (from the spool doc): it
+        rides the lease payload and stamps the claim events, so the
+        lease protocol itself is visible in the job's distributed
+        trace."""
+        self._traces[job_id] = dict(trace or {})
+        trace_id = self._traces[job_id].get("trace_id")
         path = self.path(job_id)
         reclaim = False
-        if not self._create(path):
+        if not self._create(path, job_id):
             if self.live(job_id):
                 return None
             # Stale or torn: break it via an atomic rename — exactly
@@ -258,13 +280,15 @@ class LeaseManager:
                 self._rec.emit("lease_expired", job_id=job_id,
                                worker=prev.get("worker", "unknown"),
                                by=self.worker,
-                               age_s=round(age, 3))
+                               age_s=round(age, 3),
+                               trace_id=trace_id)
                 reclaim = True
             # else: released between checks — plain fresh claim below
-            if not self._create(path):
+            if not self._create(path, job_id):
                 return None           # a third claimer slipped in
         self._rec.emit("lease_acquired", job_id=job_id,
-                       worker=self.worker, reclaim=reclaim)
+                       worker=self.worker, reclaim=reclaim,
+                       trace_id=trace_id)
         return Lease(self, job_id)
 
     def refresh(self, job_id: str) -> None:
@@ -276,13 +300,14 @@ class LeaseManager:
                             worker=self.worker)
         tmp = f"{path}.hb.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(self._payload(), f)
+            json.dump(self._payload(job_id), f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
         rfaults.corrupt_file("lease.write", path)
 
     def release(self, job_id: str) -> None:
+        self._traces.pop(job_id, None)
         try:
             os.remove(self.path(job_id))
         except FileNotFoundError:
@@ -295,12 +320,16 @@ class _LeaseHeartbeat(threading.Thread):
     armed rule hard-kills the process (uncatchable, mid-dispatch) —
     the closest CPU-testable analogue of node preemption. A failed
     refresh (armed ``lease.write``, full disk) skips the beat; the
-    lease simply ages."""
+    lease simply ages. ``beat_fn`` (optional, best-effort) runs every
+    beat — the worker passes its own heartbeat-file writer so
+    ``workers/<name>.json`` stays fresh through a long job whose run()
+    loop never spins."""
 
-    def __init__(self, lease: Lease, hb_s: float):
+    def __init__(self, lease: Lease, hb_s: float, beat_fn=None):
         super().__init__(name=f"lease-hb-{lease.job_id}", daemon=True)
         self._lease = lease
         self._hb_s = hb_s
+        self._beat_fn = beat_fn
         # NB: not `_stop` — that name is Thread internals.
         self._halt = threading.Event()
 
@@ -311,6 +340,11 @@ class _LeaseHeartbeat(threading.Thread):
                                     job_id=self._lease.job_id)
             except rfaults.InjectedFault:
                 os.kill(os.getpid(), signal.SIGKILL)
+            if self._beat_fn is not None:
+                try:
+                    self._beat_fn()
+                except OSError:
+                    pass
             try:
                 self._lease.refresh()
             except (OSError, rfaults.InjectedFault):
@@ -353,6 +387,19 @@ class Worker:
                                    clock=clock, recorder=recorder)
         self.executed: list = []      # (job_id, status) this process ran
         self.failures = 0             # failed/quarantined among those
+        self.heartbeat_path = os.path.join(self.dirs[WORKERS_DIR],
+                                           f"{self.worker}.json")
+
+    def _beat(self, status: str, job_id: Optional[str] = None) -> None:
+        """Refresh ``workers/<name>.json`` — the per-worker liveness doc
+        ``obs_report --heartbeat`` probes (mtime carries freshness, like
+        leases). Written from the run() loop between jobs and from the
+        lease heartbeat thread during one, so a long job never looks
+        dead. Atomic: probes must never see a torn doc."""
+        _write_json_atomic(self.heartbeat_path, {
+            "worker": self.worker, "pid": os.getpid(),
+            "ts": self._clock(), "status": status, "job_id": job_id,
+            "hb_s": self.hb_s})
 
     # -- spool views --------------------------------------------------
 
@@ -386,20 +433,22 @@ class Worker:
 
     # -- execution ----------------------------------------------------
 
-    def _mark_started(self, job_id: str) -> None:
+    def _mark_started(self, job_id: str) -> bool:
         """First-claim marker (O_EXCL — first worker wins, reclaims
         keep the original anchor): queue-to-start is measured from the
-        job's FIRST execution start, not a post-crash resume."""
+        job's FIRST execution start, not a post-crash resume. Returns
+        True when THIS call planted the marker (first execution)."""
         path = os.path.join(self.dirs[STARTED_DIR], f"{job_id}.json")
         try:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
-            return
+            return False
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             json.dump({"job_id": job_id, "worker": self.worker,
                        "started_ts": self._clock()}, f)
             f.flush()
             os.fsync(f.fileno())
+        return True
 
     def _publish(self, job: q.Job, doc: dict) -> None:
         # doc["job_id"] is the FLEET id; job.job_id is the per-job
@@ -433,10 +482,19 @@ class Worker:
 
     def _execute(self, lease: Lease, doc: dict) -> bool:
         """Run one claimed job to a terminal state (or to a drain
-        boundary). Returns True when a terminal verdict was published."""
+        boundary). Returns True when a terminal verdict was published.
+
+        Runs under the job's adopted trace context (``obs.adopt``): the
+        queue_wait back-stamp, the ``job`` span, and every span the
+        per-job SweepService opens on this thread all join the submit
+        span's trace, so one Perfetto timeline tells the job's whole
+        cross-process story."""
         job_id = doc["job_id"]
-        self._mark_started(job_id)
-        hb = _LeaseHeartbeat(lease, self.hb_s)
+        trace = doc.get("trace") or {}
+        first = self._mark_started(job_id)
+        hb = _LeaseHeartbeat(lease, self.hb_s,
+                             beat_fn=lambda: self._beat("running",
+                                                        job_id=job_id))
         hb.start()
         rundir = os.path.join(self.dirs[RUN_DIR], job_id)
         # per-job checkpoint subdir: the ckpt tree is shared (any
@@ -450,27 +508,47 @@ class Worker:
                       policy=self.policy,
                       dispatch_timeout=self.dispatch_timeout,
                       clock=self._clock, verbose=self.verbose)
+        watcher = profiling.ProfileWatcher(self.root, job_id,
+                                           self.worker,
+                                           recorder=self._rec,
+                                           clock=self._clock)
+        prev_watcher = profiling.install(watcher)
         try:
-            if os.path.exists(jnl.journal_path_for(rundir)):
-                svc = SweepService.recover(rundir, **kwargs)
-            else:
-                svc = SweepService(rundir, **kwargs)
-                svc.submit(jnl.config_from_doc(doc["config"]))
-            svc.run_until_idle()
-            if svc.drained:
-                # requeued + checkpointed in the run journal; the
-                # released lease lets any worker resume after restart
-                return False
-            job = svc.queue.jobs()[0]
-            self._publish(job, doc)
-            self.executed.append((job_id, job.status))
-            if job.status != q.DONE:
-                self.failures += 1
-            if self.verbose:
-                print(f"[{self.worker}] {job_id} {job.tag} "
-                      f"-> {job.status}")
-            return True
+            with obs.adopt(self._rec, trace):
+                sub_ts = doc.get("submitted_ts")
+                if first and isinstance(sub_ts, (int, float)):
+                    # back-stamp the spool wait: begins at submission,
+                    # ends now (first claim) — visible queue time in
+                    # the job's trace without a live server-side span
+                    obs.emit_span_at(
+                        self._rec, "queue_wait", ts_begin=sub_ts,
+                        dur_s=max(0.0, self._clock() - sub_ts),
+                        job_id=job_id, worker=self.worker)
+                with obs.span(self._rec, "job", job_id=job_id,
+                              worker=self.worker, tag=doc.get("tag")):
+                    if os.path.exists(jnl.journal_path_for(rundir)):
+                        svc = SweepService.recover(rundir, **kwargs)
+                    else:
+                        svc = SweepService(rundir, **kwargs)
+                        svc.submit(jnl.config_from_doc(doc["config"]))
+                    svc.run_until_idle()
+                    if svc.drained:
+                        # requeued + checkpointed in the run journal;
+                        # the released lease lets any worker resume
+                        # after restart
+                        return False
+                    job = svc.queue.jobs()[0]
+                    self._publish(job, doc)
+                    self.executed.append((job_id, job.status))
+                    if job.status != q.DONE:
+                        self.failures += 1
+                    if self.verbose:
+                        print(f"[{self.worker}] {job_id} {job.tag} "
+                              f"-> {job.status}")
+                    return True
         finally:
+            watcher.finish()
+            profiling.install(prev_watcher)
             hb.stop()
 
     def run_once(self) -> int:
@@ -484,7 +562,7 @@ class Worker:
             job_id = doc["job_id"]
             if self.terminal(job_id) is not None:
                 continue
-            lease = self.leases.claim(job_id)
+            lease = self.leases.claim(job_id, trace=doc.get("trace"))
             if lease is None:
                 continue
             try:
@@ -502,6 +580,7 @@ class Worker:
         (0 / 2 failures / 3 drained)."""
         self._rec.emit("worker_started", worker=self.worker,
                        pid=os.getpid(), root=self.root)
+        self._beat("idle")
         idle_t0 = time.monotonic()
         reason = "idle"
         while True:
@@ -513,6 +592,7 @@ class Worker:
                 reason = "drain"
                 break
             did = self.run_once()
+            self._beat("idle")
             if lifecycle.drain_requested() is not None:
                 reason = "drain"
                 break
@@ -528,6 +608,9 @@ class Worker:
         self._rec.emit("worker_exited", worker=self.worker,
                        reason=reason, n_executed=len(self.executed),
                        n_failures=self.failures)
+        # terminal heartbeat doc: probes exempt "exited" workers from
+        # staleness (a clean exit is not a dead worker)
+        self._beat("exited")
         if reason == "drain":
             return lifecycle.EXIT_DRAINED
         return 2 if self.failures else 0

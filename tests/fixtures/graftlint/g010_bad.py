@@ -1,0 +1,19 @@
+"""G010 positive fixture: request/job-scoped emits with no trace
+context — each is an event an operator cannot join to its submit
+trace."""
+
+
+def submit(rec):
+    rec.emit("http_request", method="POST", status=200)
+    rec.emit("job_submitted", job_id="j0000", tenant="t0")
+    rec.emit("quota_rejected", tenant="t0", tokens=0.0)
+
+
+def claim(rec):
+    rec.emit("lease_acquired", job_id="j0000", worker="w1")
+
+
+def reclaim(rec):
+    # a `with` that is NOT an adopt() does not supply context
+    with open("/dev/null") as fh:  # noqa: F841
+        rec.emit("lease_expired", job_id="j0000", holder="w9")

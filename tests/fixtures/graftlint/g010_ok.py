@@ -1,0 +1,32 @@
+"""G010 negative fixture: request/job-scoped emits that carry trace
+context — explicitly via kwargs, or ambiently under adopt()."""
+
+
+class obs:  # stand-in for flipcomplexityempirical_tpu.obs
+    @staticmethod
+    def adopt(rec, ctx):
+        return ctx
+
+
+def submit(rec, trace_id):
+    # explicit context: trace_id kwarg (even None is a decision)
+    rec.emit("http_request", method="POST", status=200, trace_id=trace_id)
+    rec.emit("job_submitted", job_id="j0000", trace_id=trace_id)
+    rec.emit("quota_rejected", tenant="t0", trace_id=None)
+
+
+def claim(rec, trace):
+    # the whole trace dict works too
+    rec.emit("lease_acquired", job_id="j0000", trace=trace)
+
+
+def execute(rec, ctx):
+    with obs.adopt(rec, ctx):
+        # ambient context: the recorder stamps the adopted trace
+        rec.emit("lease_expired", job_id="j0000", holder="w9")
+
+
+def lifecycle(rec):
+    # fleet-scoped events belong to no job: exempt
+    rec.emit("worker_started", worker="w1")
+    rec.emit("worker_exited", worker="w1", code=0)

@@ -1,0 +1,481 @@
+"""Fleet observability plane (ISSUE 18).
+
+The load-bearing claims, each tested here:
+- ``FleetCollector`` tails every stream incrementally — file-offset
+  checkpoints survive a collector restart without double-counting, a
+  torn tail (the SIGKILL mid-line write) waits un-consumed until the
+  writer finishes it, and a truncated stream re-reads from zero;
+- ``prometheus_text()`` is well-formed text exposition (HELP/TYPE
+  pairs, label syntax, fleet rollups that sum per-stream snapshots);
+- the trace the front door mints at submit survives the WAL record,
+  spool doc, and lease file: the worker's run spans join it, and
+  ``trace_export --fleet``'s end-to-end parenting gate passes;
+- ``/v1/metrics`` and ``/v1/fleet`` serve live collector state, and
+  ``POST /v1/profile/<job>`` drops the atomic marker the worker honors
+  at its next segment boundary;
+- ``obs/slo.py`` burn-rate math: storms trip, clean timelines pass,
+  thin populations pass vacuously;
+- the fleet-layout heartbeat probe names the stale worker.
+
+The committed fixture under tests/fixtures/obs/fleet/ is a fully
+terminal 2-worker run (journal + server stream + 2 worker streams +
+status docs) generated with the real Recorder on a deterministic
+clock. The cross-process gate (real server + 2 worker processes,
+mid-run scrape, SLO breach injection) is tools/obsfleet_check.sh
+(`make obsfleet-check`), wrapped here as a slow-tier test.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from flipcomplexityempirical_tpu import obs
+from flipcomplexityempirical_tpu.obs import slo
+from flipcomplexityempirical_tpu.obs.aggregate import FleetCollector
+from flipcomplexityempirical_tpu.resilience import faults as rfaults
+from flipcomplexityempirical_tpu.service import (
+    FleetServer, ServiceClient, Worker, clear_drain)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "obs", "fleet")
+
+OVERRIDES = {"total_steps": 60, "n_chains": 2, "checkpoint_every": 20}
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    rfaults.install_plan(None)
+    clear_drain()
+    yield
+    rfaults.install_plan(None)
+    clear_drain()
+
+
+def _tools(name):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _fixture_copy(tmp_path) -> str:
+    root = os.path.join(str(tmp_path), "fleet")
+    shutil.copytree(FIXTURE, root)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# FleetCollector: incremental tailing, checkpoints, torn tails
+# ---------------------------------------------------------------------------
+
+def test_collector_folds_fixture_and_is_idempotent(tmp_path):
+    root = _fixture_copy(tmp_path)
+    c = FleetCollector(root)
+    first = c.poll()
+    assert first == {"events": 30, "streams": 3}
+    # folded topology: both jobs seen running (terminal stages are the
+    # server's status files, merged in /v1/fleet), both workers exited
+    jobs = c.state["jobs"]
+    assert sorted(jobs) == ["j0000", "j0001"]
+    assert jobs["j0000"]["trace_id"] == "job:j0000"
+    assert jobs["j0000"]["worker"] == "w1"
+    assert jobs["j0000"]["profiled_segments"] == 2
+    assert all(w["exited"] for w in c.state["workers"].values())
+    # ident stamped at the Recorder layer, recovered from the stream
+    srv = c.state["streams"]["server.jsonl"]
+    assert srv["ident"] == {"pid": 101, "worker_name": "server"}
+    # nothing new: the second poll reads zero bytes
+    assert c.poll() == {"events": 0, "streams": 3}
+
+
+def test_collector_checkpoint_survives_restart(tmp_path):
+    root = _fixture_copy(tmp_path)
+    FleetCollector(root).poll()
+    assert os.path.exists(os.path.join(root, "events",
+                                       ".collector.json"))
+    # a RESTARTED collector (fresh instance, same root) resumes from
+    # the checkpoint: no event is counted twice
+    c2 = FleetCollector(root)
+    assert c2.poll()["events"] == 0
+    assert c2.state["streams"]["w1.jsonl"]["events"][
+        "worker_started"] == 1
+    # new events past the checkpointed offset are picked up
+    with open(os.path.join(root, "events", "w1.jsonl"), "a") as f:
+        f.write(json.dumps({"v": 1, "ts": 2000.0,
+                            "event": "worker_started",
+                            "worker": "w1"}) + "\n")
+    assert FleetCollector(root).poll()["events"] == 1
+
+
+def test_collector_waits_for_torn_tail(tmp_path):
+    root = _fixture_copy(tmp_path)
+    c = FleetCollector(root)
+    c.poll()
+    path = os.path.join(root, "events", "w2.jsonl")
+    line = json.dumps({"v": 1, "ts": 2000.0, "event": "worker_exited",
+                       "worker": "w2", "reason": "idle"}) + "\n"
+    # a half-written line (no newline yet) must not be consumed...
+    with open(path, "a") as f:
+        f.write(line[:20])
+    assert c.poll()["events"] == 0
+    # ...and is read whole once the writer finishes it
+    with open(path, "a") as f:
+        f.write(line[20:])
+    assert c.poll()["events"] == 1
+    assert c.state["streams"]["w2.jsonl"]["malformed"] == 0
+
+
+def test_collector_counts_malformed_and_resets_on_truncation(tmp_path):
+    root = _fixture_copy(tmp_path)
+    c = FleetCollector(root)
+    c.poll()
+    path = os.path.join(root, "events", "w1.jsonl")
+    with open(path, "a") as f:
+        f.write("{not json}\n")
+    assert c.poll()["events"] == 0
+    assert c.state["streams"]["w1.jsonl"]["malformed"] == 1
+    # a stream that SHRANK (rotation) re-reads from offset zero
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 1, "ts": 3000.0,
+                            "event": "worker_started",
+                            "worker": "w1"}) + "\n")
+    assert c.poll()["events"] == 1
+    assert c.state["streams"]["w1.jsonl"]["offset"] == \
+        os.path.getsize(path)
+
+
+def test_prometheus_exposition_format(tmp_path):
+    root = _fixture_copy(tmp_path)
+    c = FleetCollector(root, checkpoint=False)
+    c.poll()
+    text = c.prometheus_text()
+    assert text.endswith("\n")
+    lines = text.splitlines()
+    # every metric family announces itself: HELP then TYPE
+    helps = [i for i, ln in enumerate(lines)
+             if ln.startswith("# HELP")]
+    for i in helps:
+        assert lines[i + 1].startswith("# TYPE"), lines[i:i + 2]
+    # samples are `name{label="v",...} value` or `name value`
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        metric, _, value = ln.rpartition(" ")
+        float(value)            # parses as a number
+        assert metric and (metric.endswith("}") or "{" not in metric)
+    # fleet rollups sum the per-stream snapshots (w1: 4096, w2: 8192)
+    assert 'graft_fleet_counter{name="flips"} 12288' in lines
+    assert 'graft_fleet_workers{state="exited"} 2' in lines
+    assert 'graft_events_total{event="lease_acquired",' \
+           'stream="w1"} 1' in lines
+    # histogram digests surface count/sum/percentiles per stream
+    assert any(ln.startswith('graft_histogram{name="segment_wall_s"')
+               and '"p99"' in ln for ln in lines)
+    # checkpoint=False never dirtied the fixture copy
+    assert not os.path.exists(os.path.join(root, "events",
+                                           ".collector.json"))
+
+
+def test_fixture_passes_fleet_trace_gate():
+    trace_export = _tools("trace_export")
+    schema = trace_export._load_schema()
+    assert trace_export.validate_fleet(FIXTURE, schema) == 0
+    doc = trace_export.export(trace_export.fleet_streams(FIXTURE),
+                              schema, fleet=True)
+    evs = doc["traceEvents"]
+    names = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {"server", "w1", "w2"}
+    # one flow (s->f pair) per adopted top-level span: queue_wait +
+    # job span, per job
+    assert sum(1 for e in evs if e.get("cat") == "fleet"
+               and e["ph"] == "s") == 4
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate math
+# ---------------------------------------------------------------------------
+
+def _fleet_events(n_jobs=6, wait_s=1.0, tail_s=None):
+    evs = []
+    for i in range(n_jobs):
+        jid = f"j{i:04d}"
+        sub = 1000.0 + i
+        evs.append({"event": "job_submitted", "ts": sub, "job_id": jid})
+        wait = (tail_s if tail_s is not None and i == n_jobs - 1
+                else wait_s)
+        evs.append({"event": "lease_acquired", "ts": sub + wait,
+                    "job_id": jid, "worker": "w1"})
+    return evs
+
+
+def test_slo_clean_timeline_passes():
+    rows = slo.evaluate(_fleet_events())
+    assert all(r["ok"] for r in rows)
+    by = {r["name"]: r for r in rows}
+    assert by["queue_to_start_tail"]["value"] == 1.0
+    assert by["lease_expiry_rate"]["burn"] == 0.0
+
+
+def test_slo_queue_tail_trips_on_a_straggler():
+    rows = slo.evaluate(_fleet_events(n_jobs=8, wait_s=1.0,
+                                      tail_s=20.0))
+    r = {x["name"]: x for x in rows}["queue_to_start_tail"]
+    assert r["value"] == 20.0 and r["burn"] == pytest.approx(2.5)
+    assert not r["ok"]
+
+
+def test_slo_lease_expiry_storm_burns_by_worst_window():
+    # 5 expirations inside one 60s window: 5/min vs target 2/min
+    evs = _fleet_events() + [
+        {"event": "lease_expired", "ts": 1100.0 + 10 * k,
+         "job_id": "j0000", "worker": "w9"} for k in range(5)]
+    r = {x["name"]: x for x in slo.evaluate(evs)}["lease_expiry_rate"]
+    assert r["value"] == pytest.approx(5.0)
+    assert r["burn"] == pytest.approx(2.5) and not r["ok"]
+    # spread the same 5 at 50s apart: the worst 60s window holds only
+    # 2 -> exactly at target, ok
+    evs = _fleet_events() + [
+        {"event": "lease_expired", "ts": 1100.0 + 50 * k,
+         "job_id": "j0000", "worker": "w9"} for k in range(5)]
+    r = {x["name"]: x for x in slo.evaluate(evs)}["lease_expiry_rate"]
+    assert r["value"] == pytest.approx(2.0) and r["ok"]
+
+
+def test_slo_vacuous_below_min_count():
+    # 2 queue pairs < min_count 4: passes vacuously, burn 0, even with
+    # a catastrophic tail
+    rows = slo.evaluate(_fleet_events(n_jobs=2, tail_s=10_000.0))
+    r = {x["name"]: x for x in rows}["queue_to_start_tail"]
+    assert r["ok"] and r["burn"] == 0.0 and "vacuous" in r["detail"]
+
+
+def test_slo_floor_and_cache_burn_directions():
+    evs = _fleet_events()
+    # first board run is warmup (jit compile) and must be excluded;
+    # the straggler among the steady-state runs sets the floor
+    evs += [{"event": "run_end", "ts": 2000.0 + i,
+             "kernel_path": "board", "flips_per_s": fps}
+            for i, fps in enumerate((1.0, 100.0, 10.0))]
+    # k1's first miss is compulsory (cold); the 4 repeat probes (1 hit,
+    # 3 misses) are what the cache is judged on
+    evs += [{"event": "compile_cache_miss", "ts": 2010.0, "key": "k1"}]
+    evs += [{"event": "compile_cache_hit", "ts": 2011.0, "key": "k1"}]
+    evs += [{"event": "compile_cache_miss", "ts": 2012.0 + k,
+             "key": "k1"} for k in range(3)]
+    by = {r["name"]: r for r in slo.evaluate(evs)}
+    # floor objectives burn as target/value: 0.2 / 0.1 = 2.0
+    floor = by["throughput_floor"]
+    assert floor["value"] == pytest.approx(0.1)
+    assert floor["burn"] == pytest.approx(2.0) and not floor["ok"]
+    assert "1 warmup(s) excluded" in floor["detail"]
+    # hit-ratio burns the consumed error budget: exactly at target
+    # (0.25 hits) the budget is fully but not over-spent -> burn 1.0, ok
+    cache = by["compile_cache_hit_ratio"]
+    assert cache["value"] == pytest.approx(0.25)
+    assert cache["burn"] == pytest.approx(1.0) and cache["ok"]
+
+
+def test_slo_cold_start_is_not_a_breach():
+    """A cold fleet's compulsory work never burns budget: warmup-only
+    runs and first-seen-key misses leave both objectives vacuous."""
+    evs = _fleet_events()
+    # one run per shape group: all warmup, nothing steady-state
+    evs += [{"event": "run_end", "ts": 2000.0, "kernel_path": "board",
+             "flips_per_s": 1.0, "worker_name": "w1"},
+            {"event": "run_end", "ts": 2001.0, "kernel_path": "board",
+             "flips_per_s": 500.0, "worker_name": "w2"}]
+    # every probe is a distinct key's first miss
+    evs += [{"event": "compile_cache_miss", "ts": 2010.0 + k,
+             "key": f"k{k}"} for k in range(6)]
+    by = {r["name"]: r for r in slo.evaluate(evs)}
+    assert by["throughput_floor"]["ok"]
+    assert by["throughput_floor"]["count"] == 0
+    assert by["compile_cache_hit_ratio"]["ok"]
+    assert "cold" in by["compile_cache_hit_ratio"]["detail"]
+    # a first-seen HIT (persistent index pre-warm) still counts
+    evs += [{"event": "compile_cache_hit", "ts": 2020.0, "key": "p0"}]
+    r = {x["name"]: x for x in slo.evaluate(evs)}[
+        "compile_cache_hit_ratio"]
+    assert r["value"] == pytest.approx(1.0) and r["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet heartbeat probe (obs_report --heartbeat DIRECTORY mode)
+# ---------------------------------------------------------------------------
+
+def test_fleet_heartbeat_probe_names_the_stale_worker(tmp_path):
+    obs_report = _tools("obs_report")
+    d = os.path.join(str(tmp_path), "workers")
+    os.makedirs(d)
+
+    def doc(name, status, hb_s=2.0, age_s=0.0):
+        path = os.path.join(d, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump({"worker": name, "pid": 1, "ts": 0.0,
+                       "status": status, "job_id": None,
+                       "hb_s": hb_s}, f)
+        t = time.time() - age_s
+        os.utime(path, (t, t))
+
+    doc("w1", "running", hb_s=2.0, age_s=0.5)      # fresh
+    doc("w2", "running", hb_s=2.0, age_s=60.0)     # stale
+    doc("w3", "exited", hb_s=2.0, age_s=600.0)     # exempt by design
+    err = obs_report.check_fleet_heartbeats(str(tmp_path), 2.0)
+    assert err is not None and "worker w2" in err
+    assert "w1" not in err and "w3" not in err
+    # every worker fresh (or exited): no error
+    doc("w2", "running", hb_s=2.0, age_s=1.0)
+    assert obs_report.check_fleet_heartbeats(str(tmp_path), 2.0) is None
+    # an empty fleet has no liveness story: that's an error, not a pass
+    empty = os.path.join(str(tmp_path), "empty")
+    os.makedirs(os.path.join(empty, "workers"))
+    assert "no worker heartbeat docs" in \
+        obs_report.check_fleet_heartbeats(empty, 2.0)
+
+
+# ---------------------------------------------------------------------------
+# live endpoints: /v1/metrics, /v1/fleet, /v1/profile
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return (resp.status, resp.headers.get("Content-Type", ""),
+                resp.read().decode("utf-8"))
+
+
+def test_metrics_fleet_and_profile_endpoints(tmp_path):
+    with FleetServer(str(tmp_path)) as srv:
+        client = ServiceClient(srv.url, tenant="acme")
+        job_id = client.submit(workload="frank",
+                               overrides=OVERRIDES)["job_id"]
+        status, ctype, body = _get(srv.url + "/v1/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert "# TYPE graft_fleet_jobs gauge" in body
+        status, _, body = _get(srv.url + "/v1/fleet")
+        doc = json.loads(body)
+        assert doc["stages"] in ({"pending": 1}, {"queued": 1})
+        assert "queue_depth" in doc and doc["draining"] is False
+        # profile request: 404 unknown job, then marker drop + readback
+        req = urllib.request.Request(
+            srv.url + "/v1/profile/j9999", data=b"{}", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 404
+        req = urllib.request.Request(
+            srv.url + f"/v1/profile/{job_id}",
+            data=json.dumps({"segments": 2}).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read()) == {
+                "job_id": job_id, "segments": 2,
+                "profiling": "requested"}
+        marker = os.path.join(str(tmp_path), "profile",
+                              f"{job_id}.json")
+        assert json.load(open(marker))["segments"] == 2
+        _, _, body = _get(srv.url + f"/v1/profile/{job_id}")
+        doc = json.loads(body)
+        assert doc["requested"]["segments"] == 2
+        assert doc["captured"] is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: submit trace adopted by the worker, profile captured
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_trace_propagates_submit_to_worker_spans(tmp_path):
+    """The tentpole invariant in-process: the trace minted at submit is
+    the one the worker's spans carry, linked via ctx_parent_id to the
+    submit span, with the queue wait back-stamped — and the --fleet
+    gate agrees."""
+    root = str(tmp_path)
+    events = os.path.join(root, "events")
+    with obs.recorder.Recorder(
+            path=os.path.join(events, "server.jsonl"),
+            ident={"pid": os.getpid(), "worker_name": "server"}) as rec:
+        with FleetServer(root, recorder=rec) as srv:
+            client = ServiceClient(srv.url, tenant="acme")
+            job_id = client.submit(workload="frank",
+                                   overrides=OVERRIDES)["job_id"]
+            # profile marker BEFORE the run: the worker captures at
+            # its segment boundaries mid-job
+            req = urllib.request.Request(
+                srv.url + f"/v1/profile/{job_id}",
+                data=json.dumps({"segments": 1}).encode(),
+                method="POST")
+            urllib.request.urlopen(req, timeout=10).close()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not os.path.exists(
+                    os.path.join(root, "jobs", f"{job_id}.json")):
+                time.sleep(0.02)
+            with obs.recorder.Recorder(
+                    path=os.path.join(events, "w1.jsonl"),
+                    ident={"pid": os.getpid(),
+                           "worker_name": "w1"}) as wrec:
+                w = Worker(root, worker="w1", ttl_s=30.0,
+                           recorder=wrec)
+                assert w.run_once() == 1
+            assert client.status(job_id)["status"] == "done"
+
+    trace_id = f"job:{job_id}"
+    server_evs = [json.loads(ln) for ln in
+                  open(os.path.join(events, "server.jsonl"))]
+    worker_evs = [json.loads(ln) for ln in
+                  open(os.path.join(events, "w1.jsonl"))]
+    submit = [e for e in server_evs if e["event"] == "span_begin"
+              and e["name"] == "submit"]
+    assert len(submit) == 1 and submit[0]["trace_id"] == trace_id
+    # the server's job_submitted/http_request carry the trace too
+    assert any(e["event"] == "job_submitted"
+               and e.get("trace_id") == trace_id for e in server_evs)
+    wspans = [e for e in worker_evs if e["event"] == "span_begin"]
+    adopted = [e for e in wspans
+               if e.get("ctx_parent_id") == submit[0]["span_id"]]
+    assert adopted and all(e["trace_id"] == trace_id for e in adopted)
+    # queue wait back-stamped from the spool doc's submitted_ts
+    assert any(e["name"] == "queue_wait" for e in adopted)
+    job_span = [e for e in adopted if e["name"] == "job"]
+    assert len(job_span) == 1
+    # the run actually happened UNDER the adopted span (local child)
+    assert any(e.get("parent_id") == job_span[0]["span_id"]
+               for e in wspans)
+    # every worker event is pid/name-stamped at the Recorder layer
+    assert all(e.get("worker_name") == "w1" for e in worker_evs)
+
+    # the on-demand profile was honored at a segment boundary
+    capture = json.load(open(os.path.join(
+        root, "artifacts", f"{job_id}.profile.json")))
+    assert capture["ok"] is True and capture["segments"] >= 1
+    assert not os.path.exists(os.path.join(root, "profile",
+                                           f"{job_id}.json"))
+    assert any(e["event"] == "profile_captured" for e in worker_evs)
+
+    # the external gate sees the same story
+    trace_export = _tools("trace_export")
+    assert trace_export.validate_fleet(
+        root, trace_export._load_schema()) == 0
+
+
+# ---------------------------------------------------------------------------
+# the cross-process gate script (slow tier, like fleet-check)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_obsfleet_check_script(tmp_path):
+    """`make obsfleet-check` end to end: real server + 2 worker
+    processes, mid-run /v1/metrics scrape, --fleet trace gate, SLO
+    section + --strict breach injection, collector bench."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "obsfleet_check.sh")],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -9,9 +9,13 @@
 # per-tenant completed service share (>= 0.8): in an 8-job one-shot
 # burst, allocation is what weighted-fair admission controls — the
 # wait-time fairness axis needs statistics and is gated at 500 tenants
-# by tools/loadtest.py. The full matrix — claim races, lease aging,
-# bit-identical SIGKILL resume — lives in tests/test_preemption.py and
-# tests/test_fleet.py; this is the cross-process smoke.
+# by tools/loadtest.py. ISSUE 18 adds the observability legs: a mid-run
+# /v1/metrics + /v1/fleet scrape while the chaos is armed, the
+# trace_export --fleet end-to-end trace-parenting gate over the shared
+# $ROOT/events/ streams, and the SLO section of the merged report. The
+# full matrix — claim races, lease aging, bit-identical SIGKILL resume
+# — lives in tests/test_preemption.py and tests/test_fleet.py; this is
+# the cross-process smoke.
 #
 #   tools/fleet_check.sh
 #
@@ -40,9 +44,9 @@ cleanup() {
 }
 trap cleanup EXIT
 
-# -- 1. server up ------------------------------------------------------
+# -- 1. server up (events default to the canonical $ROOT/events/) ------
 "$PY" -m flipcomplexityempirical_tpu.service serve "$ROOT" \
-    --ready-file "$ROOT/server.json" --events "$TD/server-events.jsonl" \
+    --ready-file "$ROOT/server.json" \
     --ttl 2 &
 SERVER_PID=$!
 for _ in $(seq 1 120); do
@@ -79,13 +83,36 @@ PYEOF
 
 # -- 3. two workers; w2 is armed to SIGKILL itself mid-run -------------
 "$PY" -m flipcomplexityempirical_tpu.service worker "$ROOT" \
-    --name w1 --ttl 2 --idle-timeout 8 --compile-cache "$ROOT/cc" \
-    --events "$TD/w1-events.jsonl" &
+    --name w1 --ttl 2 --idle-timeout 8 --compile-cache "$ROOT/cc" &
 W1_PID=$!
 "$PY" -m flipcomplexityempirical_tpu.service worker "$ROOT" \
     --name w2 --ttl 2 --idle-timeout 8 --compile-cache "$ROOT/cc" \
-    --events "$TD/w2-events.jsonl" --faults worker.sigkill:once@3 &
+    --faults worker.sigkill:once@3 &
 W2_PID=$!
+
+# -- 3b. mid-run scrape: /v1/metrics + /v1/fleet serve LIVE collector
+# state while both workers run and the sigkill chaos is armed (the
+# read path is host-side file tailing only — G009 keeps device work
+# off handler threads)
+"$PY" - "$URL" <<'PYEOF'
+import json
+import sys
+import urllib.request
+
+url = sys.argv[1]
+with urllib.request.urlopen(url + "/v1/metrics", timeout=10) as resp:
+    assert resp.status == 200, resp.status
+    ctype = resp.headers.get("Content-Type", "")
+    assert ctype.startswith("text/plain"), ctype
+    body = resp.read().decode("utf-8")
+assert "# TYPE graft_fleet_jobs gauge" in body, body[:400]
+with urllib.request.urlopen(url + "/v1/fleet", timeout=10) as resp:
+    doc = json.loads(resp.read())
+assert "workers" in doc and "stages" in doc and "queue_depth" in doc
+print(f"fleet-check: mid-run scrape ok "
+      f"({len(body.splitlines())} metric lines, "
+      f"stages={doc['stages']})")
+PYEOF
 
 RC_W2=0
 wait "$W2_PID" || RC_W2=$?
@@ -169,9 +196,8 @@ for jid in statuses:
 # survivor broke it (lease_expired) and reclaimed; and no job was ever
 # freshly claimed twice (double execution)
 events = []
-for name in ("server-events.jsonl", "w1-events.jsonl",
-             "w2-events.jsonl"):
-    for line in open(os.path.join(td, name)):
+for name in ("server.jsonl", "w1.jsonl", "w2.jsonl"):
+    for line in open(os.path.join(root, "events", name)):
         line = line.strip()
         if not line:
             continue
@@ -207,10 +233,20 @@ print(f"fleet-check: {N} jobs done, {len(expired)} lease "
       f"max={waits[-1]:.2f}s")
 PYEOF
 
-# -- 6. telemetry gates: schema-valid streams + the Fleet report -------
-"$PY" tools/obs_report.py "$TD/server-events.jsonl" --check
-"$PY" tools/obs_report.py "$TD/w1-events.jsonl" --check
-cat "$TD/server-events.jsonl" "$TD/w1-events.jsonl" \
+# -- 6. telemetry gates: schema-valid streams + the Fleet/SLO report ---
+"$PY" tools/obs_report.py "$ROOT/events/server.jsonl" --check
+"$PY" tools/obs_report.py "$ROOT/events/w1.jsonl" --check
+cat "$ROOT/events/server.jsonl" "$ROOT/events/w1.jsonl" \
     > "$TD/merged-events.jsonl"
-"$PY" tools/obs_report.py "$TD/merged-events.jsonl" | grep -q "Fleet"
+"$PY" tools/obs_report.py "$TD/merged-events.jsonl" > "$TD/report.md"
+grep -q "Fleet" "$TD/report.md"
+grep -q "SLO" "$TD/report.md"
+
+# -- 7. the fleet trace gate: every terminal job's worker-side spans
+# parent (via ctx_parent_id links) under its HTTP submit span — across
+# the sigkill chaos (w2's torn stream is crash-tolerated) — and the
+# merged Perfetto export carries the flow links
+"$PY" tools/trace_export.py --fleet "$ROOT" --validate
+"$PY" tools/trace_export.py --fleet "$ROOT" -o "$TD/fleet.trace.json" \
+    | grep -q "trace link"
 echo "fleet-check: OK"

@@ -9,10 +9,10 @@
 
 from . import (g001_host_sync, g002_prng, g003_treedef, g004_events,
                g005_recorder, g006_pytest, g007_retry, g008_control,
-               g009_server)
+               g009_server, g010_tracectx)
 
 RULES = (g001_host_sync, g002_prng, g003_treedef, g004_events,
          g005_recorder, g006_pytest, g007_retry, g008_control,
-         g009_server)
+         g009_server, g010_tracectx)
 
 RULE_IDS = tuple(r.RULE_ID for r in RULES)

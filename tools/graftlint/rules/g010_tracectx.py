@@ -1,0 +1,95 @@
+"""G010: trace-context hygiene in the fleet's request/job paths.
+
+The fleet's observability story (ISSUE 18) hangs off one invariant:
+every event a request or job produces can be joined back to the trace
+the front door minted at submit time (``trace_id = "job:<id>"``).
+Events that break the chain are the ones that hurt — a
+``lease_expired`` with no trace context is exactly the crash-reclaim
+record an operator needs to find FROM the job's timeline and can't.
+
+Statically, in ``service/server.py`` and ``service/worker.py`` (the
+two processes that handle requests and jobs), every ``.emit()`` of a
+request/job-scoped event type — ``job_submitted``, ``http_request``,
+``quota_rejected``, ``lease_acquired``, ``lease_expired`` — must carry
+the context explicitly (a ``trace_id=`` or ``trace=`` keyword, even if
+the value is None: the author decided, rather than forgot) OR be
+emitted inside a ``with ...adopt(...)`` block, where the recorder
+stamps every span with the adopted context.
+
+Fleet-scoped events (``worker_started``/``worker_exited``) belong to
+no job and are exempt; span events inherit context from the tracer
+itself. Everything else stays out of scope — this is a contract about
+the fleet's serving surface, not a global tax on emit sites.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted_name
+
+RULE_ID = "G010"
+
+# request/job-scoped event types: each names a job or request whose
+# trace the front door minted; emitting one without context orphans it
+_SCOPED = frozenset({"job_submitted", "http_request", "quota_rejected",
+                     "lease_acquired", "lease_expired"})
+
+_CTX_KWARGS = frozenset({"trace_id", "trace"})
+
+
+def applies(module) -> bool:
+    return ("service/" in module.path
+            and module.path.endswith(("server.py", "worker.py"))
+            and not module.is_test)
+
+
+def _adopting_with(node) -> bool:
+    """True for a ``with`` statement whose context expression calls an
+    ``adopt`` (``obs.adopt(rec, ctx)`` / ``trace.adopt(...)``)."""
+    for item in node.items:
+        for call in ast.walk(item.context_expr):
+            if isinstance(call, ast.Call):
+                name = dotted_name(call.func) or ""
+                if name.split(".")[-1] == "adopt":
+                    return True
+    return False
+
+
+def _scoped_emit_type(node: ast.Call):
+    """The event-type literal of a ``.emit("<type>", ...)`` call when
+    it is one of the scoped types, else None."""
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit" and node.args):
+        return None
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str) \
+            and first.value in _SCOPED:
+        return first.value
+    return None
+
+
+def check(module, config):
+    findings = []
+
+    def visit(node, adopted):
+        if isinstance(node, (ast.With, ast.AsyncWith)) \
+                and _adopting_with(node):
+            adopted = True
+        if isinstance(node, ast.Call) and not adopted:
+            etype = _scoped_emit_type(node)
+            if etype is not None:
+                kwargs = {kw.arg for kw in node.keywords}
+                if not (kwargs & _CTX_KWARGS):
+                    findings.append(module.finding(
+                        RULE_ID, node,
+                        f"emit({etype!r}) without trace context — "
+                        "pass trace_id=/trace= (None is an explicit "
+                        "decision) or emit under `with ...adopt(...)`;"
+                        " an uncontexted request/job event cannot be "
+                        "joined to its submit trace"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, adopted)
+
+    visit(module.tree, False)
+    return findings

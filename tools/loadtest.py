@@ -41,6 +41,19 @@ theory says waits stay at the floor — shrink ``--spread-s`` or
 
 The simulated record is tagged ``device: cpu`` + ``cpu_fallback`` so
 no comparison ever mistakes a scheduling simulation for silicon.
+
+``--collector-bench`` (ISSUE 18, simulate-only) prices the fleet
+observability plane itself: the simulated scenario is materialized as
+a real fleet event layout (one server stream + K worker streams,
+written through the real ``obs.Recorder``), then ``FleetCollector`` is
+timed over it — a cold full tail, a warm incremental poll after an
+append (the restart path, through the on-disk offset checkpoint), and
+one ``/v1/metrics`` text render. The headline metric becomes
+``fleet_collector_events_per_s`` (higher is better, same
+``[tenants=N,workers=K]`` qualification) and the record carries
+``collector_overhead``: collector host-seconds per simulated
+fleet-second at this scenario's event volume —
+``--require-collector-overhead 0.02`` is the ≤2%% gate.
 """
 
 from __future__ import annotations
@@ -193,6 +206,104 @@ def live(url: str, tenants: int, jobs: int, workload: str,
             "rejected": rejected, "statuses": done}
 
 
+def collector_bench(tenants: int, jobs: int, workers: int,
+                    seed: int, makespan_s: float) -> dict:
+    """Materialize the simulated scenario's telemetry and time the
+    FleetCollector over it. The layout is the served one exactly: per
+    job one ``http_request`` + one ``job_submitted`` (trace context
+    attached, server stream) and one ``lease_acquired`` (its worker's
+    stream), plus a ``metrics_snapshot`` per worker — written through
+    the real Recorder so framing/fsync behavior is the production one.
+
+    Three timed legs: a COLD poll (full tail of every stream), a WARM
+    poll through a fresh collector instance after an append (the
+    restart path: offsets come back from the on-disk checkpoint), and
+    one Prometheus text render (what a /v1/metrics scrape pays).
+    ``collector_overhead`` divides the total by the scenario's
+    simulated makespan: host-seconds of collection per fleet-second,
+    at this scenario's event volume."""
+    import tempfile
+    import time as _time
+
+    from flipcomplexityempirical_tpu import obs
+    from flipcomplexityempirical_tpu.obs.aggregate import FleetCollector
+
+    root = tempfile.mkdtemp(prefix="graft-collector-bench-")
+    events_dir = os.path.join(root, "events")
+    os.makedirs(events_dir, exist_ok=True)
+
+    def _recorders():
+        server = obs.Recorder(
+            os.path.join(events_dir, "server.jsonl"),
+            ident={"pid": 1, "worker_name": "server"})
+        wrecs = [obs.Recorder(
+            os.path.join(events_dir, f"w{k}.jsonl"),
+            ident={"pid": 100 + k, "worker_name": f"w{k}"})
+            for k in range(workers)]
+        return server, wrecs
+
+    def _emit_jobs(server, wrecs, n, offset=0):
+        for i in range(n):
+            job_id = f"j{offset + i:05d}"
+            tenant = f"t{i % max(1, tenants):03d}"
+            trace_id = f"job:{job_id}"
+            server.emit("http_request", method="POST", path="/v1/jobs",
+                        status=200, dur_s=0.001, trace_id=trace_id)
+            server.emit("job_submitted", job_id=job_id, tag="bench",
+                        tenant=tenant, trace_id=trace_id)
+            wrecs[i % workers].emit(
+                "lease_acquired", job_id=job_id,
+                worker=f"w{i % workers}", reclaim=False,
+                trace_id=trace_id)
+
+    n_jobs = tenants * jobs
+    server, wrecs = _recorders()
+    _emit_jobs(server, wrecs, n_jobs)
+    for k, w in enumerate(wrecs):
+        w.emit("metrics_snapshot", counters={"flips": 1000 * (k + 1)},
+               gauges={}, histograms={
+                   "segment_wall_s": {"count": 8, "sum": 4.0,
+                                      "p50": 0.5, "p95": 0.9,
+                                      "p99": 1.0}})
+    server.close()
+    for w in wrecs:
+        w.close()
+
+    t0 = _time.perf_counter()
+    cold = FleetCollector(root).poll()
+    cold_s = _time.perf_counter() - t0
+
+    # warm leg: append a trickle, collect through a FRESH instance so
+    # the offsets round-trip the on-disk checkpoint (the restart path)
+    server, wrecs = _recorders()
+    _emit_jobs(server, wrecs, workers, offset=n_jobs)
+    server.close()
+    for w in wrecs:
+        w.close()
+    t0 = _time.perf_counter()
+    warm_collector = FleetCollector(root)
+    warm = warm_collector.poll()
+    warm_s = _time.perf_counter() - t0
+
+    t0 = _time.perf_counter()
+    text = warm_collector.prometheus_text()
+    render_s = _time.perf_counter() - t0
+
+    n_events = cold["events"] + warm["events"]
+    total_s = cold_s + warm_s + render_s
+    return {
+        "collector_events": n_events,
+        "collector_streams": cold["streams"],
+        "collector_cold_poll_s": round(cold_s, 6),
+        "collector_warm_poll_s": round(warm_s, 6),
+        "collector_render_s": round(render_s, 6),
+        "collector_poll_wall_s": round(total_s, 6),
+        "collector_events_per_s": round(n_events / max(total_s, 1e-9)),
+        "collector_overhead": round(total_s / max(makespan_s, 1e-9), 6),
+        "collector_metrics_lines": len(text.splitlines()),
+    }
+
+
 def build_record(waits: dict, turnarounds: dict, rejected: dict,
                  tenants: int, workers: int, jobs: int, mode: str,
                  extra=None) -> dict:
@@ -256,6 +367,16 @@ def main(argv=None):
     ap.add_argument("--set", dest="overrides", action="append",
                     metavar="K=V", help="live: workload override")
     ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--collector-bench", action="store_true",
+                    help="simulate-only: also materialize the scenario "
+                         "as real fleet event streams and time the "
+                         "FleetCollector over them; the headline "
+                         "metric becomes fleet_collector_events_per_s")
+    ap.add_argument("--require-collector-overhead", type=float,
+                    default=None, metavar="F",
+                    help="exit 1 unless collector_overhead (collector "
+                         "host-seconds per simulated fleet-second) "
+                         "<= F")
     ap.add_argument("--require-p99-ratio", type=float, default=None,
                     metavar="R", help="exit 1 unless p99 <= R x p50")
     ap.add_argument("--require-fairness", type=float, default=None,
@@ -280,7 +401,21 @@ def main(argv=None):
                    "spread_s": round(spread, 3),
                    "admit_s": args.admit_s, "seed": args.seed,
                    "makespan_s": round(sim["makespan_s"], 3)})
+        if args.collector_bench:
+            cb = collector_bench(args.tenants, args.jobs,
+                                 args.workers, args.seed,
+                                 sim["makespan_s"])
+            # re-headline: the fairness index stays in the record as a
+            # plain field, the gated metric is collector throughput
+            # (higher is better, same tenants/workers qualification)
+            record["fleet_fairness_jain"] = record["value"]
+            record.update(cb)
+            record["metric"] = "fleet_collector_events_per_s"
+            record["value"] = cb["collector_events_per_s"]
+            record["unit"] = "events/s"
     else:
+        if args.collector_bench:
+            ap.error("--collector-bench requires --simulate")
         overrides = {}
         for pair in args.overrides or ():
             k, v = pair.split("=", 1)
@@ -307,11 +442,18 @@ def main(argv=None):
         print(f"loadtest: p99/p50 {ratio} exceeds "
               f"{args.require_p99_ratio}", file=sys.stderr)
         rc = 1
+    jain = record.get("fleet_fairness_jain", record["value"])
     if (args.require_fairness is not None
-            and record["value"] < args.require_fairness):
-        print(f"loadtest: Jain {record['value']} below "
+            and jain < args.require_fairness):
+        print(f"loadtest: Jain {jain} below "
               f"{args.require_fairness}", file=sys.stderr)
         rc = 1
+    if args.require_collector_overhead is not None:
+        ov = record.get("collector_overhead")
+        if ov is None or ov > args.require_collector_overhead:
+            print(f"loadtest: collector overhead {ov} exceeds "
+                  f"{args.require_collector_overhead}", file=sys.stderr)
+            rc = 1
     return rc
 
 

@@ -24,8 +24,13 @@ leaves a start with no exit), lease expirations, quota rejections by
 tenant, http request status mix, and p50/p99 queue-to-start measured
 job_submitted -> first lease_acquired; ``--strict`` also fails on a
 lease-expiry STORM (more than 2 expirations for one job — lease churn,
-not crash recovery). A trailing sweep section summarizes driver
-progress events.
+not crash recovery). An SLO section (ISSUE 18) renders whenever the
+stream carries fleet events: the declarative objectives in
+``obs/slo.py`` (queue-to-start tail ratio, lease-expiry rate over the
+worst window, per-path throughput floor, compile-cache hit ratio)
+evaluated as burn rates — ``--strict`` fails on any violated
+objective. A trailing sweep section summarizes driver progress
+events.
 
 ``--check`` validates every line against the event schema
 (obs.events.EVENT_FIELDS envelope + per-type core fields) AND the span
@@ -41,7 +46,11 @@ printing the report) when the stream carries any ``anomaly``,
 rather than stream shape — or when ``--heartbeat PATH`` names a sweep
 heartbeat whose mtime is staler than 2x ``--heartbeat-interval``
 without a complete status (service heartbeats report WHICH namespaced
-per-job/per-batch file went stale and by how much). A Resilience
+per-job/per-batch file went stale and by how much). ``--heartbeat``
+pointed at a DIRECTORY (a fleet root, or its ``workers/`` subdir)
+probes every per-worker heartbeat doc instead: a worker whose doc went
+stale past 2x its own beat cadence is named with how far behind it is;
+cleanly "exited" workers are exempt. A Resilience
 section summarizes retries by error class, quarantines, kernel-path
 degradations, hung dispatches, mesh degradations, corrupt checkpoint
 generations, and heartbeat write failures whenever the stream carries
@@ -674,6 +683,89 @@ def report_fleet(events, out):
               file=out)
 
 
+_SLO_PY = os.path.join(_HERE, os.pardir, "flipcomplexityempirical_tpu",
+                       "obs", "slo.py")
+
+_FLEET_EVENTS = ("job_submitted", "lease_acquired", "lease_expired",
+                 "worker_started", "http_request")
+
+
+def _load_slo():
+    """Load obs.slo by file path, same stdlib-only discipline as the
+    schema module (no package import, no jax)."""
+    spec = importlib.util.spec_from_file_location("_obs_slo", _SLO_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def report_slo(events, out):
+    """The SLO section (ISSUE 18): obs/slo.py's declarative objectives
+    evaluated as burn rates over the stream. Rendered only when the
+    stream carries fleet events (single-process sweeps have no serving
+    objectives and stay byte-identical). Returns the evaluated rows so
+    ``--strict`` can gate on them, or None when not rendered."""
+    if not any(e["event"] in _FLEET_EVENTS for e in events):
+        return None
+    rows = _load_slo().evaluate(events)
+    print("\n## SLO", file=out)
+    print("| objective | target | value | burn | n | status |", file=out)
+    print("|---|---|---|---|---|---|", file=out)
+    for r in rows:
+        value = "-" if r["value"] is None else format(r["value"], ".3f")
+        print(f"| {r['name']} | {r['target']:g} | {value} "
+              f"| {r['burn']:.2f} | {r['count']} "
+              f"| {'ok' if r['ok'] else 'VIOLATED'} |", file=out)
+    for r in rows:
+        print(f"- {r['name']}: {r['detail']}", file=out)
+    return rows
+
+
+def check_fleet_heartbeats(dirpath: str, interval_s: float):
+    """Per-worker heartbeat probe over a fleet root (or its ``workers/``
+    subdir): a worker doc whose mtime is staler than 2x its own beat
+    cadence (the doc's ``hb_s``, falling back to ``interval_s``) is
+    named together with how far behind it is. Workers whose doc says
+    ``exited`` stopped beating by design and are exempt. Returns an
+    error string, or None when every worker is fresh (no docs at all is
+    an error — a fleet with no workers has no liveness story)."""
+    import time as _time
+
+    d = os.path.join(dirpath, "workers")
+    if not os.path.isdir(d):
+        d = dirpath
+    try:
+        names = sorted(n for n in os.listdir(d) if n.endswith(".json"))
+    except OSError as e:
+        return f"fleet heartbeats {dirpath}: unreadable ({e})"
+    if not names:
+        return f"fleet heartbeats {d}: no worker heartbeat docs"
+    stale = []
+    for name in names:
+        path = os.path.join(d, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            mtime = os.path.getmtime(path)
+        except (OSError, json.JSONDecodeError):
+            # torn mid-replace or vanished: the next beat rewrites it;
+            # staleness (not parseability) is the liveness signal here
+            continue
+        if str(doc.get("status", "")) == "exited":
+            continue
+        hb_s = doc.get("hb_s")
+        cadence = float(hb_s) if isinstance(hb_s, (int, float)) \
+            and hb_s > 0 else interval_s
+        age = _time.time() - mtime
+        if age > 2 * cadence:
+            worker = doc.get("worker") or name[:-len(".json")]
+            stale.append(
+                f"worker {worker}: stale — last beat {age:.0f}s ago "
+                f"(> 2x the {cadence:.0f}s cadence; status="
+                f"{doc.get('status', '?')}, job={doc.get('job_id')})")
+    return "; ".join(stale) if stale else None
+
+
 def _namespaced_heartbeat_path(path: str, tag: str) -> str:
     # mirror of experiments.driver.heartbeat_path_for (this tool must
     # stay importable without jax): heartbeat.json + 2B30P10 ->
@@ -799,7 +891,9 @@ def main(argv=None):
     ap.add_argument("--heartbeat", metavar="PATH", default=None,
                     help="also probe this sweep heartbeat file for "
                          "staleness (mtime > 2x --heartbeat-interval "
-                         "with a non-complete status); fails --strict")
+                         "with a non-complete status); a DIRECTORY "
+                         "probes every per-worker fleet heartbeat doc "
+                         "instead; fails --strict")
     ap.add_argument("--heartbeat-interval", type=float, default=300.0,
                     metavar="S",
                     help="expected heartbeat refresh cadence for the "
@@ -830,16 +924,21 @@ def main(argv=None):
     report_resilience(events, out)
     report_control(events, out)
     report_fleet(events, out)
+    slo_rows = report_slo(events, out)
     report_sweep(events, out)
     hb_error = None
     if args.heartbeat:
-        stopped = frozenset(
-            e.get("tag") for e in events
-            if e["event"] == "control_action"
-            and e.get("kind") == "stop" and e.get("tag"))
-        hb_error = check_heartbeat(args.heartbeat,
-                                   args.heartbeat_interval,
-                                   stopped_tags=stopped)
+        if os.path.isdir(args.heartbeat):
+            hb_error = check_fleet_heartbeats(args.heartbeat,
+                                              args.heartbeat_interval)
+        else:
+            stopped = frozenset(
+                e.get("tag") for e in events
+                if e["event"] == "control_action"
+                and e.get("kind") == "stop" and e.get("tag"))
+            hb_error = check_heartbeat(args.heartbeat,
+                                       args.heartbeat_interval,
+                                       stopped_tags=stopped)
         if hb_error:
             print(f"\n{hb_error}", file=out)
     if args.strict:
@@ -864,6 +963,13 @@ def main(argv=None):
             return 2
         if hb_error:
             print(f"--strict: {hb_error}", file=sys.stderr)
+            return 2
+        violated = [r for r in (slo_rows or ()) if not r["ok"]]
+        if violated:
+            print("--strict: SLO violated — "
+                  + "; ".join(f"{r['name']} burn {r['burn']:.2f} "
+                              f"({r['detail']})" for r in violated),
+                  file=sys.stderr)
             return 2
     return 0
 

@@ -1,0 +1,230 @@
+#!/usr/bin/env bash
+# Fleet observability gate (`make obsfleet-check`, ISSUE 18): one HTTP
+# front door + two worker processes over the canonical shared-root
+# event layout ($ROOT/events/<name>.jsonl), exercising every surface of
+# the observability plane end to end:
+#
+#   - /v1/metrics and /v1/fleet scraped MID-RUN serve live
+#     FleetCollector state (Prometheus text exposition + JSON topology);
+#   - an on-demand profile marker dropped over HTTP before the run is
+#     honored by the owning worker at a segment boundary and published
+#     as a fetchable artifact;
+#   - per-worker heartbeat docs appear under $ROOT/workers/ and the
+#     obs_report --heartbeat DIRECTORY probe passes on the drained
+#     fleet;
+#   - trace_export --fleet --validate proves every job's worker-side
+#     spans parent under its HTTP submit span, and the merged Perfetto
+#     export carries the cross-stream flow links;
+#   - the merged report renders the SLO section, --strict passes clean
+#     and trips (exit 2) on an injected lease-expiry storm;
+#   - the collector microbench holds the <= 2% overhead gate at the
+#     frozen 500-tenant/16-worker scenario (BENCH_obs_r16.json's
+#     shape).
+#
+#   tools/obsfleet_check.sh
+#
+# Exercised by tests/test_obsfleet.py, so tier-1 fails when the gate
+# rots.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+export JAX_PLATFORMS=cpu
+export JAX_COMPILATION_CACHE_DIR="${GRAFT_GATE_JAX_CACHE:-${TMPDIR:-/tmp}/graft-gate-jax-cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+TD="$(mktemp -d)"
+ROOT="$TD/fleet"
+SERVER_PID=""
+W1_PID=""
+W2_PID=""
+cleanup() {
+    for pid in "$SERVER_PID" "$W1_PID" "$W2_PID"; do
+        [ -n "$pid" ] && kill -9 "$pid" 2>/dev/null || true
+    done
+    rm -rf "$TD"
+}
+trap cleanup EXIT
+
+# -- 1. server up ------------------------------------------------------
+"$PY" -m flipcomplexityempirical_tpu.service serve "$ROOT" \
+    --ready-file "$ROOT/server.json" --ttl 2 &
+SERVER_PID=$!
+for _ in $(seq 1 120); do
+    [ -f "$ROOT/server.json" ] && break
+    kill -0 "$SERVER_PID" 2>/dev/null || {
+        echo "obsfleet-check: server died before binding" >&2; exit 1; }
+    sleep 0.25
+done
+[ -f "$ROOT/server.json" ] || {
+    echo "obsfleet-check: server never wrote its ready file" >&2
+    exit 1; }
+URL="$("$PY" - "$ROOT/server.json" <<'PYEOF'
+import json, sys
+print(json.load(open(sys.argv[1]))["url"])
+PYEOF
+)"
+
+# -- 2. three tenants submit; j0000 gets a profile request BEFORE any
+# worker runs, so the capture is honored at the job's first segment
+# boundary (the marker is per-job and one-shot)
+"$PY" - "$URL" <<'PYEOF'
+import json
+import sys
+import urllib.request
+
+from flipcomplexityempirical_tpu.service import ServiceClient
+
+url = sys.argv[1]
+for i in range(3):
+    client = ServiceClient(url, tenant=f"t{i}")
+    doc = client.submit(workload="frank",
+                        overrides={"total_steps": 60, "n_chains": 2,
+                                   "checkpoint_every": 20,
+                                   "seed": 3 + 13 * i})
+    assert doc["job_id"] == f"j{i:04d}", doc
+req = urllib.request.Request(url + "/v1/profile/j0000",
+                             data=json.dumps({"segments": 1}).encode(),
+                             method="POST")
+with urllib.request.urlopen(req, timeout=10) as resp:
+    out = json.loads(resp.read())
+assert out == {"job_id": "j0000", "segments": 1,
+               "profiling": "requested"}, out
+PYEOF
+[ -f "$ROOT/profile/j0000.json" ] || {
+    echo "obsfleet-check: profile marker never dropped" >&2; exit 1; }
+
+# -- 3. two workers run the spool to idle-exit; scrape mid-run ---------
+"$PY" -m flipcomplexityempirical_tpu.service worker "$ROOT" \
+    --name w1 --ttl 2 --idle-timeout 8 --compile-cache "$ROOT/cc" &
+W1_PID=$!
+"$PY" -m flipcomplexityempirical_tpu.service worker "$ROOT" \
+    --name w2 --ttl 2 --idle-timeout 8 --compile-cache "$ROOT/cc" &
+W2_PID=$!
+
+"$PY" - "$URL" <<'PYEOF'
+import json
+import sys
+import urllib.request
+
+url = sys.argv[1]
+with urllib.request.urlopen(url + "/v1/metrics", timeout=10) as resp:
+    assert resp.status == 200, resp.status
+    assert resp.headers.get("Content-Type", "").startswith("text/plain")
+    body = resp.read().decode("utf-8")
+assert "# TYPE graft_events_total counter" in body, body[:400]
+assert "graft_fleet_jobs{" in body, body[:400]
+with urllib.request.urlopen(url + "/v1/fleet", timeout=10) as resp:
+    doc = json.loads(resp.read())
+assert "workers" in doc and "streams" in doc
+assert doc["queue_depth"] >= 0 and doc["draining"] is False
+print(f"obsfleet-check: mid-run scrape ok "
+      f"({len(body.splitlines())} metric lines, "
+      f"stages={doc['stages']})")
+PYEOF
+
+RC_W1=0; RC_W2=0
+wait "$W1_PID" || RC_W1=$?
+W1_PID=""
+wait "$W2_PID" || RC_W2=$?
+W2_PID=""
+[ "$RC_W1" -eq 0 ] && [ "$RC_W2" -eq 0 ] || {
+    echo "obsfleet-check: workers exited $RC_W1/$RC_W2" >&2; exit 1; }
+
+# -- 4. the profile round-trip completed: marker consumed, capture
+# published as a fetchable artifact, profile_captured in the stream
+"$PY" - "$URL" "$ROOT" <<'PYEOF'
+import json
+import os
+import sys
+import urllib.request
+
+url, root = sys.argv[1], sys.argv[2]
+cap = json.load(open(os.path.join(root, "artifacts",
+                                  "j0000.profile.json")))
+assert cap["job_id"] == "j0000" and cap["segments"] >= 1, cap
+assert cap["ok"] is True, cap
+assert not os.path.exists(os.path.join(root, "profile", "j0000.json"))
+with urllib.request.urlopen(url + "/v1/profile/j0000",
+                            timeout=10) as resp:
+    doc = json.loads(resp.read())
+assert doc["requested"] is None and doc["captured"]["ok"] is True, doc
+docs = sorted(os.listdir(os.path.join(root, "workers")))
+assert docs == ["w1.json", "w2.json"], docs
+for name in docs:
+    hb = json.load(open(os.path.join(root, "workers", name)))
+    assert hb["status"] == "exited", hb
+print(f"obsfleet-check: profile captured "
+      f"({cap['segments']} segment(s)) by {cap['worker']}")
+PYEOF
+
+# -- 5. drain; serving ends with EXIT_DRAINED --------------------------
+"$PY" - "$URL" <<'PYEOF'
+import sys
+from flipcomplexityempirical_tpu.service import ServiceClient
+print(ServiceClient(sys.argv[1]).drain())
+PYEOF
+RC_SRV=0
+wait "$SERVER_PID" || RC_SRV=$?
+SERVER_PID=""
+[ "$RC_SRV" -eq 3 ] || {
+    echo "obsfleet-check: server exited $RC_SRV, expected 3" >&2
+    exit 1; }
+
+# -- 6. fleet trace gate + Perfetto export with flow links -------------
+"$PY" tools/trace_export.py --fleet "$ROOT" --validate
+"$PY" tools/trace_export.py --fleet "$ROOT" -o "$TD/fleet.trace.json" \
+    | grep -q "trace link"
+
+# -- 7. merged report: SLO section renders, heartbeat-directory probe
+# and --strict pass on the clean run
+cat "$ROOT"/events/*.jsonl > "$TD/merged-events.jsonl"
+"$PY" tools/obs_report.py "$TD/merged-events.jsonl" \
+    --heartbeat "$ROOT" --strict > "$TD/report.md"
+grep -q "## SLO" "$TD/report.md"
+grep -q "queue_to_start_tail" "$TD/report.md"
+
+# -- 8. an injected lease-expiry storm (5 expirations inside one 60s
+# window vs the 2/min objective) must trip --strict with exit 2
+"$PY" - "$TD" <<'PYEOF'
+import json
+import shutil
+import sys
+
+td = sys.argv[1]
+src = f"{td}/merged-events.jsonl"
+dst = f"{td}/storm-events.jsonl"
+shutil.copy(src, dst)
+last_ts = max(json.loads(ln)["ts"] for ln in open(src) if ln.strip())
+with open(dst, "a") as f:
+    for k in range(5):      # distinct jobs: the per-job storm gate
+        f.write(json.dumps({  # stays quiet; the SLO burn rate trips
+            "v": 1, "ts": last_ts + 1.0 + 10.0 * k,
+            "event": "lease_expired", "job_id": f"j{k:04d}",
+            "worker": "w9"}) + "\n")
+PYEOF
+RC_STORM=0
+"$PY" tools/obs_report.py "$TD/storm-events.jsonl" --strict \
+    > "$TD/storm-report.md" || RC_STORM=$?
+[ "$RC_STORM" -eq 2 ] || {
+    echo "obsfleet-check: --strict exited $RC_STORM on the injected" \
+         "storm, expected 2" >&2
+    exit 1; }
+grep -q "VIOLATED" "$TD/storm-report.md"
+
+# -- 9. collector overhead gate at the frozen bench scenario -----------
+"$PY" tools/loadtest.py --simulate --tenants 500 --workers 16 \
+    --collector-bench --require-collector-overhead 0.02 \
+    --require-fairness 0.8 --out "$TD/bench_obs.json"
+"$PY" - "$TD/bench_obs.json" <<'PYEOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+assert rec["metric"] == "fleet_collector_events_per_s", rec["metric"]
+assert rec["collector_overhead"] <= 0.02, rec["collector_overhead"]
+assert rec["fleet_fairness_jain"] >= 0.8, rec["fleet_fairness_jain"]
+print(f"obsfleet-check: collector {rec['value']:.0f} events/s, "
+      f"overhead {rec['collector_overhead']:.5f} "
+      f"over {rec['collector_events']} events")
+PYEOF
+
+echo "obsfleet-check: OK"

@@ -4,6 +4,7 @@
     python tools/trace_export.py EVENTS.jsonl                # -> EVENTS.trace.json
     python tools/trace_export.py -o run.trace.json E1 E2 ...  # merge hosts
     python tools/trace_export.py --validate EVENTS.jsonl      # gate only
+    python tools/trace_export.py --fleet ROOT [--validate]    # whole fleet
 
 The output opens directly in Perfetto (ui.perfetto.dev) or
 chrome://tracing: one timeline row per (host, thread), sweep → config →
@@ -27,6 +28,19 @@ parents, no id reuse) and exits nonzero listing each violation, without
 writing anything — the CI hook for "this stream will render".
 Stdlib-only: the schema module is loaded by file path, so neither mode
 imports jax (or any package) at all.
+
+``--fleet ROOT`` (ISSUE 18) exports a whole fleet root at once: every
+``ROOT/events/*.jsonl`` stream becomes its own Perfetto process (named
+after the stream — server, w1, ...), and the cross-process trace
+contexts the front door mints at submit time (``trace_id`` +
+``ctx_parent_id``, adopted by workers via ``obs.adopt``) render as
+Perfetto flow arrows from each job's HTTP ``submit`` span to its
+worker-side spans. ``--fleet --validate`` adds the fleet parenting
+gate on top of the per-stream contract: every job with a terminal
+status doc must have a submit span, a worker span adopting it, and a
+local child under that — while tolerating exactly the damage a
+SIGKILLed worker legitimately leaves (spans never closed, one torn
+final line).
 """
 
 from __future__ import annotations
@@ -83,23 +97,40 @@ def load_events(path: str, schema):
     return events, bad
 
 
-def validate(path: str, schema) -> int:
+def validate(path: str, schema, tolerate_crash: bool = False) -> int:
     """Schema gate + span contract for one stream; prints one line per
-    violation; returns the violation count."""
+    violation; returns the violation count.
+
+    ``tolerate_crash`` (fleet mode) forgives exactly what a SIGKILLed
+    writer legitimately leaves behind: spans never closed, and a torn
+    (malformed) FINAL line. Interior damage still fails — a crash
+    truncates a stream, it does not edit the middle of one."""
     bad = n = 0
     parsed = []
+    schema_errs = []        # (lineno, err)
+    last_lineno = 0
     with _open_text(path) as f:
         for lineno, line in enumerate(f, 1):
             if not line.strip():
                 continue
             n += 1
+            last_lineno = lineno
             err = schema.validate_line(line)
             if err is not None:
-                bad += 1
-                print(f"{path}:{lineno}: {err}", file=sys.stderr)
+                schema_errs.append((lineno, err))
             else:
                 parsed.append(json.loads(line))
+    if tolerate_crash:
+        schema_errs = [(ln, err) for ln, err in schema_errs
+                       if not (ln == last_lineno
+                               and err.startswith("malformed JSON"))]
+    for lineno, err in schema_errs:
+        bad += 1
+        print(f"{path}:{lineno}: {err}", file=sys.stderr)
     span_errors = schema.validate_spans(parsed)
+    if tolerate_crash:
+        span_errors = [e for e in span_errors
+                       if not e.endswith("never closed")]
     for err in span_errors:
         print(f"{path}: span contract: {err}", file=sys.stderr)
     n_spans = sum(1 for e in parsed if e["event"] == "span_begin")
@@ -107,6 +138,131 @@ def validate(path: str, schema) -> int:
         print(f"{path}: ok ({n} events, {n_spans} spans, "
               f"schema v{schema.SCHEMA_VERSION})")
     return bad + len(span_errors)
+
+
+def fleet_streams(root: str) -> list:
+    """The fleet root's per-process streams, sorted by name (dotfiles —
+    the collector checkpoint — excluded)."""
+    d = os.path.join(root, "events")
+    try:
+        names = sorted(n for n in os.listdir(d)
+                       if n.endswith((".jsonl", ".jsonl.gz"))
+                       and not n.startswith("."))
+    except OSError:
+        return []
+    return [os.path.join(d, n) for n in names]
+
+
+def _stream_name(path: str) -> str:
+    base = os.path.basename(path)
+    for suffix in (".gz", ".jsonl"):
+        if base.endswith(suffix):
+            base = base[:-len(suffix)]
+    return base
+
+
+def _fleet_flows(per_file) -> list:
+    """Perfetto flow arrows for the cross-process trace contexts: one
+    s->f pair from each submit span (the front door's, carrying the
+    ``job:<id>`` trace_id) to every adopted span that names it via
+    ``ctx_parent_id`` in another stream. The arrows are the rendered
+    form of the propagation the fleet validator proves."""
+    submits = {}            # (trace_id, span_id) -> (pid, begin event)
+    for _path, pid, events in per_file:
+        for e in events:
+            if (e["event"] == "span_begin" and e.get("name") == "submit"
+                    and e.get("trace_id")):
+                submits[(e["trace_id"], e["span_id"])] = (pid, e)
+    flows, fid = [], 0
+    for _path, pid, events in per_file:
+        for e in events:
+            cpid = e.get("ctx_parent_id")
+            if e["event"] != "span_begin" or cpid is None:
+                continue
+            src = submits.get((e.get("trace_id"), cpid))
+            if src is None:
+                continue
+            spid, sb = src
+            fid += 1
+            name = str(e.get("trace_id"))
+            flows.append({"name": name, "cat": "fleet", "ph": "s",
+                          "id": fid, "ts": sb["ts"] * 1e6, "pid": spid,
+                          "tid": sb.get("tid", 0)})
+            flows.append({"name": name, "cat": "fleet", "ph": "f",
+                          "bp": "e", "id": fid, "ts": e["ts"] * 1e6,
+                          "pid": pid, "tid": e.get("tid", 0)})
+    return flows
+
+
+def validate_fleet(root: str, schema) -> int:
+    """The fleet gate: per-stream contracts (crash-tolerant) plus
+    end-to-end trace parenting for every job that reached a terminal
+    status doc — (1) a ``submit`` span with the job's trace_id exists,
+    (2) some worker stream has a span adopting it (same trace_id,
+    ``ctx_parent_id`` = the submit span's id), and (3) that span has a
+    local child (the job actually ran under it). Jobs without status
+    docs (drained mid-flight, never claimed) are exempt: parenting is a
+    claim about executed work."""
+    paths = fleet_streams(root)
+    if not paths:
+        print(f"{root}: no event streams under events/", file=sys.stderr)
+        return 1
+    violations = 0
+    per_stream = []
+    for path in paths:
+        violations += validate(path, schema, tolerate_crash=True)
+        events, _bad = load_events(path, schema)
+        per_stream.append((path, events))
+    # index every begin across the fleet
+    submits = {}            # trace_id -> begin event (server stream)
+    adopted: dict = {}      # trace_id -> [(stream, begin)]
+    children = set()        # (stream, parent_id) with a local child
+    for path, events in per_stream:
+        for e in events:
+            if e["event"] != "span_begin":
+                continue
+            if e.get("name") == "submit" and e.get("trace_id"):
+                submits[e["trace_id"]] = e
+            if e.get("ctx_parent_id") is not None:
+                adopted.setdefault(e.get("trace_id"), []).append(
+                    (path, e))
+            if e.get("parent_id") is not None:
+                children.add((path, e["parent_id"]))
+    status_dir = os.path.join(root, "status")
+    try:
+        job_ids = sorted(n[:-len(".json")]
+                         for n in os.listdir(status_dir)
+                         if n.endswith(".json"))
+    except OSError:
+        job_ids = []
+    checked = 0
+    for job_id in job_ids:
+        trace_id = f"job:{job_id}"
+        sub = submits.get(trace_id)
+        if sub is None:
+            print(f"{root}: {job_id}: no submit span with trace_id "
+                  f"{trace_id!r}", file=sys.stderr)
+            violations += 1
+            continue
+        links = [(p, e) for p, e in adopted.get(trace_id, ())
+                 if e.get("ctx_parent_id") == sub["span_id"]]
+        if not links:
+            print(f"{root}: {job_id}: no worker span adopted trace "
+                  f"{trace_id!r} (ctx_parent_id {sub['span_id']!r})",
+                  file=sys.stderr)
+            violations += 1
+            continue
+        if not any((p, e["span_id"]) in children for p, e in links):
+            print(f"{root}: {job_id}: adopted span(s) have no local "
+                  f"children — job never ran under its trace",
+                  file=sys.stderr)
+            violations += 1
+            continue
+        checked += 1
+    if not violations:
+        print(f"{root}: fleet ok ({len(paths)} stream(s), "
+              f"{checked}/{len(job_ids)} terminal job(s) trace-parented)")
+    return violations
 
 
 def host_pid(path: str, index: int) -> int:
@@ -199,8 +355,11 @@ def file_trace_events(events, pid: int) -> list[dict]:
     return out
 
 
-def export(paths: list[str], schema) -> dict:
-    """Merge one or more streams into a single Chrome trace document."""
+def export(paths: list[str], schema, fleet: bool = False) -> dict:
+    """Merge one or more streams into a single Chrome trace document.
+    Fleet mode names each process after its stream (server, w1, ...) —
+    pids are positional, names carry the identity — and adds the
+    cross-process flow arrows."""
     trace = []
     t_min = None
     per_file = []
@@ -209,16 +368,19 @@ def export(paths: list[str], schema) -> dict:
         if bad:
             print(f"{path}: skipped {bad} malformed line(s)",
                   file=sys.stderr)
-        pid = host_pid(path, i)
+        pid = i if fleet else host_pid(path, i)
         per_file.append((path, pid, events))
         for e in events:
             if t_min is None or e["ts"] < t_min:
                 t_min = e["ts"]
     for path, pid, events in per_file:
+        name = (_stream_name(path) if fleet
+                else f"host{pid} ({os.path.basename(path)})")
         trace.append({"name": "process_name", "ph": "M", "pid": pid,
-                      "args": {"name": f"host{pid} "
-                                       f"({os.path.basename(path)})"}})
+                      "args": {"name": name}})
         trace.extend(file_trace_events(events, pid))
+    if fleet:
+        trace.extend(_fleet_flows(per_file))
     # rebase to t=0 so Perfetto's time axis starts at the run, not the
     # unix epoch
     if t_min is not None:
@@ -240,29 +402,48 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Export obs spans to Chrome trace-event JSON "
                     "(Perfetto / chrome://tracing)")
-    ap.add_argument("paths", nargs="+",
+    ap.add_argument("paths", nargs="*",
                     help="JSONL event stream(s); multiple files (e.g. "
                          "per-host events.host<K>.jsonl) merge into one "
                          "trace, one pid per file")
+    ap.add_argument("--fleet", metavar="ROOT",
+                    help="export a fleet root: every ROOT/events/*.jsonl "
+                         "stream becomes a named process, submit->worker "
+                         "trace contexts render as flow arrows; with "
+                         "--validate, gates end-to-end trace parenting")
     ap.add_argument("-o", "--output",
                     help="output path (default: first input with a "
-                         ".trace.json suffix)")
+                         ".trace.json suffix; fleet mode: "
+                         "ROOT/fleet.trace.json)")
     ap.add_argument("--validate", action="store_true",
                     help="validate only (schema + span nesting), write "
                          "nothing, exit nonzero on any violation")
     args = ap.parse_args(argv)
+    if bool(args.paths) == bool(args.fleet):
+        ap.error("pass either event stream paths or --fleet ROOT")
     schema = _load_schema()
 
     if args.validate:
+        if args.fleet:
+            return 1 if validate_fleet(args.fleet, schema) else 0
         return 1 if sum(validate(p, schema) for p in args.paths) else 0
 
-    doc = export(args.paths, schema)
-    out_path = args.output or default_output(args.paths[0])
+    paths = fleet_streams(args.fleet) if args.fleet else args.paths
+    if not paths:
+        print(f"{args.fleet}: no event streams under events/",
+              file=sys.stderr)
+        return 1
+    doc = export(paths, schema, fleet=bool(args.fleet))
+    out_path = args.output or (
+        os.path.join(args.fleet, "fleet.trace.json") if args.fleet
+        else default_output(paths[0]))
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(doc, f)
     n_slices = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    n_flows = sum(1 for e in doc["traceEvents"] if e.get("ph") == "s")
+    extra = f", {n_flows} trace link(s)" if args.fleet else ""
     print(f"{out_path}: {len(doc['traceEvents'])} trace events "
-          f"({n_slices} spans) from {len(args.paths)} stream(s)")
+          f"({n_slices} spans{extra}) from {len(paths)} stream(s)")
     return 0
 
 
