@@ -88,5 +88,9 @@ fleet-check:
 obsfleet-check:
 	PYTHON=$(PYTHON) tools/obsfleet_check.sh
 
+# full pack: per-file rules G001-G010 plus the whole-program stage
+# (G011 lock discipline, G012 durability protocol, G013 fault-site
+# conformance — also scans the gate .sh scripts' --faults plans).
+# Results are content-hash cached in .graftlint_cache.json.
 lint:
 	$(PYTHON) -m tools.graftlint flipcomplexityempirical_tpu tools

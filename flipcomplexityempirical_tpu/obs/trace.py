@@ -199,6 +199,10 @@ class _NullSpan:
 NULL_SPAN = _NullSpan()
 
 
+# A Span is a per-operation object owned by the thread that created it:
+# begin/end run on that one thread, and only the emitted events cross
+# threads (via the recorder's own discipline), so no field needs a lock.
+# graftlint: guarded-by(none: per-operation object, single-thread by construction)
 class Span:
     """One live span. Use as a context manager::
 

@@ -20,10 +20,10 @@ uses.
 
 from .errors import (CheckpointIdentityError, ConfigDeadlineExceeded,
                      KernelPathError)
-from .faults import (ENV_VAR, SITES, FaultPlan, FaultRule, InjectedFault,
-                     active_plan, corrupt_file, fault_point,
-                     install_from_env, install_from_spec, install_plan,
-                     truncate_file)
+from .faults import (ENV_VAR, FAULT_SITES, SITES, FaultPlan, FaultRule,
+                     InjectedFault, active_plan, corrupt_file,
+                     fault_point, install_from_env, install_from_spec,
+                     install_plan, truncate_file)
 from .degrade import (DEGRADATIONS, is_device_loss, is_kernel_error,
                       next_board_body, next_general_path,
                       record_degradation)
@@ -36,7 +36,8 @@ from .supervisor import (DETERMINISTIC, RESOURCE, TRANSIENT,
 __all__ = [
     "CheckpointIdentityError", "ConfigDeadlineExceeded",
     "KernelPathError",
-    "ENV_VAR", "SITES", "FaultPlan", "FaultRule", "InjectedFault",
+    "ENV_VAR", "FAULT_SITES", "SITES", "FaultPlan", "FaultRule",
+    "InjectedFault",
     "active_plan", "corrupt_file", "fault_point", "install_from_env",
     "install_from_spec", "install_plan", "truncate_file",
     "DEGRADATIONS", "is_device_loss", "is_kernel_error",

@@ -81,10 +81,29 @@ from typing import Optional
 
 ENV_VAR = "GRAFT_FAULTS"
 
-SITES = ("checkpoint.write", "checkpoint.load", "segment.step",
-         "compile", "recorder.emit", "heartbeat.write",
-         "sigterm", "journal.append", "dispatch.stall",
-         "lease.write", "http.accept", "worker.sigkill")
+# The canonical fault-site registry. Every ``fault_point`` /
+# ``corrupt_file`` call names a key of this dict, and graftlint's G013
+# checks injection points AND the ``--faults`` plan strings in the gate
+# scripts against it — rename a site here and every stale literal
+# anywhere in the tree flags at lint time instead of silently never
+# arming.
+FAULT_SITES = {
+    "checkpoint.write": "atomic checkpoint doc write (corruptible)",
+    "checkpoint.load": "checkpoint doc read/parse on recovery",
+    "segment.step": "one dispatched segment of the sweep loop",
+    "compile": "kernel compile/lower (cache-miss path)",
+    "recorder.emit": "telemetry event append",
+    "heartbeat.write": "driver/worker heartbeat doc write (corruptible)",
+    "sigterm": "drain-signal delivery point",
+    "journal.append": "fleet/run journal WAL append",
+    "dispatch.stall": "watchdog-observed dispatch stall",
+    "lease.write": "worker lease claim/refresh write (corruptible)",
+    "http.accept": "front-door connection accept",
+    "worker.sigkill": "hard worker kill between segments",
+}
+
+# Backwards-compatible tuple view (insertion order preserved).
+SITES = tuple(FAULT_SITES)
 
 _RAISING_MODES = ("fail", "always", "p")
 

@@ -53,9 +53,12 @@ import os
 import signal
 import threading
 import time
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from ..resilience import faults as rfaults
+
+if TYPE_CHECKING:
+    from .journal import Journal
 
 EXIT_DRAINED = 3
 
@@ -217,7 +220,8 @@ class DispatchWatchdog:
     service has no latency prior to scale from.
     """
 
-    def __init__(self, recorder=None, journal=None,
+    def __init__(self, recorder=None,
+                 journal: Optional["Journal"] = None,
                  timeout_s: Optional[float] = None, metrics=None,
                  floor_s: float = 30.0, scale: float = 10.0,
                  poll_s: float = 0.05):
@@ -299,7 +303,8 @@ class DispatchWatchdog:
             self._fire(batch_id, jobs, timeout, waited)
 
     def _fire(self, batch_id, jobs, timeout, waited):
-        self.stalled.append(batch_id)
+        with self._lock:
+            self.stalled.append(batch_id)
         if self.recorder is not None:
             self.recorder.emit("dispatch_stalled", batch_id=batch_id,
                                timeout_s=timeout,
@@ -313,7 +318,8 @@ class DispatchWatchdog:
                 pass  # the marker is advisory; the stall event stands
 
     def fired_for(self, batch_id: str) -> bool:
-        return batch_id in self.stalled
+        with self._lock:
+            return batch_id in self.stalled
 
     # -- chaos hook ---------------------------------------------------
 

@@ -203,7 +203,11 @@ class FrontDoor:
         self._clock = clock
         self.quota_rate = quota_rate
         self.quota_burst = quota_burst
+        # per-tenant buckets get-or-created on concurrent handler
+        # threads (submit runs before _cond is taken), so the map has
+        # its own lock; each TokenBucket then locks its own counters
         self._buckets: dict = {}
+        self._buckets_lock = threading.Lock()
         self.journal = jnl.Journal(jnl.journal_path_for(root),
                                    clock=clock)
         self._leases = LeaseManager(root, "server", ttl_s=ttl_s,
@@ -306,12 +310,13 @@ class FrontDoor:
     def _bucket(self, tenant: str) -> Optional[TokenBucket]:
         if self.quota_rate is None:
             return None
-        bucket = self._buckets.get(tenant)
-        if bucket is None:
-            bucket = TokenBucket(self.quota_rate, self.quota_burst,
-                                 clock=self._clock)
-            self._buckets[tenant] = bucket
-        return bucket
+        with self._buckets_lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = TokenBucket(self.quota_rate, self.quota_burst,
+                                     clock=self._clock)
+                self._buckets[tenant] = bucket
+            return bucket
 
     def submit(self, body: dict, tenant: str) -> dict:
         """Accept one submission: quota check, write-ahead journal,

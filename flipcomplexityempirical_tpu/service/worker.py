@@ -198,8 +198,12 @@ class LeaseManager:
         self._rec = obs.resolve_recorder(recorder)
         self._tomb_seq = 0
         # trace context per held job: rides every lease payload so the
-        # lease file itself witnesses which distributed trace owns it
+        # lease file itself witnesses which distributed trace owns it.
+        # Claim/release mutate on the worker's job thread while the
+        # lease heartbeat thread reads it through _payload — hence the
+        # lock.
         self._traces: dict = {}
+        self._traces_lock = threading.Lock()
 
     def path(self, job_id: str) -> str:
         return os.path.join(self.dir, f"{job_id}.lease")
@@ -226,7 +230,8 @@ class LeaseManager:
     def _payload(self, job_id: str) -> dict:
         doc = {"worker": self.worker, "pid": os.getpid(),
                "ts": self._clock()}
-        trace = self._traces.get(job_id)
+        with self._traces_lock:
+            trace = self._traces.get(job_id)
         if trace:
             doc["trace"] = trace
         return doc
@@ -258,8 +263,9 @@ class LeaseManager:
         rides the lease payload and stamps the claim events, so the
         lease protocol itself is visible in the job's distributed
         trace."""
-        self._traces[job_id] = dict(trace or {})
-        trace_id = self._traces[job_id].get("trace_id")
+        with self._traces_lock:
+            self._traces[job_id] = dict(trace or {})
+            trace_id = self._traces[job_id].get("trace_id")
         path = self.path(job_id)
         reclaim = False
         if not self._create(path, job_id):
@@ -307,7 +313,8 @@ class LeaseManager:
         rfaults.corrupt_file("lease.write", path)
 
     def release(self, job_id: str) -> None:
-        self._traces.pop(job_id, None)
+        with self._traces_lock:
+            self._traces.pop(job_id, None)
         try:
             os.remove(self.path(job_id))
         except FileNotFoundError:

@@ -1,0 +1,28 @@
+"""G011 positive fixture: a worker thread mutates shared state without
+the lock the other mutation site holds."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.events = []
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            self.total += 1          # unguarded on the worker thread
+            self.events.append(self.total)
+
+    def bump(self, n):
+        with self._lock:
+            self.total += n          # the lock the other site should hold
+
+
+def main():
+    c = Counter()
+    c.bump(3)
+    return c.total

@@ -1,0 +1,40 @@
+"""G011 negative fixture: every shared mutation happens under the one
+dominating lock; intentional lock-free fields carry guarded-by pragmas."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.hint = 0  # graftlint: guarded-by(none: monotonic hint, torn reads tolerated)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.total += 1
+            self.hint += 1
+
+    def bump(self, n):
+        with self._lock:
+            self.total += n
+
+
+# graftlint: guarded-by(none: per-request object, single-thread by construction)
+class Scratch:
+    def __init__(self):
+        self.items = []
+
+    def add(self, x):
+        self.items.append(x)
+
+
+def main():
+    c = Counter()
+    c.bump(3)
+    s = Scratch()
+    s.add(c.total)
+    return s.items

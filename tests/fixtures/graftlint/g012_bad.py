@@ -1,0 +1,27 @@
+"""G012 positive fixture: writes to durable roots without the atomic
+idioms (tmp+fsync+replace, O_EXCL create, fsync'd append)."""
+
+import json
+import os
+
+
+def save_status(run_dir, doc):
+    path = os.path.join(run_dir, "status", "job.json")
+    with open(path, "w", encoding="utf-8") as f:   # bare overwrite
+        json.dump(doc, f)
+
+
+def append_journal(run_dir, line):
+    path = os.path.join(run_dir, "journal.wal")
+    with open(path, "a", encoding="utf-8") as f:   # append, never fsync'd
+        f.write(line)
+
+
+def _write_doc(path, doc):
+    # helper that writes whatever path it is handed, non-atomically
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(doc)
+
+
+def publish_checkpoint(root, doc):
+    _write_doc(os.path.join(root, "checkpoint", "latest.json"), doc)

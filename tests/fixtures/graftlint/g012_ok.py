@@ -1,0 +1,50 @@
+"""G012 negative fixture: durable writes through the sanctioned
+idioms — tmp+fsync+replace, O_EXCL create, fsync'd append."""
+
+import json
+import os
+
+
+def save_status(run_dir, doc):
+    path = os.path.join(run_dir, "status", "job.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def append_journal(run_dir, line):
+    path = os.path.join(run_dir, "journal.wal")
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(line)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def claim_lease(run_dir, worker, payload):
+    path = os.path.join(run_dir, "leases", worker + ".lease")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    return True
+
+
+def _write_json_atomic(path, doc):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def publish_checkpoint(root, doc):
+    _write_json_atomic(os.path.join(root, "checkpoint", "latest.json"),
+                       doc)

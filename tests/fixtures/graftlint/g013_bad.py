@@ -1,0 +1,23 @@
+"""G013 positive fixture: fault-site literals that miss the registry.
+The fixture carries its own FAULT_SITES so the rule has a registry even
+when linted standalone."""
+
+FAULT_SITES = {
+    "checkpoint.write": "raise in the fsync window",
+    "journal.append": "raise before the WAL append",
+    "lease.write": "raise before the O_EXCL create",
+}
+
+
+def fault_point(site, **ctx):
+    return site
+
+
+def install_from_spec(spec):
+    return spec
+
+
+def run():
+    fault_point("checkpoint.wrte")               # typo: missing 'i'
+    fault_point("journal.append")                # registered: fine
+    install_from_spec("journal.append:once,worker.sigkill:always")

@@ -1,0 +1,22 @@
+"""G013 negative fixture: every fault-site literal and plan spec names
+a registered site."""
+
+FAULT_SITES = {
+    "checkpoint.write": "raise in the fsync window",
+    "journal.append": "raise before the WAL append",
+    "lease.write": "raise before the O_EXCL create",
+}
+
+
+def fault_point(site, **ctx):
+    return site
+
+
+def install_from_spec(spec):
+    return spec
+
+
+def run():
+    fault_point("checkpoint.write")
+    fault_point("lease.write", path="/tmp/x.lease")
+    install_from_spec("journal.append:once,lease.write:always,seed=7")

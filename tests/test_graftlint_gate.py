@@ -9,8 +9,12 @@ import shutil
 import subprocess
 import sys
 
+from tools.graftlint import LintConfig
+from tools.graftlint.engine import run_lint
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
+PKG = os.path.join(REPO, "flipcomplexityempirical_tpu")
 
 
 def _cli(args, cwd=REPO):
@@ -61,3 +65,77 @@ def test_obs_report_check_surfaces_baseline_count(tmp_path):
         cwd=REPO, capture_output=True, text=True)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "graftlint baseline: 0 grandfathered" in res.stdout
+
+
+# ---- seeded-defect proofs (the acceptance criteria) -------------------
+#
+# Each test copies real shipped sources, re-introduces one historical
+# defect class, and asserts the matching program rule trips — while
+# test_repo_lints_clean above pins the unmutated tree to zero findings.
+
+def _lint_copy(tmp_path, files, rule, mutate=None):
+    """Copy repo files into tmp (dest-relative paths), optionally
+    mutate one, and run the single program rule over the copy."""
+    for src, dst in files.items():
+        d = tmp_path / dst
+        d.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, src), d)
+    if mutate is not None:
+        dst, old, new = mutate
+        p = tmp_path / dst
+        text = p.read_text()
+        assert old in text, f"mutation anchor missing from {dst}"
+        p.write_text(text.replace(old, new, 1))
+    cfg = LintConfig(root=str(tmp_path), rules=frozenset({rule}),
+                     cache=False)
+    return run_lint([str(tmp_path)], cfg)
+
+
+def test_deleting_a_lock_trips_g011(tmp_path):
+    files = {"flipcomplexityempirical_tpu/service/server.py":
+             "svc/server.py",
+             "flipcomplexityempirical_tpu/service/journal.py":
+             "svc/journal.py"}
+    clean = _lint_copy(tmp_path, files, "G011")
+    assert clean == [], [f.render() for f in clean]
+    seeded = _lint_copy(tmp_path, files, "G011",
+                        mutate=("svc/server.py",
+                                "with self._buckets_lock:", "if True:"))
+    assert len(seeded) == 1, [f.render() for f in seeded]
+    assert "FrontDoor._buckets" in seeded[0].message
+
+
+def test_bare_durable_write_trips_g012(tmp_path):
+    files = {"flipcomplexityempirical_tpu/service/worker.py":
+             "svc/worker.py"}
+    clean = _lint_copy(tmp_path, files, "G012")
+    assert clean == [], [f.render() for f in clean]
+    atomic = ('    tmp = f"{path}.tmp.{os.getpid()}"\n'
+              '    with open(tmp, "w", encoding="utf-8") as f:\n'
+              '        json.dump(doc, f, sort_keys=True)\n'
+              '        f.flush()\n'
+              '        os.fsync(f.fileno())\n'
+              '    os.replace(tmp, path)\n')
+    bare = ('    with open(path, "w", encoding="utf-8") as f:\n'
+            '        json.dump(doc, f, sort_keys=True)\n')
+    seeded = _lint_copy(tmp_path, files, "G012",
+                        mutate=("svc/worker.py", atomic, bare))
+    assert seeded, "bare overwrite of durable docs went unflagged"
+    assert all(f.rule == "G012" for f in seeded)
+    roots = "\n".join(f.message for f in seeded)
+    assert "_write_json_atomic" in roots
+
+
+def test_misspelled_fault_site_in_gate_script_trips_g013(tmp_path):
+    files = {"flipcomplexityempirical_tpu/resilience/faults.py":
+             "resilience/faults.py",
+             "tools/fleet_check.sh": "tools/fleet_check.sh"}
+    clean = _lint_copy(tmp_path, files, "G013")
+    assert clean == [], [f.render() for f in clean]
+    seeded = _lint_copy(tmp_path, files, "G013",
+                        mutate=("tools/fleet_check.sh",
+                                "worker.sigkill:once",
+                                "worker.sigkil:once"))
+    assert len(seeded) == 1, [f.render() for f in seeded]
+    assert "worker.sigkil" in seeded[0].message
+    assert "did you mean 'worker.sigkill'?" in seeded[0].message

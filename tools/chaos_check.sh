@@ -42,6 +42,7 @@ GRAFT_FAULTS= "$PY" -m flipcomplexityempirical_tpu.experiments \
 
 # the chaos sweep: fail save 1, tear a part of save 2, fail segment 4 —
 # all absorbed by retries + the checksum fallback, same seed, same bits
+# (fault-site names here are G013-checked against FAULT_SITES)
 "$PY" -m flipcomplexityempirical_tpu.experiments \
     "${SWEEP_ARGS[@]}" --out "$tmp/fault" --checkpoint-dir "$tmp/ck" \
     --faults 'checkpoint.write:once,checkpoint.write:truncate@3,segment.step:once@4,seed=7' \

@@ -4,7 +4,10 @@
 # fixture stream so the gate itself needs no jax and no device.
 #
 #   1. graftlint over the package + tools (G004 emit conformance, G005
-#      NullRecorder purity, ...; must be clean against the committed
+#      NullRecorder purity, ..., plus the whole-program stage: G011
+#      lock discipline, G012 durability protocol, G013 fault-site
+#      conformance — including the fault plans in this script's
+#      sibling gate scripts; must be clean against the committed
 #      empty baseline)
 #   2. obs_report --check: schema + span pairing/nesting gate
 #   3. trace_export --validate: the same stream must convert to a

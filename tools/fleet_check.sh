@@ -85,6 +85,8 @@ PYEOF
 "$PY" -m flipcomplexityempirical_tpu.service worker "$ROOT" \
     --name w1 --ttl 2 --idle-timeout 8 --compile-cache "$ROOT/cc" &
 W1_PID=$!
+# plan sites below are pinned to resilience.faults.FAULT_SITES by
+# graftlint G013 — a renamed site fails `make lint`, not silently here
 "$PY" -m flipcomplexityempirical_tpu.service worker "$ROOT" \
     --name w2 --ttl 2 --idle-timeout 8 --compile-cache "$ROOT/cc" \
     --faults worker.sigkill:once@3 &

@@ -27,9 +27,16 @@ def main(argv=None) -> int:
                          "and exit 0")
     ap.add_argument("--root", default=".",
                     help="repo root for relative paths (default: cwd)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="process-parallel per-file rule dispatch "
+                         "(default: 1)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and don't write the content-hash "
+                         "result cache (.graftlint_cache.json)")
     args = ap.parse_args(argv)
 
-    config = LintConfig(root=args.root)
+    config = LintConfig(root=args.root, jobs=max(1, args.jobs),
+                        cache=not args.no_cache)
     findings = run_lint(args.paths, config)
 
     baseline_path = args.baseline

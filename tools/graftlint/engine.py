@@ -1,20 +1,45 @@
-"""Rule engine: file discovery, pragma handling, and rule dispatch."""
+"""Rule engine: file discovery, pragma handling, rule dispatch, the
+whole-program stage, and the content-hash result cache.
+
+Two kinds of rules live in the registry:
+
+* **per-file rules** (G001–G010): ``check(module, config)`` over one
+  ``ParsedModule`` — embarrassingly parallel, cacheable per file.
+* **program rules** (G011–G013, ``PROGRAM = True``): ``check_program(
+  program, config)`` over the cross-module :class:`~.program.Program`
+  index — one pass per lint run, cacheable against the digest of every
+  input file (any edit anywhere invalidates it, as an interprocedural
+  result must be).
+
+The cache (``.graftlint_cache.json`` at the repo root, git-ignored)
+keys per-file results on the file's content hash and the whole-program
+result on the sorted digest of all inputs, both salted with a
+fingerprint of graftlint's own sources so editing a rule re-lints
+everything. ``--jobs N`` farms cache-miss per-file work to a process
+pool; the program stage is one index build and stays in-process.
+"""
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
+import json
 import os
 import re
-from typing import Iterable, Iterator, List, Optional
+from typing import Iterable, Iterator, List, Optional, Tuple
 
 from .findings import Finding
 
 # ``# graftlint: disable=G001(reason),G002`` — reasons are free text in
 # balanced-paren-free parens; ``# graftlint: traced`` marks the next (or
-# same) line's ``def`` as a traced context.
+# same) line's ``def`` as a traced context; ``# graftlint:
+# guarded-by(<lock>: <reason>)`` declares an intentionally lock-free
+# attribute for G011 (on the attribute's assignment line, or the
+# preceding comment line).
 _PRAGMA_RE = re.compile(
-    r"#\s*graftlint:\s*(disable=([^#]*)|traced(?:\s*\([^)]*\))?)\s*$")
+    r"#\s*graftlint:\s*(disable=([^#]*)|traced(?:\s*\([^)]*\))?"
+    r"|guarded-by\s*\(([^)]*)\))\s*$")
 _RULE_TOKEN_RE = re.compile(r"(G\d{3}|all)(?:\(([^)]*)\))?")
 
 # Directory names never linted when walking (fixtures are deliberately
@@ -22,12 +47,17 @@ _RULE_TOKEN_RE = re.compile(r"(G\d{3}|all)(?:\(([^)]*)\))?")
 EXCLUDED_DIRS = frozenset({"__pycache__", ".git", "fixtures", ".venv",
                            "build", "dist"})
 
+CACHE_FILE = ".graftlint_cache.json"
+_CACHE_VERSION = 2
+
 
 @dataclasses.dataclass
 class LintConfig:
     root: str = "."                  # repo root; paths reported relative to it
     max_test_steps: int = 5000       # G006: unmarked tests may step <= this
     rules: Optional[frozenset] = None  # restrict to these rule ids (tests)
+    jobs: int = 1                    # per-file process parallelism
+    cache: bool = True               # content-hash result cache
 
 
 class Pragmas:
@@ -36,15 +66,18 @@ class Pragmas:
     A ``disable=`` pragma suppresses the named rules on its own line; on
     a comment-only line it suppresses them on the next non-blank source
     line instead. ``traced`` marks the next/same line for the traced-
-    context seeder.
+    context seeder; ``guarded-by(...)`` annotates the next/same line's
+    attribute for G011's intentional-lock-free exemption.
     """
 
     def __init__(self, source_lines: List[str]):
         self._disabled: dict = {}     # lineno -> set of rule ids / {"all"}
         self.reasons: dict = {}       # (lineno, rule) -> reason text
         self.traced_lines: set = set()
+        self.guarded: dict = {}       # lineno -> guarded-by payload text
         pending: List[tuple] = []     # comment-only pragmas awaiting code
         pending_traced = False
+        pending_guard: Optional[str] = None
         for i, raw in enumerate(source_lines, start=1):
             stripped = raw.strip()
             m = _PRAGMA_RE.search(raw)
@@ -59,7 +92,17 @@ class Pragmas:
                 if pending_traced:
                     self.traced_lines.add(i)
                     pending_traced = False
+                if pending_guard is not None:
+                    self.guarded[i] = pending_guard
+                    pending_guard = None
             if not m:
+                continue
+            if m.group(1).startswith("guarded-by"):
+                payload = (m.group(3) or "").strip()
+                if comment_only:
+                    pending_guard = payload
+                else:
+                    self.guarded[i] = payload
                 continue
             if m.group(1).startswith("traced"):
                 if comment_only:
@@ -118,6 +161,27 @@ class ParsedModule:
                        snippet=self.snippet(line))
 
 
+class ShellFile:
+    """A gate script the program stage scans (G013 fault plans)."""
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.path = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.pragmas = Pragmas(self.lines)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, line: int, col: int,
+                message: str) -> Finding:
+        return Finding(rule=rule, path=self.path, line=line, col=col,
+                       message=message, snippet=self.snippet(line))
+
+
 def _relpath(path: str, root: str) -> str:
     try:
         rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
@@ -126,55 +190,291 @@ def _relpath(path: str, root: str) -> str:
     return rel.replace(os.sep, "/")
 
 
-def iter_py_files(paths: Iterable[str], root: str) -> Iterator[str]:
+def _iter_files(paths: Iterable[str], suffix: str) -> Iterator[str]:
     for p in paths:
         if os.path.isfile(p):
-            if p.endswith(".py"):
+            if p.endswith(suffix):
                 yield p
             continue
         for dirpath, dirnames, filenames in os.walk(p):
             dirnames[:] = sorted(d for d in dirnames
                                  if d not in EXCLUDED_DIRS)
             for fn in sorted(filenames):
-                if fn.endswith(".py"):
+                if fn.endswith(suffix):
                     yield os.path.join(dirpath, fn)
 
 
-def lint_file(path: str, config: Optional[LintConfig] = None
-              ) -> List[Finding]:
-    """Lint one file, bypassing directory exclusions (used on fixtures)."""
+def iter_py_files(paths: Iterable[str], root: str) -> Iterator[str]:
+    yield from _iter_files(paths, ".py")
+
+
+def iter_sh_files(paths: Iterable[str], root: str) -> Iterator[str]:
+    yield from _iter_files(paths, ".sh")
+
+
+# -- rule dispatch -----------------------------------------------------
+
+
+def _split_rules():
     from .rules import RULES
-    config = config or LintConfig()
-    relpath = _relpath(path, config.root)
-    with open(path, "r", encoding="utf-8") as fh:
-        source = fh.read()
-    try:
-        module = ParsedModule(os.path.abspath(path), relpath, source)
-    except SyntaxError as exc:
-        return [Finding(rule="G000", path=relpath,
-                        line=exc.lineno or 1, col=(exc.offset or 1) - 1,
-                        message=f"syntax error: {exc.msg}")]
+    per_file = [r for r in RULES if not getattr(r, "PROGRAM", False)]
+    program = [r for r in RULES if getattr(r, "PROGRAM", False)]
+    return per_file, program
+
+
+def _selected(rule, config: LintConfig, module=None) -> bool:
+    if config.rules is not None:
+        # explicit rule selection (fixture tests) bypasses the
+        # path-scoping in applies()
+        return rule.RULE_ID in config.rules
+    if module is not None:
+        return rule.applies(module)
+    return True
+
+
+def _check_module(module: ParsedModule,
+                  config: LintConfig) -> List[Finding]:
+    per_file, _ = _split_rules()
     findings: List[Finding] = []
-    for rule in RULES:
-        if config.rules is not None:
-            # explicit rule selection (fixture tests) bypasses the
-            # path-scoping in applies()
-            if rule.RULE_ID not in config.rules:
-                continue
-        elif not rule.applies(module):
+    for rule in per_file:
+        if not _selected(rule, config, module):
             continue
         for f in rule.check(module, config):
             if not module.pragmas.suppressed(f.rule, f.line):
                 findings.append(f)
+    return findings
+
+
+def _check_program(modules: dict, shell_files: List[ShellFile],
+                   config: LintConfig) -> List[Finding]:
+    _, program_rules = _split_rules()
+    active = [r for r in program_rules if _selected(r, config)]
+    if not active:
+        return []
+    from .program import build_program
+    program = build_program(modules, shell_files)
+    findings: List[Finding] = []
+    by_path = {m.path: m for m in modules.values()}
+    by_path.update({sf.path: sf for sf in shell_files})
+    for rule in active:
+        for f in rule.check_program(program, config):
+            owner = by_path.get(f.path)
+            if owner is not None and owner.pragmas.suppressed(f.rule,
+                                                              f.line):
+                continue
+            findings.append(f)
+    return findings
+
+
+def _parse_module(path: str, config: LintConfig):
+    """Returns (ParsedModule | None, [G000 findings])."""
+    relpath = _relpath(path, config.root)
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        return ParsedModule(os.path.abspath(path), relpath, source), []
+    except SyntaxError as exc:
+        return None, [Finding(rule="G000", path=relpath,
+                              line=exc.lineno or 1,
+                              col=(exc.offset or 1) - 1,
+                              message=f"syntax error: {exc.msg}")]
+
+
+def lint_file(path: str, config: Optional[LintConfig] = None
+              ) -> List[Finding]:
+    """Lint one file, bypassing directory exclusions (used on
+    fixtures). Program rules run over a single-file program, so a
+    fixture exercises G011–G013 without the rest of the tree."""
+    config = config or LintConfig()
+    findings: List[Finding] = []
+    modules: dict = {}
+    shell_files: List[ShellFile] = []
+    if path.endswith(".sh"):
+        relpath = _relpath(path, config.root)
+        with open(path, "r", encoding="utf-8") as fh:
+            shell_files.append(ShellFile(os.path.abspath(path), relpath,
+                                         fh.read()))
+    else:
+        module, g000 = _parse_module(path, config)
+        if module is None:
+            return g000
+        modules[module.path] = module
+        findings.extend(_check_module(module, config))
+    findings.extend(_check_program(modules, shell_files, config))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+# -- cache -------------------------------------------------------------
+
+_PACK_FP: Optional[str] = None
+
+
+def _pack_fingerprint() -> str:
+    """Digest of graftlint's own sources: editing the linter
+    invalidates every cached result."""
+    global _PACK_FP
+    if _PACK_FP is None:
+        h = hashlib.sha1()
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        names = []
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    names.append(os.path.join(dirpath, fn))
+        for name in sorted(names):
+            with open(name, "rb") as fh:
+                h.update(name.encode() + b"\0" + fh.read() + b"\0")
+        _PACK_FP = h.hexdigest()
+    return _PACK_FP
+
+
+def _sha_file(path: str) -> str:
+    with open(path, "rb") as fh:
+        return hashlib.sha1(fh.read()).hexdigest()
+
+
+def _load_cache(path: str) -> dict:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if (doc.get("v") == _CACHE_VERSION
+                and doc.get("pack") == _pack_fingerprint()
+                and isinstance(doc.get("files"), dict)):
+            return doc
+    except (OSError, ValueError):
+        pass
+    return {"v": _CACHE_VERSION, "pack": _pack_fingerprint(),
+            "files": {}, "program": {}}
+
+
+def _save_cache(path: str, doc: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return dataclasses.asdict(f)
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(rule=d["rule"], path=d["path"], line=d["line"],
+                   col=d["col"], message=d["message"],
+                   snippet=d.get("snippet", ""))
+
+
+def _pool_lint_one(args: Tuple[str, str, int]) -> Tuple[str, list]:
+    """Process-pool worker: per-file rules for one path."""
+    path, root, max_test_steps = args
+    config = LintConfig(root=root, max_test_steps=max_test_steps)
+    module, g000 = _parse_module(path, config)
+    if module is None:
+        return path, [_finding_to_dict(f) for f in g000]
+    return path, [_finding_to_dict(f)
+                  for f in _check_module(module, config)]
+
+
+# -- whole-run driver --------------------------------------------------
 
 
 def run_lint(paths: Iterable[str], config: Optional[LintConfig] = None
              ) -> List[Finding]:
     config = config or LintConfig()
+    py_files = list(dict.fromkeys(iter_py_files(paths, config.root)))
+    sh_files = list(dict.fromkeys(iter_sh_files(paths, config.root)))
+
+    use_cache = config.cache and config.rules is None
+    cache_path = os.path.join(config.root, CACHE_FILE)
+    cache = _load_cache(cache_path) if use_cache else {
+        "v": _CACHE_VERSION, "pack": _pack_fingerprint(), "files": {},
+        "program": {}}
+
+    shas = {p: _sha_file(p) for p in py_files + sh_files}
+    rel = {p: _relpath(p, config.root) for p in py_files + sh_files}
+
     findings: List[Finding] = []
-    for path in iter_py_files(paths, config.root):
-        findings.extend(lint_file(path, config))
+    new_files: dict = {}
+    misses: List[str] = []
+    for p in py_files:
+        entry = cache["files"].get(rel[p])
+        if use_cache and entry and entry.get("sha") == shas[p]:
+            cached = [_finding_from_dict(d) for d in entry["findings"]]
+            findings.extend(cached)
+            new_files[rel[p]] = entry
+        else:
+            misses.append(p)
+
+    per_file_results: dict = {}
+    if misses and config.jobs > 1:
+        import multiprocessing
+
+        with multiprocessing.Pool(config.jobs) as pool:
+            for path, dicts in pool.imap_unordered(
+                    _pool_lint_one,
+                    [(p, config.root, config.max_test_steps)
+                     for p in misses]):
+                per_file_results[path] = [
+                    _finding_from_dict(d) for d in dicts]
+    else:
+        for p in misses:
+            module, g000 = _parse_module(p, config)
+            if module is None:
+                per_file_results[p] = g000
+            else:
+                per_file_results[p] = _check_module(module, config)
+
+    for p in misses:
+        fs = per_file_results[p]
+        findings.extend(fs)
+        new_files[rel[p]] = {"sha": shas[p],
+                             "findings": [_finding_to_dict(f)
+                                          for f in fs]}
+    for p in sh_files:
+        new_files[rel[p]] = {"sha": shas[p], "findings": []}
+
+    # program stage: keyed on every input's digest
+    h = hashlib.sha1()
+    for p in sorted(py_files + sh_files, key=lambda q: rel[q]):
+        h.update(f"{rel[p]}:{shas[p]}\n".encode())
+    program_key = h.hexdigest()
+
+    prog_entry = cache.get("program") or {}
+    if use_cache and prog_entry.get("key") == program_key:
+        findings.extend(_finding_from_dict(d)
+                        for d in prog_entry["findings"])
+        new_program = prog_entry
+    else:
+        modules: dict = {}
+        for p in py_files:
+            module, _ = _parse_module(p, config)
+            if module is not None:
+                modules[module.path] = module
+        shell_objs: List[ShellFile] = []
+        for p in sh_files:
+            with open(p, "r", encoding="utf-8") as fh:
+                shell_objs.append(ShellFile(os.path.abspath(p), rel[p],
+                                            fh.read()))
+        prog_findings = _check_program(modules, shell_objs, config)
+        findings.extend(prog_findings)
+        new_program = {"key": program_key,
+                       "findings": [_finding_to_dict(f)
+                                    for f in prog_findings]}
+
+    if use_cache:
+        _save_cache(cache_path, {"v": _CACHE_VERSION,
+                                 "pack": _pack_fingerprint(),
+                                 "files": new_files,
+                                 "program": new_program})
+
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -1,0 +1,920 @@
+"""Whole-program index: symbols, class attribute types, call graph,
+thread entry points, and lock-dominance dataflow (ISSUE 19).
+
+Built once per lint run from every ``ParsedModule`` in the invocation,
+the :class:`Program` is what turns graftlint from a per-file syntactic
+pass into an interprocedural analyzer. The index is deliberately
+heuristic in the direction a linter must be — resolution only follows
+facts the source states explicitly (constructor calls, parameter / class
+-body annotations, ``self`` receivers, intra-repo imports), so an edge
+in the call graph is close to certain while a *missing* edge is merely
+unknown. Rules built on top (G011/G012) therefore only reason from
+resolved edges and stay quiet about the rest; intentional exceptions are
+one ``# graftlint:`` pragma away.
+
+Resolution ladder for a call ``expr.m(...)`` / ``f(...)``:
+
+1. ``self.m(...)``      -> method ``m`` of the enclosing class or its
+                           indexed bases;
+2. typed receivers      -> local vars assigned from an indexed
+                           constructor, annotated parameters (incl.
+                           ``Optional[X]`` and quoted forwards), class
+                           attributes whose ``__init__`` assignment or
+                           class-body annotation names an indexed class,
+                           and return annotations of resolved callees;
+3. module symbols       -> functions/classes defined or imported
+                           (``from .x import y``, ``from . import x``)
+                           anywhere in the linted set;
+4. attribute fallback   -> ``anything.attr`` where exactly ONE indexed
+                           class declares ``attr`` with a class type
+                           (e.g. ``self.server.front`` via the
+                           ``front: FrontDoor`` class-body annotation).
+
+Thread roots recognized: ``threading.Thread`` subclasses (their ``run``
+is an entry), ``threading.Thread(target=...)``, ``do_*`` methods of
+HTTP handler classes (entered *concurrently* — each counts as two
+threads), ``signal.signal(sig, handler)`` callbacks, and callables
+passed into a thread-subclass constructor (the ``beat_fn`` pattern:
+they run on that thread). Everything with no in-index caller and no
+thread reference seeds the implicit **main** root.
+
+Lock facts: an attribute assigned ``threading.Lock/RLock/Condition()``
+types as a lock; ``with self._lock:`` (or ``with mod._LOCK:`` /
+``with x.attr_lock:`` on typed chains) establishes the lock lexically;
+a fixpoint over the call graph then computes, for every function, the
+set of locks *guaranteed held on every resolved path from any entry* —
+which is exactly the dominance fact G011 needs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import dotted_name, parents, walk_with_parents
+
+# Type-lattice tokens for non-class types we care about.
+LOCK = "@lock"          # threading.Lock / RLock / Condition
+EVENT = "@event"        # threading.Event (atomic by contract)
+THREAD = "@thread"      # a threading.Thread instance
+
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+_EVENT_CTORS = frozenset({"Event"})
+_THREAD_CTORS = frozenset({"Thread"})
+
+# Container-mutating method names (same list G009 uses): a call
+# ``self.attr.append(x)`` mutates ``attr`` when attr isn't an indexed
+# class (when it is, the call is an edge and the callee is analyzed).
+MUTATORS = frozenset({"append", "add", "pop", "update", "setdefault",
+                      "insert", "remove", "extend", "clear", "popitem",
+                      "discard", "appendleft"})
+
+
+class FuncInfo:
+    """One function/method in the index. Nested defs and lambdas are
+    inlined into their enclosing function's body (they execute on the
+    same threads unless explicitly handed to a thread, which the
+    thread-root seeding handles separately)."""
+
+    __slots__ = ("module", "node", "cls", "name", "qualname")
+
+    def __init__(self, module, node, cls: Optional["ClassInfo"]):
+        self.module = module
+        self.node = node
+        self.cls = cls
+        self.name = node.name
+        owner = f"{cls.name}." if cls is not None else ""
+        self.qualname = f"{module.path}::{owner}{node.name}"
+
+    def __repr__(self):
+        return f"<func {self.qualname}>"
+
+
+class ClassInfo:
+    __slots__ = ("module", "node", "name", "bases", "methods",
+                 "attr_types", "attr_lines", "attr_values", "is_thread",
+                 "is_handler")
+
+    def __init__(self, module, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.bases: List[str] = [d for d in
+                                 (dotted_name(b) for b in node.bases)
+                                 if d]
+        self.methods: Dict[str, FuncInfo] = {}
+        # attr -> set of type tokens (ClassInfo objects or @-strings)
+        self.attr_types: Dict[str, set] = {}
+        # attr -> [lineno, ...] of assignment/annotation sites
+        self.attr_lines: Dict[str, List[int]] = {}
+        # attr -> [value exprs] (G012 resolves path strings through them)
+        self.attr_values: Dict[str, List[ast.AST]] = {}
+        self.is_thread = False
+        self.is_handler = False
+
+    def attrs(self) -> Set[str]:
+        return set(self.attr_types)
+
+    def __repr__(self):
+        return f"<class {self.module.path}::{self.name}>"
+
+
+class Root:
+    """A source of control flow. ``weight`` counts how many concurrent
+    threads the root contributes (HTTP handlers are entered by a
+    threaded server, hence 2)."""
+
+    __slots__ = ("kind", "label", "entries", "weight")
+
+    def __init__(self, kind: str, label: str, weight: int = 1):
+        self.kind = kind          # "main" | "thread" | "handler" | "signal"
+        self.label = label        # display, e.g. "thread:_Heartbeat.run"
+        self.entries: List[FuncInfo] = []
+        self.weight = weight
+
+    def __repr__(self):
+        return f"<root {self.label} w={self.weight}>"
+
+
+class Access:
+    """One attribute access site."""
+
+    __slots__ = ("func", "node", "line", "is_store", "lexical_locks")
+
+    def __init__(self, func: FuncInfo, node: ast.AST, is_store: bool,
+                 lexical_locks: frozenset):
+        self.func = func
+        self.node = node
+        self.line = getattr(node, "lineno", 1)
+        self.is_store = is_store
+        self.lexical_locks = lexical_locks
+
+
+def _self_name(func_node) -> Optional[str]:
+    args = func_node.args
+    if args.args:
+        return args.args[0].arg
+    return None
+
+
+def _ann_names(ann: Optional[ast.AST]) -> List[str]:
+    """Candidate type names from an annotation: unwraps Optional[...]/
+    quoted forwards; returns dotted names."""
+    if ann is None:
+        return []
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return []
+    if isinstance(ann, ast.Subscript):
+        head = dotted_name(ann.value) or ""
+        if head.split(".")[-1] in ("Optional", "Union"):
+            inner = ann.slice
+            elts = inner.elts if isinstance(inner, ast.Tuple) else [inner]
+            out: List[str] = []
+            for e in elts:
+                out.extend(_ann_names(e))
+            return out
+        return []
+    d = dotted_name(ann)
+    return [d] if d else []
+
+
+class Program:
+    """The cross-module index. ``modules`` maps repo-relative posix
+    paths to ParsedModule; ``shell_files`` is the list of
+    ``engine.ShellFile`` gate scripts G013 scans."""
+
+    def __init__(self, modules: Dict[str, object],
+                 shell_files: Optional[list] = None):
+        self.modules = modules
+        self.shell_files = shell_files or []
+
+        self.classes: List[ClassInfo] = []
+        self.functions: List[FuncInfo] = []
+        # relpath -> {local name: ("class", ClassInfo) | ("func", FuncInfo)
+        #             | ("module", relpath) | ("const", value)}
+        self.symbols: Dict[str, Dict[str, tuple]] = {}
+        self._cls_of_node: Dict[int, ClassInfo] = {}
+        self._func_of_node: Dict[int, FuncInfo] = {}
+        # attr name -> [ClassInfo] declaring it with a class-typed value
+        self._attr_owners: Dict[str, List[ClassInfo]] = {}
+        # call edges: (caller, callee, frozenset of lock ids at the site)
+        self.edges: List[Tuple[FuncInfo, FuncInfo, frozenset]] = []
+        self._edges_in: Dict[FuncInfo, List[tuple]] = {}
+        self.roots: List[Root] = []
+        # attribute accesses: (ClassInfo, attr) -> [Access]
+        self.accesses: Dict[Tuple[ClassInfo, str], List[Access]] = {}
+        self.held: Dict[FuncInfo, Optional[frozenset]] = {}
+        self._reach: Dict[int, Set[FuncInfo]] = {}
+        self._init_ctx: Set[FuncInfo] = set()
+
+        self._build()
+
+    # -- construction -------------------------------------------------
+
+    def _build(self) -> None:
+        for mod in self.modules.values():
+            walk_with_parents(mod.tree)
+            self._index_module(mod)
+        for cls in self.classes:
+            self._resolve_bases(cls)
+        # two passes: pass 2 types attrs assigned from attrs of classes
+        # indexed later in pass 1 (``self.front = httpd.front``)
+        for _ in range(2):
+            for mod in self.modules.values():
+                self._collect_class_attrs(mod)
+        for attr, owners in list(self._attr_owners.items()):
+            # dedupe, keep deterministic order
+            seen: List[ClassInfo] = []
+            for c in owners:
+                if c not in seen:
+                    seen.append(c)
+            self._attr_owners[attr] = seen
+        for func in self.functions:
+            self._walk_function(func)
+        self._seed_roots()
+        self._compute_reach()
+        self._compute_init_ctx()
+        self._compute_held()
+
+    def _index_module(self, mod) -> None:
+        table: Dict[str, tuple] = {}
+        self.symbols[mod.path] = table
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls = ClassInfo(mod, node)
+                self.classes.append(cls)
+                self._cls_of_node[id(node)] = cls
+                table[cls.name] = ("class", cls)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fi = FuncInfo(mod, item, cls)
+                        cls.methods[item.name] = fi
+                        self.functions.append(fi)
+                        self._func_of_node[id(item)] = fi
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(mod, node, None)
+                self.functions.append(fi)
+                self._func_of_node[id(node)] = fi
+                table[node.name] = ("func", fi)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    if (isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)):
+                        table[tgt.id] = ("const", node.value.value)
+                    else:
+                        tok = self._builtin_ctor_token(node.value)
+                        if tok:
+                            table[tgt.id] = ("token", tok)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._index_import(mod, node, table)
+            elif (isinstance(node, ast.If)
+                    and (dotted_name(node.test) or "").split(".")[-1]
+                    == "TYPE_CHECKING"):
+                # typing-only imports back quoted annotations
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                        self._index_import(mod, stmt, table)
+
+    def _index_import(self, mod, node, table) -> None:
+        if isinstance(node, ast.Import):
+            return  # absolute external imports: not resolved
+        target = self._resolve_module(mod.path, node.level, node.module)
+        for alias in node.names:
+            local = alias.asname or alias.name
+            # ``from .pkg import mod`` names a submodule, ``from .mod
+            # import sym`` names a symbol; try submodule first (the
+            # submodule can resolve even when the package __init__
+            # isn't part of the lint set, e.g. fixture trees).
+            sub = self._resolve_module(mod.path, node.level,
+                                       (node.module + "." + alias.name)
+                                       if node.module else alias.name)
+            if sub is not None:
+                table[local] = ("module", sub)
+            elif target is not None:
+                table[local] = ("import", target, alias.name)
+
+    def _resolve_module(self, relpath: str, level: int,
+                        module: Optional[str]) -> Optional[str]:
+        """Resolve a relative import to a relpath in the linted set."""
+        if level == 0:
+            # absolute: try to match a linted top-level package
+            parts = (module or "").split(".")
+        else:
+            base = relpath.split("/")[:-1]
+            if relpath.endswith("/__init__.py"):
+                base = relpath.split("/")[:-1]
+            for _ in range(level - 1):
+                if base:
+                    base = base[:-1]
+            parts = base + ((module or "").split(".") if module else [])
+            parts = [p for p in parts if p]
+        if not parts:
+            return None
+        cand = "/".join(parts)
+        for suffix in (cand + ".py", cand + "/__init__.py"):
+            if suffix in self.modules:
+                return suffix
+        return None
+
+    def lookup(self, relpath: str, name: str, _depth: int = 0):
+        """Resolve a (possibly dotted) name in a module to a
+        ("class"|"func"|"const"|"token", payload) entry, following
+        import and module links."""
+        if _depth > 8:
+            return None
+        table = self.symbols.get(relpath)
+        if table is None:
+            return None
+        head, _, rest = name.partition(".")
+        entry = table.get(head)
+        if entry is None:
+            return None
+        kind = entry[0]
+        if kind == "module":
+            if not rest:
+                return entry
+            return self.lookup(entry[1], rest, _depth + 1)
+        if kind == "import":
+            resolved = self.lookup(entry[1], entry[2], _depth + 1)
+            if resolved is None:
+                return None
+            if rest:
+                if resolved[0] == "module":
+                    return self.lookup(resolved[1], rest, _depth + 1)
+                return None
+            return resolved
+        if rest:
+            return None
+        return entry
+
+    def _builtin_ctor_token(self, expr) -> Optional[str]:
+        if not isinstance(expr, ast.Call):
+            return None
+        term = dotted_name(expr.func)
+        term = term.split(".")[-1] if term else None
+        if term in _LOCK_CTORS:
+            return LOCK
+        if term in _EVENT_CTORS:
+            return EVENT
+        if term in _THREAD_CTORS:
+            return THREAD
+        return None
+
+    def _resolve_bases(self, cls: ClassInfo) -> None:
+        seen: Set[int] = set()
+
+        def is_thread(c: ClassInfo) -> bool:
+            if id(c) in seen:
+                return False
+            seen.add(id(c))
+            for b in c.bases:
+                if b.split(".")[-1] == "Thread":
+                    return True
+                ent = self.lookup(c.module.path, b)
+                if ent and ent[0] == "class" and is_thread(ent[1]):
+                    return True
+            return False
+
+        cls.is_thread = is_thread(cls)
+        cls.is_handler = (
+            any(b.split(".")[-1] == "BaseHTTPRequestHandler"
+                for b in cls.bases)
+            or any(m.startswith("do_") and m[3:].isupper()
+                   for m in cls.methods))
+
+    # -- class attribute typing ---------------------------------------
+
+    def _collect_class_attrs(self, mod) -> None:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = self._cls_of_node[id(node)]
+            for item in node.body:
+                # class-body annotations: ``front: FrontDoor``
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name):
+                    self._note_attr(cls, item.target.id, item.lineno,
+                                    self._types_from_ann(mod,
+                                                         item.annotation),
+                                    item.value)
+                elif isinstance(item, ast.Assign):
+                    for tgt in item.targets:
+                        if isinstance(tgt, ast.Name):
+                            self._note_attr(cls, tgt.id, item.lineno,
+                                            set(), item.value)
+            for meth in cls.methods.values():
+                sname = _self_name(meth.node)
+                if sname is None:
+                    continue
+                env = self._param_env(mod, meth.node)
+                for sub in ast.walk(meth.node):
+                    tgt = None
+                    ann = None
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        tgt = sub.targets[0]
+                    elif isinstance(sub, ast.AnnAssign):
+                        tgt = sub.target
+                        ann = sub.annotation
+                    else:
+                        continue
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == sname):
+                        types: set = set()
+                        if ann is not None:
+                            types |= self._types_from_ann(mod, ann)
+                        if sub.value is not None:
+                            types |= self._types_of_expr(
+                                mod, sub.value, env, cls, sname)
+                        self._note_attr(cls, tgt.attr, sub.lineno, types,
+                                        sub.value)
+
+    def _note_attr(self, cls: ClassInfo, attr: str, lineno: int,
+                   types: set, value: Optional[ast.AST] = None) -> None:
+        cls.attr_types.setdefault(attr, set()).update(types)
+        if lineno not in cls.attr_lines.setdefault(attr, []):
+            cls.attr_lines[attr].append(lineno)
+        if value is not None:
+            vals = cls.attr_values.setdefault(attr, [])
+            if all(v is not value for v in vals):
+                vals.append(value)
+        for t in types:
+            if isinstance(t, ClassInfo):
+                self._attr_owners.setdefault(attr, []).append(cls)
+
+    def _types_from_ann(self, mod, ann) -> set:
+        out: set = set()
+        for name in _ann_names(ann):
+            term = name.split(".")[-1]
+            if term in _LOCK_CTORS:
+                out.add(LOCK)
+            elif term in _EVENT_CTORS:
+                out.add(EVENT)
+            elif term in _THREAD_CTORS:
+                out.add(THREAD)
+            ent = self.lookup(mod.path, name) \
+                or self.lookup(mod.path, term)
+            if ent and ent[0] == "class":
+                out.add(ent[1])
+        return out
+
+    def _param_env(self, mod, func_node) -> Dict[str, set]:
+        env: Dict[str, set] = {}
+        a = func_node.args
+        for arg in (list(a.posonlyargs) + list(a.args)
+                    + list(a.kwonlyargs)):
+            types = self._types_from_ann(mod, arg.annotation)
+            if types:
+                env[arg.arg] = types
+        return env
+
+    def _types_of_expr(self, mod, expr, env, cls, sname) -> set:
+        """Best-effort type of an expression: a set of ClassInfo /
+        @-token candidates (empty when unknown)."""
+        tok = self._builtin_ctor_token(expr)
+        if tok:
+            return {tok}
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func)
+            if d:
+                ent = self.lookup(mod.path, d)
+                if ent and ent[0] == "class":
+                    return {ent[1]}
+                if ent and ent[0] == "func":
+                    ret = getattr(ent[1].node, "returns", None)
+                    return self._types_from_ann(ent[1].module, ret)
+            # method call on a typed receiver with a return annotation
+            if isinstance(expr.func, ast.Attribute):
+                recv = self._types_of_expr(mod, expr.func.value, env,
+                                           cls, sname)
+                out: set = set()
+                for t in recv:
+                    if isinstance(t, ClassInfo):
+                        m = self._find_method(t, expr.func.attr)
+                        if m is not None:
+                            ret = getattr(m.node, "returns", None)
+                            out |= self._types_from_ann(m.module, ret)
+                return out
+            return set()
+        if isinstance(expr, ast.Name):
+            if expr.id in env:
+                return env[expr.id]
+            ent = self.lookup(mod.path, expr.id)
+            if ent and ent[0] == "class":
+                return {ent[1]}
+            if ent and ent[0] == "token":
+                return {ent[1]}
+            return set()
+        if isinstance(expr, ast.Attribute):
+            if (isinstance(expr.value, ast.Name) and cls is not None
+                    and sname is not None and expr.value.id == sname):
+                return set(cls.attr_types.get(expr.attr, ()))
+            base = self._types_of_expr(mod, expr.value, env, cls, sname)
+            out = set()
+            for t in base:
+                if isinstance(t, ClassInfo):
+                    out |= set(t.attr_types.get(expr.attr, ()))
+            if out:
+                return out
+            # global fallback: every indexed declarer of this attr
+            # agrees on ONE class type -> safe to assume it
+            owners = self._attr_owners.get(expr.attr, [])
+            cand: set = set()
+            for o in owners:
+                cand |= {t for t in o.attr_types.get(expr.attr, set())
+                         if isinstance(t, ClassInfo)}
+            if len(cand) == 1:
+                return cand
+            return set()
+        if isinstance(expr, ast.IfExp):
+            return (self._types_of_expr(mod, expr.body, env, cls, sname)
+                    | self._types_of_expr(mod, expr.orelse, env, cls,
+                                          sname))
+        if isinstance(expr, ast.Await):
+            return self._types_of_expr(mod, expr.value, env, cls, sname)
+        return set()
+
+    def _find_method(self, cls: ClassInfo, name: str,
+                     _depth: int = 0) -> Optional[FuncInfo]:
+        if _depth > 8:
+            return None
+        if name in cls.methods:
+            return cls.methods[name]
+        for b in cls.bases:
+            ent = self.lookup(cls.module.path, b)
+            if ent and ent[0] == "class":
+                m = self._find_method(ent[1], name, _depth + 1)
+                if m is not None:
+                    return m
+        return None
+
+    # -- per-function walk: env, edges, locks, accesses ---------------
+
+    def _local_env(self, func: FuncInfo) -> Dict[str, set]:
+        mod, cls = func.module, func.cls
+        sname = _self_name(func.node) if cls else None
+        env = self._param_env(mod, func.node)
+        # two passes so forward-defined locals still type
+        for _ in range(2):
+            for sub in ast.walk(func.node):
+                if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)):
+                    t = self._types_of_expr(mod, sub.value, env, cls,
+                                            sname)
+                    if t:
+                        env.setdefault(sub.targets[0].id, set()).update(t)
+                elif (isinstance(sub, ast.AnnAssign)
+                        and isinstance(sub.target, ast.Name)):
+                    t = self._types_from_ann(mod, sub.annotation)
+                    if t:
+                        env.setdefault(sub.target.id, set()).update(t)
+        return env
+
+    def _lock_id_of(self, expr, func: FuncInfo, env) -> Optional[tuple]:
+        """Lock identity of a ``with`` context expression, or None."""
+        mod, cls = func.module, func.cls
+        sname = _self_name(func.node) if cls else None
+        if isinstance(expr, ast.Name):
+            ent = self.lookup(mod.path, expr.id)
+            if ent and ent[0] == "token" and ent[1] == LOCK:
+                return ("mod", mod.path, expr.id)
+            local = env.get(expr.id, set())
+            if LOCK in local:
+                return ("local", func.qualname, expr.id)
+            return None
+        if isinstance(expr, ast.Attribute):
+            if (isinstance(expr.value, ast.Name) and cls is not None
+                    and sname is not None and expr.value.id == sname):
+                if LOCK in cls.attr_types.get(expr.attr, ()):
+                    return ("attr", self._lock_owner(cls, expr.attr),
+                            expr.attr)
+                return None
+            base = self._types_of_expr(mod, expr.value, env, cls, sname)
+            for t in base:
+                if isinstance(t, ClassInfo) and LOCK in t.attr_types.get(
+                        expr.attr, ()):
+                    return ("attr", self._lock_owner(t, expr.attr),
+                            expr.attr)
+        return None
+
+    def _lock_owner(self, cls: ClassInfo, attr: str) -> str:
+        """Canonical owner key so ``self._lock`` in a base and a child
+        name the same lock."""
+        return f"{cls.module.path}::{cls.name}"
+
+    def _lexical_locks(self, node, func: FuncInfo, env) -> frozenset:
+        locks = set()
+        for p in parents(node):
+            if p is func.node:
+                break
+            if isinstance(p, (ast.With, ast.AsyncWith)):
+                for item in p.items:
+                    lid = self._lock_id_of(item.context_expr, func, env)
+                    if lid is not None:
+                        locks.add(lid)
+        return frozenset(locks)
+
+    def _self_attr_chain_root(self, node, sname) -> Optional[str]:
+        """For a Subscript/Attribute chain rooted at ``self.A``, the
+        attr name A; else None."""
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == sname):
+                return node.attr
+            node = node.value
+        return None
+
+    def _record_access(self, cls: ClassInfo, attr: str, func: FuncInfo,
+                       node, is_store: bool, env) -> None:
+        acc = Access(func, node, is_store,
+                     self._lexical_locks(node, func, env))
+        self.accesses.setdefault((cls, attr), []).append(acc)
+
+    def _walk_function(self, func: FuncInfo) -> None:
+        mod, cls = func.module, func.cls
+        sname = _self_name(func.node) if cls else None
+        env = self._local_env(func)
+
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Attribute):
+                is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+                # self.A loads/stores
+                if (isinstance(node.value, ast.Name) and sname is not None
+                        and node.value.id == sname):
+                    self._record_access(cls, node.attr, func, node,
+                                        is_store, env)
+                elif is_store:
+                    # cross-object store: ``x.front = ...`` on typed x
+                    for t in self._types_of_expr(mod, node.value, env,
+                                                 cls, sname):
+                        if isinstance(t, ClassInfo):
+                            self._record_access(t, node.attr, func, node,
+                                                True, env)
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, (ast.Store, ast.Del))
+                    and sname is not None):
+                attr = self._self_attr_chain_root(node, sname)
+                if attr is not None:
+                    self._record_access(cls, attr, func, node, True, env)
+            elif isinstance(node, ast.Call):
+                self._walk_call(func, node, env, sname)
+
+    def _walk_call(self, func: FuncInfo, node: ast.Call, env,
+                   sname) -> None:
+        mod, cls = func.module, func.cls
+        site_locks = self._lexical_locks(node, func, env)
+        callees: List[FuncInfo] = []
+        ctor_of: Optional[ClassInfo] = None
+        fn = node.func
+
+        if isinstance(fn, ast.Attribute):
+            recv_types: set = set()
+            if (isinstance(fn.value, ast.Name) and sname is not None
+                    and fn.value.id == sname and cls is not None):
+                m = self._find_method(cls, fn.attr)
+                if m is not None:
+                    callees.append(m)
+                elif fn.attr in MUTATORS:
+                    pass  # self.append? no such attr: ignore
+            else:
+                recv_types = self._types_of_expr(mod, fn.value, env, cls,
+                                                 sname)
+                for t in recv_types:
+                    if isinstance(t, ClassInfo):
+                        m = self._find_method(t, fn.attr)
+                        if m is not None:
+                            callees.append(m)
+                if not callees and fn.attr in MUTATORS and sname is not None:
+                    # mutator on a container hanging off self.A
+                    attr = self._self_attr_chain_root(fn.value, sname)
+                    if attr is not None and not any(
+                            isinstance(t, ClassInfo)
+                            for t in cls.attr_types.get(attr, ())):
+                        self._record_access(cls, attr, func, node, True,
+                                            env)
+            d = dotted_name(fn)
+            if d:
+                ent = self.lookup(mod.path, d)
+                if ent and ent[0] == "func":
+                    callees.append(ent[1])
+                elif ent and ent[0] == "class":
+                    ctor_of = ent[1]
+            # signal handler registration
+            if fn.attr == "signal" and len(node.args) >= 2:
+                self._seed_callable(node.args[1], func, env, sname,
+                                    kind="signal")
+            # raw Thread(target=...) on a dotted threading.Thread
+            if fn.attr == "Thread":
+                self._thread_ctor(node, func, env, sname)
+        elif isinstance(fn, ast.Name):
+            ent = self.lookup(mod.path, fn.id)
+            if ent and ent[0] == "func":
+                callees.append(ent[1])
+            elif ent and ent[0] == "class":
+                ctor_of = ent[1]
+            if fn.id == "Thread":
+                self._thread_ctor(node, func, env, sname)
+
+        if ctor_of is not None:
+            init = self._find_method(ctor_of, "__init__")
+            if init is not None:
+                callees.append(init)
+            if ctor_of.is_thread:
+                # callables handed to a thread-subclass constructor run
+                # on that thread (the beat_fn pattern)
+                root = self._root("thread",
+                                  f"thread:{ctor_of.name}", 1)
+                runm = self._find_method(ctor_of, "run")
+                if runm is not None and runm not in root.entries:
+                    root.entries.append(runm)
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    self._seed_callable(arg, func, env, sname,
+                                        kind="thread", root=root)
+
+        for callee in callees:
+            self._add_edge(func, callee, site_locks)
+
+    def _thread_ctor(self, node: ast.Call, func, env, sname) -> None:
+        for kw in node.keywords:
+            if kw.arg == "target":
+                self._seed_callable(kw.value, func, env, sname,
+                                    kind="thread")
+
+    def _seed_callable(self, expr, func: FuncInfo, env, sname,
+                       kind: str, root: Optional[Root] = None) -> None:
+        """Register a callable reference (self.m / module func /
+        lambda) as an entry of a thread/signal root."""
+        mod, cls = func.module, func.cls
+        targets: List[FuncInfo] = []
+        if isinstance(expr, ast.Attribute):
+            recv: set = set()
+            if (isinstance(expr.value, ast.Name) and sname is not None
+                    and expr.value.id == sname and cls is not None):
+                recv = {cls}
+            else:
+                recv = self._types_of_expr(mod, expr.value, env, cls,
+                                           sname)
+            for t in recv:
+                if isinstance(t, ClassInfo):
+                    m = self._find_method(t, expr.attr)
+                    if m is not None:
+                        targets.append(m)
+        elif isinstance(expr, ast.Name):
+            ent = self.lookup(mod.path, expr.id)
+            if ent and ent[0] == "func":
+                targets.append(ent[1])
+        elif isinstance(expr, ast.Lambda):
+            # the lambda body runs on the new thread; its self.m()
+            # calls become entries
+            for sub in ast.walk(expr):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sname is not None
+                        and sub.func.value.id == sname
+                        and cls is not None):
+                    m = self._find_method(cls, sub.func.attr)
+                    if m is not None:
+                        targets.append(m)
+        if not targets:
+            return
+        if root is None:
+            label = f"{kind}:{targets[0].qualname.rsplit('::', 1)[-1]}"
+            root = self._root(kind, label, 1)
+        for t in targets:
+            if t not in root.entries:
+                root.entries.append(t)
+
+    def _root(self, kind: str, label: str, weight: int) -> Root:
+        for r in self.roots:
+            if r.kind == kind and r.label == label:
+                return r
+        r = Root(kind, label, weight)
+        self.roots.append(r)
+        return r
+
+    def _add_edge(self, caller: FuncInfo, callee: FuncInfo,
+                  locks: frozenset) -> None:
+        self.edges.append((caller, callee, locks))
+        self._edges_in.setdefault(callee, []).append((caller, locks))
+
+    # -- roots, reachability, dominance -------------------------------
+
+    def _seed_roots(self) -> None:
+        for cls in self.classes:
+            if cls.is_thread and "run" in cls.methods:
+                root = self._root("thread", f"thread:{cls.name}", 1)
+                if cls.methods["run"] not in root.entries:
+                    root.entries.append(cls.methods["run"])
+            if cls.is_handler:
+                for name, m in cls.methods.items():
+                    if name.startswith("do_") and name[3:].isupper():
+                        r = self._root("handler",
+                                       f"handler:{cls.name}.{name}", 2)
+                        if m not in r.entries:
+                            r.entries.append(m)
+
+        threaded = set()
+        for r in self.roots:
+            threaded.update(r.entries)
+        called = set(self._edges_in)
+        main = self._root("main", "main", 1)
+        for f in self.functions:
+            if f not in called and f not in threaded:
+                main.entries.append(f)
+
+    def _compute_reach(self) -> None:
+        out: Dict[FuncInfo, List[FuncInfo]] = {}
+        for caller, callee, _ in self.edges:
+            out.setdefault(caller, []).append(callee)
+        for root in self.roots:
+            seen: Set[FuncInfo] = set()
+            work = list(root.entries)
+            while work:
+                f = work.pop()
+                if f in seen:
+                    continue
+                seen.add(f)
+                work.extend(out.get(f, ()))
+            self._reach[id(root)] = seen
+
+    def roots_reaching(self, func: FuncInfo) -> List[Root]:
+        return [r for r in self.roots if func in self._reach[id(r)]]
+
+    def _compute_held(self) -> None:
+        entries = set()
+        for r in self.roots:
+            entries.update(r.entries)
+        held: Dict[FuncInfo, Optional[frozenset]] = {}
+        for f in self.functions:
+            held[f] = frozenset() if f in entries else None
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for f in self.functions:
+                if f in entries:
+                    continue
+                acc: Optional[frozenset] = None
+                unknown = False
+                callers = self._edges_in.get(f, ())
+                live = [(c, lk) for c, lk in callers
+                        if c not in self._init_ctx]
+                # construction-time call sites can't race: they don't
+                # weaken the lock guarantee of the live callers
+                for caller, locks in live or callers:
+                    h = held.get(caller)
+                    if h is None:
+                        unknown = True
+                        continue
+                    site = h | locks
+                    acc = site if acc is None else (acc & site)
+                if unknown and acc is None:
+                    continue  # stay TOP until a caller resolves
+                if acc is None:
+                    acc = frozenset()
+                if held[f] != acc:
+                    held[f] = acc
+                    changed = True
+        self.held = held
+
+    def held_locks(self, func: FuncInfo) -> frozenset:
+        h = self.held.get(func)
+        return h if h is not None else frozenset()
+
+    def _compute_init_ctx(self) -> None:
+        """Functions reachable ONLY from constructors: their stores are
+        construction-time and exempt from lock dominance."""
+        init_ctx = {f for f in self.functions
+                    if f.cls is not None and f.name == "__init__"}
+        entries = set()
+        for r in self.roots:
+            if r.kind != "main":
+                entries.update(r.entries)
+        changed = True
+        while changed:
+            changed = False
+            for f in self.functions:
+                if f in init_ctx or f in entries:
+                    continue
+                callers = [c for c, _ in self._edges_in.get(f, ())]
+                if callers and all(c in init_ctx for c in callers):
+                    init_ctx.add(f)
+                    changed = True
+        self._init_ctx = init_ctx
+
+    def is_init_context(self, func: FuncInfo) -> bool:
+        return func in self._init_ctx
+
+
+def build_program(modules: Dict[str, object],
+                  shell_files: Optional[List[tuple]] = None) -> Program:
+    return Program(modules, shell_files)

@@ -1,18 +1,25 @@
-"""Rule registry. Each rule module exposes:
+"""Rule registry. Each per-file rule module exposes:
 
 - ``RULE_ID``: "Gnnn"
 - ``applies(module) -> bool``: path scoping (bypassed when a LintConfig
   selects rules explicitly, so fixtures outside the scoped trees still
   exercise the rule)
 - ``check(module, config) -> list[Finding]``
+
+Program rules (``PROGRAM = True``) run once per lint over the
+cross-module index instead:
+
+- ``check_program(program, config) -> list[Finding]``
 """
 
 from . import (g001_host_sync, g002_prng, g003_treedef, g004_events,
                g005_recorder, g006_pytest, g007_retry, g008_control,
-               g009_server, g010_tracectx)
+               g009_server, g010_tracectx, g011_locks, g012_durability,
+               g013_faultsites)
 
 RULES = (g001_host_sync, g002_prng, g003_treedef, g004_events,
          g005_recorder, g006_pytest, g007_retry, g008_control,
-         g009_server, g010_tracectx)
+         g009_server, g010_tracectx, g011_locks, g012_durability,
+         g013_faultsites)
 
 RULE_IDS = tuple(r.RULE_ID for r in RULES)

@@ -1,0 +1,152 @@
+"""G011: thread-shared state must mutate under its dominating lock.
+
+The fleet's correctness story leans on a small set of lock disciplines
+(``FrontDoor._cond`` around admission state, ``Journal._lock`` around
+the WAL, per-bucket ``TokenBucket._lock``). This rule checks the
+discipline *statically*, through the whole-program index:
+
+1. For every class in ``service/``, ``obs/``, and ``resilience/``,
+   compute which thread roots (main, spawned threads, concurrent
+   ``do_*`` HTTP handlers, signal handlers) reach each method via the
+   resolved call graph.
+2. An attribute whose accessors are reachable from a combined root
+   weight >= 2 (a handler root alone counts as two threads) is
+   **multi-thread-reachable**.
+3. Every mutation of such an attribute — ``self.x = ...``,
+   ``self.x[k] = ...``, ``del``, container mutators like ``.append`` —
+   must happen with a common lock held, either lexically (``with
+   self._lock:``) or inherited through the call graph (every resolved
+   call path into the mutating method holds the lock).
+
+Exemptions, in order:
+
+* construction: ``__init__`` and methods reachable *only* from
+  constructors (recovery helpers) — no other thread has the object yet;
+* lock-ish attributes themselves (``Lock``/``RLock``/``Condition``/
+  ``Event``/``Thread`` values);
+* ``# graftlint: guarded-by(<lock>: <reason>)`` on the attribute's
+  assignment line (or the preceding comment line) declares an
+  intentional lock-free field — Events, monotonic counters read
+  without synchronization, fields serialized by an external contract;
+* the same pragma on the ``class`` line exempts every attribute of the
+  class — for per-operation objects (one ``Span`` per begin/end pair)
+  that are never handed across threads despite living in a scoped
+  package.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..findings import Finding
+from ..program import EVENT, LOCK, THREAD, Program
+
+RULE_ID = "G011"
+PROGRAM = True
+
+_SCOPE = ("/service/", "/obs/", "/resilience/")
+
+
+def applies(module) -> bool:
+    p = "/" + module.path
+    return any(seg in p for seg in _SCOPE)
+
+
+def _in_scope(path: str, config) -> bool:
+    if config.rules is not None:
+        return True
+    return any(seg in "/" + path for seg in _SCOPE)
+
+
+def _lock_name(lock_id: tuple) -> str:
+    kind = lock_id[0]
+    if kind == "attr":
+        return f"self.{lock_id[2]}"
+    if kind == "mod":
+        return lock_id[2]
+    return lock_id[2]
+
+
+def check_program(program: Program, config) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in program.classes:
+        if not _in_scope(cls.module.path, config):
+            continue
+        if cls.module.is_test:
+            continue
+        findings.extend(_check_class(program, cls))
+    return findings
+
+
+def _check_class(program: Program, cls) -> List[Finding]:
+    findings: List[Finding] = []
+    guarded_lines = cls.module.pragmas.guarded
+    # a guarded-by pragma on the ``class`` line exempts every attribute
+    # (per-request / per-thread objects never shared across threads)
+    if cls.node.lineno in guarded_lines:
+        return findings
+    attrs = set(cls.attr_types)
+    attrs.update(a for (c, a) in program.accesses if c is cls)
+
+    for attr in sorted(attrs):
+        types = cls.attr_types.get(attr, set())
+        if types & {LOCK, EVENT, THREAD}:
+            continue
+        accesses = program.accesses.get((cls, attr), [])
+        if not accesses:
+            continue
+
+        stores = [a for a in accesses
+                  if a.is_store and not program.is_init_context(a.func)]
+        if not stores:
+            continue
+
+        # guarded-by pragma on any definition or mutation line exempts
+        lines = set(cls.attr_lines.get(attr, ()))
+        lines.update(a.line for a in accesses if a.is_store)
+        if any(ln in guarded_lines for ln in lines):
+            continue
+
+        roots = []
+        for acc in accesses:
+            for r in program.roots_reaching(acc.func):
+                if r not in roots:
+                    roots.append(r)
+        weight = sum(r.weight for r in roots)
+        if weight < 2:
+            continue
+
+        locksets = [a.lexical_locks | program.held_locks(a.func)
+                    for a in stores]
+        common = frozenset.intersection(*locksets) if locksets else \
+            frozenset()
+        if common:
+            continue
+
+        # name the likeliest intended lock for the message
+        counts: dict = {}
+        for ls in locksets:
+            for lid in ls:
+                counts[lid] = counts.get(lid, 0) + 1
+        candidate = max(counts, key=counts.get) if counts else None
+
+        root_labels = ", ".join(r.label for r in roots)
+        for acc, ls in zip(stores, locksets):
+            if candidate is not None and candidate in ls:
+                continue
+            if candidate is not None:
+                detail = (f"other mutation sites hold "
+                          f"'{_lock_name(candidate)}' but this one "
+                          f"does not")
+            elif ls:
+                detail = ("no single lock dominates every mutation "
+                          "site")
+            else:
+                detail = "no lock is held here on any resolved path"
+            findings.append(cls.module.finding(
+                RULE_ID, acc.node,
+                f"unguarded mutation of '{cls.name}.{attr}', which is "
+                f"reachable from multiple threads ({root_labels}): "
+                f"{detail}; guard it or mark the field "
+                f"'# graftlint: guarded-by(<lock>: <reason>)'"))
+    return findings

@@ -1,0 +1,434 @@
+"""G012: writes into recovery-critical roots must be crash-atomic.
+
+The fleet recovers from SIGKILL by replaying a small set of on-disk
+artifacts: the journal WAL, the job spool, lease docs, status/started
+markers, checkpoints, the collector's offset doc. A bare
+``open(path, "w")`` on any of them is a torn-write bug waiting for a
+power cut — chaos runs (``worker.sigkill``, ``checkpoint.write``
+truncation) sample that space; this rule covers it exhaustively.
+
+**DURABLE_ROOTS** below is the declarative registry: a path expression
+whose resolvable string fragments mention one of these tokens is
+*durable*. Resolution is interprocedural-lite: string literals,
+f-strings, ``os.path.join`` pieces, module constants, local variables,
+``self.x`` attributes (through the program index's recorded assignment
+values), and one level of callee return expressions.
+
+Sanctioned idioms (everything else on a durable path flags):
+
+* **tmp + fsync + os.replace** — the write goes to a scratch name
+  (``.tmp``/``.hb.``/``.part`` markers), is fsynced, then renamed over
+  the destination. The rename itself is checked: source must be a
+  scratch name, and the enclosing function must fsync.
+* **O_EXCL create** — ``open(path, "x")`` / ``os.open(..., O_EXCL)``
+  single-shot claims (leases).
+* **the journal choke point** — ``"a"``-mode appends are legal only in
+  a function that fsyncs what it wrote (``Journal.append``).
+
+Helpers are classified too: a function that bare-writes a *parameter*
+path is a bare writer, and calling it with a durable argument flags at
+the call site; a helper that does tmp+fsync+replace internally (the
+``_write_json_atomic`` family) is sanctioned.
+
+Scratch names (``.tmp``, ``.hb.``, ``.expired.``, ``.part``) and the
+reconstructible compile cache are deliberately *not* durable — the
+registry is the single place that decides.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..astutil import dotted_name
+from ..findings import Finding
+from ..program import FuncInfo, Program
+
+RULE_ID = "G012"
+PROGRAM = True
+
+_SCOPE = ("/service/", "/obs/", "/resilience/")
+
+# token -> what lives under it (documentation is part of the registry:
+# adding a root here is a reviewed decision, not a side effect)
+DURABLE_ROOTS = {
+    "journal": "fleet/run WAL (replayed on every recovery)",
+    "wal": "write-ahead logs generally",
+    "spool": "job spool docs (the fleet's work queue)",
+    "jobs": "job spool dir (this repo's spool name)",
+    "workers": "worker heartbeat/registry docs",
+    "heartbeat": "driver/worker heartbeat docs",
+    ".lease": "worker lease docs (ownership protocol)",
+    "lease_": "lease-adjacent docs (heartbeats fold into the lease)",
+    "status": "job status docs (DONE/FAILED adjudication)",
+    "started": "job started markers (double-execution guard)",
+    "checkpoint": "sweep checkpoints (resume state)",
+    "ckpt": "sweep checkpoints (short form)",
+    ".collector": "collector offset checkpoint (scrape resume)",
+    "drain": "drain markers (graceful-shutdown protocol)",
+    "profile/": "profile request markers (worker-consumed protocol)",
+    "artifacts": "published result docs (served to tenants)",
+}
+
+_SCRATCH_MARKERS = (".tmp", ".hb.", ".expired.", ".part")
+
+_W_MODES = ("w", "a")
+
+
+def applies(module) -> bool:
+    p = "/" + module.path
+    return any(seg in p for seg in _SCOPE)
+
+
+def _in_scope(path: str, config) -> bool:
+    if config.rules is not None:
+        return True
+    return any(seg in "/" + path for seg in _SCOPE)
+
+
+# -- path-string resolution -------------------------------------------
+
+
+class _Resolver:
+    def __init__(self, program: Program, func: FuncInfo):
+        self.program = program
+        self.func = func
+        self.locals: Dict[str, List[ast.AST]] = {}
+        for sub in ast.walk(func.node):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)):
+                self.locals.setdefault(sub.targets[0].id,
+                                       []).append(sub.value)
+            elif (isinstance(sub, ast.AnnAssign)
+                    and isinstance(sub.target, ast.Name)
+                    and sub.value is not None):
+                self.locals.setdefault(sub.target.id,
+                                       []).append(sub.value)
+
+    def strings(self, expr: Optional[ast.AST], depth: int = 0
+                ) -> Set[str]:
+        if expr is None or depth > 6:
+            return set()
+        out: Set[str] = set()
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, str):
+                out.add(expr.value)
+            return out
+        if isinstance(expr, ast.JoinedStr):
+            for part in expr.values:
+                if isinstance(part, ast.FormattedValue):
+                    out |= self.strings(part.value, depth + 1)
+                else:
+                    out |= self.strings(part, depth + 1)
+            return out
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return (self.strings(expr.left, depth + 1)
+                    | self.strings(expr.right, depth + 1))
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func) or ""
+            term = d.split(".")[-1]
+            if term in ("join", "format"):
+                base = (expr.func.value
+                        if isinstance(expr.func, ast.Attribute) else None)
+                if term == "format" and base is not None:
+                    out |= self.strings(base, depth + 1)
+                for a in expr.args:
+                    out |= self.strings(a, depth + 1)
+                for kw in expr.keywords:
+                    out |= self.strings(kw.value, depth + 1)
+                return out
+            callee = None
+            ent = self.program.lookup(self.func.module.path, d) if d \
+                else None
+            if ent and ent[0] == "func":
+                callee = ent[1]
+            elif (isinstance(expr.func, ast.Attribute)
+                    and isinstance(expr.func.value, ast.Name)
+                    and expr.func.value.id == self._selfname()
+                    and self.func.cls is not None):
+                callee = self.program._find_method(self.func.cls,
+                                                   expr.func.attr)
+            if callee is not None:
+                for sub in ast.walk(callee.node):
+                    if isinstance(sub, ast.Return):
+                        out |= _Resolver(self.program,
+                                         callee).strings(sub.value,
+                                                         depth + 2)
+            return out
+        if isinstance(expr, ast.Subscript):
+            # ``self.dirs[STATUS_DIR]``: the key names the fleet subdir
+            return (self.strings(expr.value, depth + 1)
+                    | self.strings(expr.slice, depth + 1))
+        if isinstance(expr, ast.Name):
+            ent = self.program.lookup(self.func.module.path, expr.id)
+            if ent and ent[0] == "const":
+                out.add(ent[1])
+            for v in self.locals.get(expr.id, ()):
+                out |= self.strings(v, depth + 1)
+            return out
+        if isinstance(expr, ast.Attribute):
+            cls = self.func.cls
+            if (cls is not None and isinstance(expr.value, ast.Name)
+                    and self._selfname() == expr.value.id):
+                for v in cls.attr_values.get(expr.attr, ()):
+                    out |= self.strings(v, depth + 1)
+                return out
+            d = dotted_name(expr)
+            if d:
+                ent = self.program.lookup(self.func.module.path, d)
+                if ent and ent[0] == "const":
+                    out.add(ent[1])
+            return out
+        return out
+
+    def _selfname(self) -> Optional[str]:
+        args = self.func.node.args
+        return args.args[0].arg if args.args else None
+
+    def param_names(self, expr: ast.AST) -> Set[str]:
+        """Parameter names of self.func appearing inside expr."""
+        a = self.func.node.args
+        params = {x.arg for x in
+                  list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+        found = set()
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in params:
+                found.add(sub.id)
+        return found
+
+
+def _durable_token(fragments: Set[str]) -> Optional[str]:
+    for frag in fragments:
+        low = frag.lower()
+        for token in DURABLE_ROOTS:
+            if token in low:
+                return token
+    return None
+
+
+def _is_scratch(fragments: Set[str]) -> bool:
+    return any(m in frag for frag in fragments for m in _SCRATCH_MARKERS)
+
+
+# -- per-function facts -----------------------------------------------
+
+
+def _has_fsync(func: FuncInfo) -> bool:
+    for sub in ast.walk(func.node):
+        if isinstance(sub, ast.Call):
+            d = dotted_name(sub.func) or ""
+            if d.split(".")[-1] == "fsync":
+                return True
+    return False
+
+
+def _open_mode(call: ast.Call) -> str:
+    for i, a in enumerate(call.args):
+        if i == 1 and isinstance(a, ast.Constant) and isinstance(
+                a.value, str):
+            return a.value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return "r"
+
+
+def _flag_names(expr: ast.AST) -> Set[str]:
+    out = set()
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+        elif isinstance(sub, ast.Name):
+            out.add(sub.id)
+    return out
+
+
+def _is_sanctioned_writer(func: FuncInfo) -> bool:
+    """tmp+fsync+replace helper: fsyncs and renames internally."""
+    if not _has_fsync(func):
+        return False
+    for sub in ast.walk(func.node):
+        if isinstance(sub, ast.Call):
+            d = dotted_name(sub.func) or ""
+            if d.split(".")[-1] in ("replace", "rename"):
+                return True
+    return False
+
+
+def _bare_write_params(func: FuncInfo) -> Set[str]:
+    """Parameters this function writes non-atomically (bare open with
+    a w/a mode on a parameter-derived path, no internal replace)."""
+    if _is_sanctioned_writer(func):
+        return set()
+    out: Set[str] = set()
+    for sub in ast.walk(func.node):
+        if not (isinstance(sub, ast.Call)
+                and dotted_name(sub.func) == "open" and sub.args):
+            continue
+        mode = _open_mode(sub)
+        if not any(m in mode for m in _W_MODES) or "x" in mode:
+            continue
+        a = func.node.args
+        params = {x.arg for x in
+                  list(a.posonlyargs) + list(a.args)
+                  + list(a.kwonlyargs)}
+        for n in ast.walk(sub.args[0]):
+            if isinstance(n, ast.Name) and n.id in params:
+                out.add(n.id)
+    return out
+
+
+# -- the rule ---------------------------------------------------------
+
+
+def check_program(program: Program, config) -> List[Finding]:
+    findings: List[Finding] = []
+
+    bare_writers: Dict[FuncInfo, Set[str]] = {}
+    sanctioned: Set[FuncInfo] = set()
+    for func in program.functions:
+        if _is_sanctioned_writer(func):
+            sanctioned.add(func)
+        else:
+            p = _bare_write_params(func)
+            if p:
+                bare_writers[func] = p
+
+    for func in program.functions:
+        if not _in_scope(func.module.path, config):
+            continue
+        if func.module.is_test:
+            continue
+        findings.extend(_check_function(program, func, bare_writers,
+                                        sanctioned))
+    return findings
+
+
+def _check_function(program: Program, func: FuncInfo,
+                    bare_writers, sanctioned) -> List[Finding]:
+    findings: List[Finding] = []
+    mod = func.module
+    res = _Resolver(program, func)
+
+    def durable(expr) -> Optional[str]:
+        frags = res.strings(expr)
+        if _is_scratch(frags):
+            return None
+        return _durable_token(frags)
+
+    for node in ast.walk(func.node):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func) or ""
+        term = d.split(".")[-1]
+
+        if d == "open" and node.args:
+            mode = _open_mode(node)
+            if "x" in mode or not any(m in mode for m in _W_MODES):
+                continue
+            token = durable(node.args[0])
+            if token is None:
+                continue
+            if "a" in mode and "w" not in mode:
+                if not _has_fsync(func):
+                    findings.append(mod.finding(
+                        RULE_ID, node,
+                        f"append to durable path (root '{token}': "
+                        f"{DURABLE_ROOTS[token]}) outside a fsyncing "
+                        f"choke point — route it through "
+                        f"Journal.append or fsync what you wrote"))
+                continue
+            findings.append(mod.finding(
+                RULE_ID, node,
+                f"bare open(..., {mode!r}) on durable path (root "
+                f"'{token}': {DURABLE_ROOTS[token]}) — a crash here "
+                f"tears the doc; write a .tmp name, fsync, then "
+                f"os.replace (or create with O_EXCL)"))
+
+        elif term == "open" and d.endswith("os.open") and node.args:
+            flags = set()
+            if len(node.args) >= 2:
+                flags = _flag_names(node.args[1])
+            if "O_EXCL" in flags:
+                continue
+            if not ({"O_WRONLY", "O_RDWR", "O_CREAT", "O_TRUNC",
+                     "O_APPEND"} & flags):
+                continue
+            token = durable(node.args[0])
+            if token is None:
+                continue
+            findings.append(mod.finding(
+                RULE_ID, node,
+                f"os.open write on durable path (root '{token}') "
+                f"without O_EXCL — use an O_EXCL create or "
+                f"tmp+fsync+os.replace"))
+
+        elif term in ("replace", "rename") and len(node.args) >= 2 \
+                and d.startswith("os"):
+            token = durable(node.args[1])
+            if token is None:
+                continue
+            src_frags = res.strings(node.args[0])
+            if src_frags and not _is_scratch(src_frags):
+                findings.append(mod.finding(
+                    RULE_ID, node,
+                    f"rename into durable path (root '{token}') from "
+                    f"a non-scratch source — stage through a .tmp "
+                    f"name so a crash never leaves a half-written "
+                    f"doc"))
+                continue
+            if not _has_fsync(func):
+                findings.append(mod.finding(
+                    RULE_ID, node,
+                    f"os.{term} into durable path (root '{token}') "
+                    f"with no fsync in '{func.name}' — the rename can "
+                    f"hit disk before the data does"))
+
+        elif term in ("write_text", "write_bytes") and isinstance(
+                node.func, ast.Attribute):
+            token = durable(node.func.value)
+            if token is not None:
+                findings.append(mod.finding(
+                    RULE_ID, node,
+                    f"direct {term} on durable path (root '{token}') "
+                    f"— use tmp+fsync+os.replace"))
+
+        else:
+            # call into a classified bare-writer helper with a durable
+            # argument
+            callee = _resolve_callee(program, func, node)
+            if callee is None or callee in sanctioned:
+                continue
+            params = bare_writers.get(callee)
+            if not params:
+                continue
+            for arg in list(node.args) + [k.value for k in
+                                          node.keywords]:
+                token = durable(arg)
+                if token is not None:
+                    findings.append(mod.finding(
+                        RULE_ID, node,
+                        f"durable path (root '{token}') flows into "
+                        f"'{callee.name}', which writes it "
+                        f"non-atomically — make the helper "
+                        f"tmp+fsync+os.replace or write through the "
+                        f"journal"))
+                    break
+    return findings
+
+
+def _resolve_callee(program: Program, func: FuncInfo,
+                    node: ast.Call) -> Optional[FuncInfo]:
+    d = dotted_name(node.func)
+    if d:
+        ent = program.lookup(func.module.path, d)
+        if ent and ent[0] == "func":
+            return ent[1]
+    if isinstance(node.func, ast.Attribute) and func.cls is not None:
+        fv = node.func.value
+        args = func.node.args
+        sname = args.args[0].arg if args.args else None
+        if isinstance(fv, ast.Name) and fv.id == sname:
+            return program._find_method(func.cls, node.func.attr)
+    return None

@@ -1,0 +1,159 @@
+"""G013: fault-site literals must exist in the FAULT_SITES registry.
+
+Chaos coverage rots silently: rename a site in ``resilience/faults.py``
+and every ``fault_point("old.name")`` still runs — it just never arms —
+and every ``--faults old.name:once`` plan in a gate script becomes a
+no-op that passes green. This rule pins every site literal to the
+single registry:
+
+* **registry extraction** — the ``FAULT_SITES`` dict (or legacy
+  ``SITES`` tuple) defined at top level of ``resilience/faults.py``
+  (any linted module defining one works, which is how fixtures carry
+  their own registry);
+* **Python injection points** — first-argument string literals of
+  ``fault_point`` / ``corrupt_file`` / ``wants_corruption`` /
+  ``FaultRule``;
+* **plan specs** — string literals handed to ``install_from_spec`` /
+  ``FaultPlan.from_spec``, parsed with the plan grammar
+  (``SITE:MODE[,...]``, ``seed=N`` entries skipped);
+* **gate scripts** — ``--faults``/``GRAFT_FAULTS=`` plan strings in
+  the ``.sh`` files of the lint set (shell lines take the same
+  ``# graftlint: disable=G013`` pragma).
+
+No registry in the lint set -> the rule is inert (a fixture tree
+without faults.py doesn't fabricate findings).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from ..astutil import dotted_name
+from ..findings import Finding
+from ..program import Program
+
+RULE_ID = "G013"
+PROGRAM = True
+
+_INJECTORS = ("fault_point", "corrupt_file", "wants_corruption",
+              "FaultRule")
+_SPEC_TAKERS = ("from_spec", "install_from_spec")
+
+# --faults 'spec' | --faults=spec | GRAFT_FAULTS=spec (shell)
+_SH_PLAN_RE = re.compile(
+    r"(?:--faults[= ]|GRAFT_FAULTS=)['\"]?([A-Za-z0-9_.*@:=,+-]+)")
+
+
+def applies(module) -> bool:
+    return True
+
+
+def _registry(program: Program) -> Optional[Set[str]]:
+    best: Optional[Set[str]] = None
+    for relpath, mod in sorted(program.modules.items()):
+        sites = _sites_in(mod)
+        if sites is None:
+            continue
+        if relpath.endswith("resilience/faults.py"):
+            return sites
+        if best is None:
+            best = sites
+    return best
+
+
+def _sites_in(mod) -> Optional[Set[str]]:
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name == "FAULT_SITES" and isinstance(node.value, ast.Dict):
+            keys = set()
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str):
+                    keys.add(k.value)
+            return keys
+        if name == "SITES" and isinstance(node.value,
+                                          (ast.Tuple, ast.List)):
+            vals = set()
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                        e.value, str):
+                    vals.add(e.value)
+            return vals
+    return None
+
+
+def _spec_sites(spec: str) -> List[str]:
+    sites = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry or entry.startswith("seed="):
+            continue
+        sites.append(entry.split(":", 1)[0].strip())
+    return sites
+
+
+def check_program(program: Program, config) -> List[Finding]:
+    registry = _registry(program)
+    if registry is None:
+        return []
+    findings: List[Finding] = []
+
+    for mod in program.modules.values():
+        if mod.path.endswith("resilience/faults.py"):
+            continue  # the registry's own docstrings/defaults
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func) or ""
+            term = d.split(".")[-1]
+            if term in _INJECTORS and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(
+                        a.value, str):
+                    if a.value not in registry:
+                        findings.append(mod.finding(
+                            RULE_ID, a,
+                            f"unknown fault site {a.value!r} — not in "
+                            f"resilience.faults.FAULT_SITES "
+                            f"({_nearest(a.value, registry)})"))
+            elif term in _SPEC_TAKERS and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(
+                        a.value, str):
+                    for site in _spec_sites(a.value):
+                        if site not in registry:
+                            findings.append(mod.finding(
+                                RULE_ID, a,
+                                f"fault plan names unknown site "
+                                f"{site!r} — not in FAULT_SITES "
+                                f"({_nearest(site, registry)})"))
+
+    for sf in program.shell_files:
+        for lineno, line in enumerate(sf.lines, start=1):
+            for m in _SH_PLAN_RE.finditer(line):
+                spec = m.group(1)
+                if "$" in spec:
+                    continue  # shell interpolation: not a literal
+                for site in _spec_sites(spec):
+                    if site and site not in registry:
+                        findings.append(sf.finding(
+                            RULE_ID, lineno, m.start(),
+                            f"fault plan in gate script names unknown "
+                            f"site {site!r} — not in "
+                            f"resilience.faults.FAULT_SITES "
+                            f"({_nearest(site, registry)})"))
+    return findings
+
+
+def _nearest(site: str, registry: Set[str]) -> str:
+    import difflib
+
+    close = difflib.get_close_matches(site, sorted(registry), n=1)
+    if close:
+        return f"did you mean {close[0]!r}?"
+    return f"{len(registry)} sites registered"
