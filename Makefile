@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test obs-check mesh-check chaos-check bitpack-check \
 	service-check preempt-check control-check workload-check \
-	dense-check fleet-check obsfleet-check lint
+	dense-check fleet-check obsfleet-check devstats-check lint
 
 # tier-1 suite (the ROADMAP verify command without the log plumbing)
 test:
@@ -88,7 +88,16 @@ fleet-check:
 obsfleet-check:
 	PYTHON=$(PYTHON) tools/obsfleet_check.sh
 
-# full pack: per-file rules G001-G010 plus the whole-program stage
+# device-resident analytics gate (ISSUE 20): G014 history-readback
+# discipline in sampling/, the sec11 artifact set byte-identical
+# between analytics='history' and 'summary', the NullRecorder /
+# analytics hot path bit-identical, and the >= 100x board-path
+# per-chunk readback reduction measured from honest readback_bytes
+# event fields
+devstats-check:
+	PYTHON=$(PYTHON) tools/devstats_check.sh
+
+# full pack: per-file rules G001-G010 + G014 plus the whole-program stage
 # (G011 lock discipline, G012 durability protocol, G013 fault-site
 # conformance — also scans the gate .sh scripts' --faults plans).
 # Results are content-hash cached in .graftlint_cache.json.

@@ -35,6 +35,7 @@ accept rate) goes to stderr as a second JSON object.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -132,6 +133,17 @@ def main():
                          "samples of the cut-count trajectory per second "
                          "of wall clock (the BASELINE metric's "
                          "'wall-clock to target ESS' axis) on stderr")
+    ap.add_argument("--devstats", action="store_true",
+                    help="also run two recorded legs at the winning "
+                         "variant — the flagged history oracle path vs "
+                         "device-resident analytics "
+                         "(stats.accumulators) — and report per-step "
+                         "readback bytes for both plus the "
+                         "summary-vs-history ratio as a "
+                         "'readback_summary_vs_history_ratio' record "
+                         "(higher is better) qualified per "
+                         "[path,kernel_path]. Board/general paths only "
+                         "(not --pallas)")
     ap.add_argument("--record-every", type=int, default=1,
                     help="history thinning for the --ess recorded pass "
                          "(device-side stride; cuts the history readback "
@@ -333,7 +345,6 @@ def main():
             # the forced-host device count must be pinned BEFORE jax
             # imports (backend init reads XLA_FLAGS once); keep a larger
             # pre-set count, grow a smaller one
-            import os
             import re
             flags = os.environ.get("XLA_FLAGS", "")
             m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
@@ -468,12 +479,13 @@ def main():
                 variants = [True, False]
 
             def run(states, n_steps, variant=None, record=False,
-                    device_hist=False):
+                    device_hist=False, analytics=None, recorder=rec):
                 return fce.sampling.run_board(
                     bg, spec, params, states, n_steps=n_steps,
                     record_history=record, chunk=args.chunk, bits=variant,
                     record_every=args.record_every if record else 1,
-                    history_device=device_hist, recorder=rec)
+                    history_device=device_hist, recorder=recorder,
+                    analytics=analytics)
     else:
         from flipcomplexityempirical_tpu.kernel import dense as kdense
         dg, states, params = fce.init_batch(
@@ -490,13 +502,13 @@ def main():
             variants = ["general_dense", "general"]
 
         def run(states, n_steps, variant=None, record=False,
-                device_hist=False):
+                device_hist=False, analytics=None, recorder=rec):
             return fce.run_chains(
                 dg, spec, params, states, n_steps=n_steps,
                 record_history=record, chunk=args.chunk,
                 record_every=args.record_every if record else 1,
-                history_device=device_hist, recorder=rec,
-                kernel_path=variant)
+                history_device=device_hist, recorder=recorder,
+                kernel_path=variant, analytics=analytics)
 
     # compile + mix in (reach steady-state boundary sizes); same chunk as
     # the timed run so the timed region reuses the compiled kernel
@@ -698,6 +710,58 @@ def main():
         headline["degraded"] = True
         headline["degradations"] = degradations
     print(json.dumps(headline))
+
+    if args.devstats and not args.pallas:
+        # two recorded legs OUTSIDE the timed window: per-step readback
+        # bytes of the history oracle path vs the device-resident
+        # summary plane, from each leg's own event stream accounting
+        import tempfile
+        from flipcomplexityempirical_tpu.stats.accumulators import \
+            DeviceAnalytics
+
+        def _readback_leg(analytics):
+            fd, jpath = tempfile.mkstemp(suffix=".jsonl")
+            os.close(fd)
+            try:
+                with obs.Recorder(path=jpath) as lrec:
+                    run(states, args.steps, best,
+                        record=(analytics is None),
+                        recorder=lrec, analytics=analytics)
+                steps = rb = 0
+                with open(jpath) as f:
+                    for line in f:
+                        e = json.loads(line)
+                        if e.get("event") == "chunk":
+                            steps += e.get("steps", 0)
+                            rb += e.get("readback_bytes", 0)
+                return rb, steps
+            finally:
+                os.unlink(jpath)
+
+        rb_h, st_h = _readback_leg(None)
+        rb_s, st_s = _readback_leg(
+            DeviceAnalytics(args.chains, observable="cut_count"))
+        per_h = rb_h / max(st_h, 1)
+        per_s = rb_s / max(st_s, 1)
+        devstats = {
+            # higher is better (bench_compare gates on throughput-shaped
+            # metrics): the factor by which the summary plane shrinks
+            # the per-chunk device->host traffic
+            "metric": "readback_summary_vs_history_ratio",
+            "value": round(per_h / max(per_s, 1e-12), 2),
+            "unit": "x",
+            "readback_bytes_per_step": round(per_s, 3),
+            "history_readback_bytes_per_step": round(per_h, 3),
+            "path": meta["path"],
+            "kernel_path": meta["kernel_path"],
+            "chains": args.chains,
+            "chunk": args.chunk,
+            "device": meta["device"],
+        }
+        if cpu_fallback:
+            devstats["cpu_fallback"] = True
+        print(json.dumps(devstats))
+
     rec.close()
 
 

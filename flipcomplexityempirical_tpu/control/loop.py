@@ -117,7 +117,7 @@ class ControlLoop:
         return out
 
     def consult(self, tag: str, *, family: str, done: int, total: int,
-                every: int, history=None, swap_attempts=None,
+                every: int, history=None, diag=(), swap_attempts=None,
                 swap_accepts=None, betas=None) -> list:
         """Evaluate every policy at one segment boundary; emit and
         journal the accepted actions. Pure in the passed observations
@@ -128,6 +128,7 @@ class ControlLoop:
             tag=tag, family=family, done=int(done), total=int(total),
             every=int(every),
             history=history,
+            diag=tuple(diag),
             swap_attempts=swap_attempts, swap_accepts=swap_accepts,
             betas=(tuple(float(b) for b in np.asarray(betas).ravel())
                    if betas is not None else None),

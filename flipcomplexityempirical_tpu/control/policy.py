@@ -76,6 +76,13 @@ class ObservedState:
     total: int                        # the run's full schedule
     every: int                        # segment length (boundary grid)
     history: Optional[np.ndarray] = None   # (C, T) accumulated observable
+    diag: tuple = ()                  # ((step, rhat, ess), ...) one point
+                                      # per consulted boundary — the
+                                      # summary-mode view when the full
+                                      # history never leaves the device
+                                      # (stats.accumulators); rhat/ess
+                                      # may be None before the device
+                                      # buffer fills
     swap_attempts: Optional[np.ndarray] = None  # (n_rungs-1,) temper
     swap_accepts: Optional[np.ndarray] = None
     betas: Optional[tuple] = None     # current ladder by rank, coldest 1st
@@ -145,12 +152,45 @@ class EarlyStopPolicy:
         _, total = ess(window)
         return total >= self.ess_target
 
+    def _propose_from_diag(self, view: ObservedState) -> list:
+        """Summary-mode path: no (C, T) history ever reached the host,
+        so judge the trailing ``patience`` boundary diagnostics the
+        device accumulator produced ((step, rhat, ess) points from
+        ``stats.accumulators.summary_diagnostics``). The same grid
+        discipline holds — one point per consulted boundary — and the
+        points are pure in the trajectory, so a replayed run re-derives
+        the identical decision."""
+        points = [p for p in view.diag[-self.patience:]]
+        if len(points) < self.patience:
+            return []
+        def _ok(p):
+            step, rhat, ess_total = p
+            return (rhat is not None and ess_total is not None
+                    and np.isfinite(rhat) and rhat <= self.rhat_target
+                    and ess_total >= self.ess_target)
+        if not all(_ok(p) for p in points):
+            return []
+        _, rhat, ess_total = points[-1]
+        return [ControlAction(
+            kind="stop", tag=view.tag, step=view.done, policy=self.name,
+            detail={"rhat": round(float(rhat), 6),
+                    "ess": round(float(ess_total), 3),
+                    "rhat_target": self.rhat_target,
+                    "ess_target": self.ess_target,
+                    "patience": self.patience,
+                    "total": view.total,
+                    "source": "device_summary",
+                    "saved_steps": view.total - view.done})]
+
     def propose(self, view: ObservedState) -> list:
-        if (view.family == "temper" or view.history is None
+        if (view.family == "temper"
+                or (view.history is None and not view.diag)
                 or view.taken.get("stop") or view.done >= view.total
                 or view.done < self.min_steps
                 or (self.tags is not None and view.tag not in self.tags)):
             return []
+        if view.history is None:
+            return self._propose_from_diag(view)
         hist = np.asarray(view.history, dtype=np.float64)
         t = hist.shape[1]
         grid = list(range(view.every, view.done + 1, view.every)) or \

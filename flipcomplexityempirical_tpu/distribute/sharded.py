@@ -44,6 +44,7 @@ from ..kernel import board as kboard
 from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
 from ..sampling.tempering import chain_rungs
+from ..stats import accumulators as _sacc
 from .mesh import CHAINS_AXIS, make_mesh, shard_chain_batch
 
 
@@ -169,22 +170,36 @@ class _ShardedStep:
         self._built.clear()
         self.fallback = None
 
-    def _build(self, states):
+    def _build(self, states, acc=None):
         pspec = _params_spec(sharded=True)
         state_spec = jax.tree.map(lambda _: P(CHAINS_AXIS), states)
+        if acc is None:
+            return jax.jit(_shard_map(
+                self._body, self.mesh,
+                in_specs=(P(), pspec, state_spec),
+                out_specs=(pspec, state_spec, P())))
+        # SummaryAcc leaves with a leading chains axis shard like the
+        # states; the fold counters (n/kept/stride) are replicated —
+        # every device advances its replica identically
+        acc_spec = jax.tree.map(
+            lambda leaf: (P(CHAINS_AXIS) if getattr(leaf, "ndim", 0) >= 1
+                          else P()), acc)
         return jax.jit(_shard_map(
             self._body, self.mesh,
-            in_specs=(P(), pspec, state_spec),
-            out_specs=(pspec, state_spec, P())))
+            in_specs=(P(), pspec, state_spec, acc_spec),
+            out_specs=(pspec, state_spec, acc_spec, P())))
 
-    def __call__(self, key, params, states):
+    def __call__(self, key, params, states, acc=None):
         if self.prepare is not None:
             states = self.prepare(states)
-        treedef = jax.tree.structure(states)
+        treedef = (jax.tree.structure(states),
+                   acc is not None and jax.tree.structure(acc))
         fn = self._built.get(treedef)
         if fn is None:
-            fn = self._built[treedef] = self._build(states)
-        return fn(key, params, states)
+            fn = self._built[treedef] = self._build(states, acc)
+        if acc is None:
+            return fn(key, params, states)
+        return fn(key, params, states, acc)
 
     def _cache_size(self):
         return sum(int(f._cache_size()) for f in self._built.values())
@@ -239,21 +254,24 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
     def make_body(body_dense):
         trans = kdense.transition if body_dense else kstep.transition
 
-        def local_advance(params, states):
-            def body(states, _):
+        def local_advance(params, states, acc):
+            def body(carry, _):
+                states, acc = carry
                 states = jax.vmap(
                     lambda p, s: trans(dg, spec, p, s),
                     in_axes=(paxes, 0))(params, states)
-                states, _ = jax.vmap(
+                states, out = jax.vmap(
                     lambda p, s: kstep.record(dg, spec, p, s),
                     in_axes=(paxes, 0))(params, states)
-                return states, ()
-            states, _ = jax.lax.scan(body, states, None,
-                                     length=inner_steps)
-            return states
+                if acc is not None:
+                    acc = _sacc.fold_out(acc, out)
+                return (states, acc), ()
+            (states, acc), _ = jax.lax.scan(body, (states, acc), None,
+                                            length=inner_steps)
+            return states, acc
 
-        def train_step(key, params, states):
-            states = local_advance(params, states)
+        def train_step(key, params, states, acc=None):
+            states, acc = local_advance(params, states, acc)
             swaps = jnp.int32(0)
             if exchange and n_dev > 1:
                 params, a0 = _swap_round(key, params, states.cut_count, 0,
@@ -267,7 +285,14 @@ def make_train_step(dg: DeviceGraph, spec: Spec, mesh, inner_steps: int,
                                         CHAINS_AXIS),
                 "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
             }
-            return params, states, info
+            if acc is None:
+                return params, states, info
+            # the telemetry allreduce: every device sees the mesh-wide
+            # summary (per-chain moment leaves gathered — R-hat needs
+            # every chain — pooled accepts/wsum psum'd)
+            info["summary"] = _sacc.summary_allreduce(
+                _sacc.summary(acc), CHAINS_AXIS)
+            return params, states, acc, info
         return train_step
 
     step = _ShardedStep(mesh, make_body(use_dense),
@@ -323,10 +348,15 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
     kernel_path = kboard.body_for(bg, spec, bits)
 
     def make_body(body_bits):
-        def train_step(key, params, states):
-            states, _ = kboard.run_board_chunk(bg, spec, params, states,
-                                               inner_steps, collect=False,
-                                               bits=body_bits)
+        def train_step(key, params, states, acc=None):
+            if acc is None:
+                states, _ = kboard.run_board_chunk(
+                    bg, spec, params, states, inner_steps, collect=False,
+                    bits=body_bits)
+            else:
+                states, _, acc = kboard.run_board_chunk(
+                    bg, spec, params, states, inner_steps, collect=False,
+                    bits=body_bits, acc=acc)
             swaps = jnp.int32(0)
             if exchange and n_dev > 1:
                 # the board loop carries cut_count incrementally, so it is
@@ -341,7 +371,11 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
                                         CHAINS_AXIS),
                 "swaps": jax.lax.psum(swaps, CHAINS_AXIS),
             }
-            return params, states, info
+            if acc is None:
+                return params, states, info
+            info["summary"] = _sacc.summary_allreduce(
+                _sacc.summary(acc), CHAINS_AXIS)
+            return params, states, acc, info
         return train_step
 
     step = _ShardedStep(mesh, make_body(bits), kernel_path, n_dev,
@@ -353,7 +387,8 @@ def make_board_train_step(bg: "kboard.BoardGraph", spec: Spec, mesh,
 
 
 def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
-                inner_steps: int, key=None, recorder=None):
+                inner_steps: int, key=None, recorder=None,
+                analytics=None):
     """Drive a sharded train step for ``rounds`` rounds of
     ``inner_steps`` local transitions + one replica-exchange step each.
     Returns ``(params, states, info)`` with a HOST info dict: totals,
@@ -371,6 +406,15 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
     run_end wall is authoritative. Pass ``host_recorder(path)`` so
     multi-host meshes write ``events.host<K>.jsonl`` streams that
     ``tools/trace_export.py`` merges onto per-host pids.
+
+    ``analytics``: a ``stats.accumulators.DeviceAnalytics`` (no series
+    keys — the sharded fold keeps only moments/buffer). The fold runs
+    inside the sharded body; every round allreduces the summary (per-
+    chain moment leaves all_gather'd over the mesh — R-hat needs every
+    chain — pooled counters psum'd) into a device ref that is read back
+    ONCE at the run-end sync as ``info['summary']`` with mesh-wide
+    ``(C_total,)`` per-chain moments. Deferred like every other
+    readback: the pipelined dispatch stays pipelined.
     """
     rec = obs.resolve_recorder(recorder)
     if key is None:
@@ -393,6 +437,13 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
 
     swaps_dev = jnp.int32(0)
     info_dev = {}
+    acc_dev = None
+    if analytics is not None:
+        if analytics.acc.series:
+            raise ValueError("run_sharded analytics must carry no series "
+                             "keys: series index per fold, which the "
+                             "replicated fold counters cannot shard")
+        acc_dev = analytics.acc
     for r in range(rounds):
         key, kr = jax.random.split(key)
         if rec:
@@ -400,7 +451,11 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
                            steps=inner_steps, round=r).begin()
         try:
             rfaults.fault_point("compile", path=step.kernel_path, round=r)
-            params, states, info_dev = step(kr, params, states)
+            if acc_dev is None:
+                params, states, info_dev = step(kr, params, states)
+            else:
+                params, states, acc_dev, info_dev = step(
+                    kr, params, states, acc_dev)
         except Exception as e:
             if not rdegrade.is_kernel_error(e) or step.fallback is None:
                 raise
@@ -411,8 +466,12 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
                                         round=r)
             # same key on purpose: the failed dispatch never consumed it,
             # and the fallback body must replay the identical round
-            params, states, info_dev = step(
-                kr, params, states)  # graftlint: disable=G002(retry replays the unconsumed key)
+            if acc_dev is None:
+                params, states, info_dev = step(
+                    kr, params, states)  # graftlint: disable=G002(retry replays the unconsumed key)
+            else:
+                params, states, acc_dev, info_dev = step(
+                    kr, params, states, acc_dev)  # graftlint: disable=G002(retry replays the unconsumed key)
         # device-side accumulation: no host sync until the run-end readback
         swaps_dev = swaps_dev + info_dev["swaps"]
         if rec:
@@ -463,6 +522,18 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
         "flips_per_s": fps,
         "flips_per_s_per_chip": fps / max(n_dev, 1),
     }
+    rb_total = (int(np.asarray(swaps_dev).nbytes)
+                + (int(np.asarray(info_dev["accepts"]).nbytes)
+                   if info_dev else 0))
+    if acc_dev is not None:
+        # ONE summary readback for the whole run: the mesh-wide
+        # allreduced summary from the final round
+        summ = {k: np.asarray(v) for k, v in info_dev["summary"].items()}
+        info["summary"] = summ
+        rb_total += sum(v.nbytes for v in summ.values())
+        analytics.update(acc_dev, total)
+        analytics.readback_bytes += rb_total
+    info["readback_bytes"] = rb_total
     if rec:
         last_acc = int(np.asarray(acc0, np.int64).sum())
         acc_start = last_acc
@@ -493,7 +564,10 @@ def run_sharded(step: _ShardedStep, params, states, *, rounds: int,
                  wall_s=wall_total, flips_per_s=fps,
                  flips_per_s_per_chip=info["flips_per_s_per_chip"],
                  devices=n_dev, swaps=swaps,
-                 accept_rate=info["accept_rate"], metrics=snap)
+                 accept_rate=info["accept_rate"], metrics=snap,
+                 readback_bytes=rb_total,
+                 readback_mode=("summary" if acc_dev is not None
+                                else "history"))
         run_span.end(flips=flips, wall_s=wall_total)
     return params, states, info
 

@@ -53,6 +53,15 @@ def main():
     ap.add_argument("--record-every", type=int, default=1,
                     help="history thinning through the runners (yields "
                          "0, k, 2k, ... recorded; accumulators exact)")
+    ap.add_argument("--analytics", choices=["history", "summary"],
+                    default="history",
+                    help="telemetry plane: 'history' exfiltrates the "
+                         "per-chunk history block to the host (the "
+                         "flagged oracle path), 'summary' folds moments/"
+                         "R-hat/ESS into the scan and reads back one "
+                         "small summary pytree per chunk (device-"
+                         "resident analytics; incompatible with "
+                         "--checkpoint-dir and --record-every > 1)")
     ap.add_argument("--backend", choices=["jax", "python"], default="jax")
     ap.add_argument("--contiguity", choices=["patch", "exact"],
                     default="patch")
@@ -143,6 +152,8 @@ def main():
     overrides = dict(backend=args.backend, contiguity=args.contiguity,
                      seed=args.seed, record_every=args.record_every,
                      checkpoint_every=args.checkpoint_every)
+    if args.analytics != "history":
+        overrides["analytics"] = args.analytics
     if args.steps is not None:
         overrides["total_steps"] = args.steps
     if args.chains is not None:

@@ -61,6 +61,11 @@ class ExperimentConfig:
     variant: str = "none"     # proposal variant: 'none' | 'nobacktrack'
                               # (arxiv 1204.4140) | 'lazy' (lazy-uniform
                               # reweighting riding the geometric waits)
+    analytics: str = "history"  # telemetry plane: 'history' (oracle
+                                # path; full per-step histories read
+                                # back per chunk) | 'summary'
+                                # (device-resident accumulators; one
+                                # small summary pytree per chunk)
 
     @property
     def tag(self) -> str:
@@ -130,6 +135,11 @@ class ExperimentConfig:
             payload["chain"] = self.chain
         if self.variant != "none":
             payload["variant"] = self.variant
+        if self.analytics != "history":
+            # summary mode threads a SummaryAcc through the scan carry,
+            # so the compiled kernel differs — coalescing across modes
+            # would recompile per batch
+            payload["analytics"] = self.analytics
         blob = json.dumps(payload, sort_keys=True,
                           separators=(",", ":"))
         return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]

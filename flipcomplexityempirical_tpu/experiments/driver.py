@@ -48,6 +48,7 @@ from ..graphs import (grid_sec11, frankengraph, sec11_plan, frank_plan,
                       stripes_plan, from_geojson, synthetic_precincts,
                       voronoi_precincts, seed_votes, validate_votes,
                       PARITY_LABELS)
+from .. import stats
 from ..stats import partisan, polsby_popper
 from ..kernel import board as kboard
 from ..kernel.step import Spec, finalize_host
@@ -303,6 +304,32 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     spec = spec_for(cfg)
     use_board = (kboard.supports(g, spec) and not _force_general
                  and cfg.chain == "flip")
+    summary_mode = cfg.analytics == "summary"
+    analytics = None
+    if summary_mode:
+        if checkpoint_dir:
+            raise ValueError(
+                "analytics='summary' keeps histories on device, so "
+                "there is no host history to checkpoint; resumable runs "
+                "need analytics='history' (checkpoint_every may still "
+                "segment a summary run — it then only sets the control "
+                "consult grid)")
+        if cfg.record_every != 1:
+            raise ValueError(
+                "analytics='summary' folds every yield on device; "
+                "record_every > 1 only thins a host history that "
+                "summary mode never materializes")
+        if cfg.chain == "recom":
+            raise ValueError(
+                "analytics='summary' covers the flip-walk runners "
+                "(board/general); the recom chain stays on the "
+                "history oracle path")
+        series_keys = (("slope", "angle") if spec.record_interface
+                       else ())
+        analytics = stats.DeviceAnalytics(
+            cfg.n_chains, observable="cut_count",
+            series_keys=series_keys,
+            series_cap=(cfg.total_steps if series_keys else 0))
     if use_board:
         handle, states, params = init_board(
             g, plan, n_chains=cfg.n_chains, seed=cfg.seed, spec=spec,
@@ -315,6 +342,7 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
     done = 0   # yields recorded (general) / transitions advanced (board)
     n_parts = 0
     hist_parts: dict = {}
+    diag_points: list = []   # summary-mode consult points (step, rhat, ess)
     waits_total = np.zeros(cfg.n_chains, np.float64)
     resumed = _load_resume(checkpoint_dir, cfg, states, recorder=recorder,
                            ignore_mismatch=_force_general)
@@ -347,8 +375,10 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         if use_board:
             try:
                 res = run_board_segment(handle, spec, params, states, n,
+                                        record_history=not summary_mode,
                                         record_every=cfg.record_every,
-                                        recorder=recorder)
+                                        recorder=recorder,
+                                        analytics=analytics)
             except KernelPathError as e:
                 # the board family is out of bodies for this workload:
                 # rerun the whole config on the general gather kernel.
@@ -376,19 +406,28 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         else:
             res = run_chains(handle, spec, params, states,
                              n_steps=n, record_initial=(done == 0),
+                             record_history=not summary_mode,
                              record_every=cfg.record_every,
-                             recorder=recorder)
+                             recorder=recorder, analytics=analytics)
         states = res.state
         for k, v in res.history.items():
             hist_parts.setdefault(k, []).append(v)
         waits_total += res.waits_total
         done += n
         segments += 1
+        if control is not None and done < total and summary_mode:
+            # summary mode: the (C, T) history never reached the host —
+            # hand the policy the device accumulator's boundary
+            # diagnostics instead (one (step, rhat, ess) point per
+            # boundary; +8 bytes readback each, honestly accounted)
+            analytics.maybe_diagnostics(force=True)
+            diag_points.append((done, analytics.rhat, analytics.ess))
         if (control is not None and done < total
                 and control.consult_stop(
                     cfg.tag, family=cfg.family, done=done, total=total,
                     every=every,
-                    history=_control_history(hist_parts))):
+                    history=_control_history(hist_parts),
+                    diag=tuple(diag_points))):
             # the targets held: close the run at this boundary (the
             # checkpoint write is skipped — the job completes here)
             stopped_at = done
@@ -408,19 +447,31 @@ def _run_jax(cfg: ExperimentConfig, g, plan, checkpoint_dir=None,
         t_close = (cfg.total_steps if stopped_at is None
                    else stopped_at + 1)
         res = finalize_board_run(handle, spec, params, states, hist_parts,
-                                 waits_total, [], True, t_close,
-                                 cfg.record_every, recorder=recorder)
+                                 waits_total, [], not summary_mode,
+                                 t_close, cfg.record_every,
+                                 recorder=recorder, analytics=analytics)
         states, history, waits_total = (res.state, res.history,
                                         res.waits_total)
     else:
         history = {k: np.concatenate(v, axis=1)
                    for k, v in hist_parts.items()}
+    if analytics is not None:
+        # the chain-0 interface series the sec11/frank artifacts render
+        # accumulated full-length on device; one readback here stands in
+        # for the per-chunk history stream (assemble_run_data sees the
+        # identical (1, T) arrays the oracle path would hand it)
+        history = dict(history)
+        for k, v in analytics.series_host().items():
+            history[k] = v[None, :]
     data = assemble_run_data(
         cfg, g, handle, use_board, states, history, waits_total,
         t_final=(None if stopped_at is None
                  else stopped_at + (1 if use_board else 0)))
     if stopped_at is not None:
         data["early_stopped"] = stopped_at
+    if analytics is not None:
+        data["summary"] = stats.summary_host(analytics.summary_refs())
+        data["readback_bytes"] = analytics.readback_bytes
     return data
 
 

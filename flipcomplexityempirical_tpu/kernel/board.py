@@ -90,6 +90,7 @@ from flax import struct
 
 from ..graphs.lattice import LatticeGraph
 from ..lower.stencil import stencil_for
+from ..stats import accumulators as _sacc
 from . import bitboard
 from .step import Spec, StepParams, sample_geom_minus1
 from .step import geom_denom_finite as kstep_geom_ok
@@ -1198,17 +1199,21 @@ def _bookkeeping_names(state: BoardState) -> tuple:
 
 
 def _scan_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
-                  loop_state: BoardState, chunk: int, collect: bool):
+                  loop_state: BoardState, chunk: int, collect: bool,
+                  acc=None):
     """The chunk scan on the lowered stencil body: masked 8-direction
     planes (holes, diagonal/seam edges), exact B2-window contiguity,
     keyed-plane interface metrics. Same scan shape as the int8 rook body
     — heavy accumulators (4 cut_times planes) ride int16 beside the
-    carry and fold afterwards."""
+    carry and fold afterwards. ``acc`` (an optional
+    stats.accumulators.SummaryAcc) rides the carry and folds every
+    yield's ``out``; None traces to the pre-analytics graph (an empty
+    pytree node costs nothing)."""
     c, n = loop_state.board.shape
     count = loop_state.reject_count is not None
 
     def body(carry, _):
-        state, cts16 = carry
+        state, cts16, acc = carry
         key, kprop, kacc, kwait = _split4(state.key)
         state = state.replace(key=key)
         planes = _planes_stencil(bg, spec, params, state, count=count)
@@ -1216,14 +1221,16 @@ def _scan_stencil(bg: BoardGraph, spec: Spec, params: StepParams,
                                   bg.n_real)
         state, cts16, out, log = _record_stencil(
             bg, spec, params, state, cts16, planes, cur_wait)
+        if acc is not None:
+            acc = _sacc.fold_out(acc, out)
         state = _transition_stencil(bg, spec, params, state, planes,
                                     kprop, kacc)
-        return (state, cts16), (out if collect else {}, log)
+        return (state, cts16, acc), (out if collect else {}, log)
 
     ct0 = tuple(jnp.zeros((c, n), jnp.int16) for _ in _CUT_KEYS)
-    (loop_state, cts16), (outs, logs) = jax.lax.scan(
-        body, (loop_state, ct0), None, length=chunk)
-    return loop_state, outs, logs, cts16
+    (loop_state, cts16, acc), (outs, logs) = jax.lax.scan(
+        body, (loop_state, ct0, acc), None, length=chunk)
+    return loop_state, outs, logs, cts16, acc
 
 
 def _record_stencil_bits(bg: BoardGraph, spec: Spec, state: BoardState,
@@ -1258,7 +1265,8 @@ def _record_stencil_bits(bg: BoardGraph, spec: Spec, state: BoardState,
 
 
 def _scan_bits_lowered(bg: BoardGraph, spec: Spec, params: StepParams,
-                       loop_state: BoardState, chunk: int, collect: bool):
+                       loop_state: BoardState, chunk: int, collect: bool,
+                       acc=None):
     """The lowered-family chunk scan on the packed stencil backend
     (kernel/bitboard.py's row-aligned canvas packing): the board rides
     as one bit per cell (holes pack as 0 — every packed plane that
@@ -1273,7 +1281,7 @@ def _scan_bits_lowered(bg: BoardGraph, spec: Spec, params: StepParams,
     count = loop_state.reject_count is not None
 
     def body(carry, _):
-        state, ct_sl = carry
+        state, ct_sl, acc = carry
         key, kprop, kacc, kwait = _split4(state.key)
         state = state.replace(key=key)
         planes = bitboard.planes_bits_lowered(
@@ -1282,6 +1290,8 @@ def _scan_bits_lowered(bg: BoardGraph, spec: Spec, params: StepParams,
                                   bg.n_real)
         state, out, log = _record_stencil_bits(bg, spec, state, planes,
                                                cur_wait)
+        if acc is not None:
+            acc = _sacc.fold_out(acc, out)
         ct_sl = tuple(bitboard.counter_add(sl, planes[k])
                       for sl, k in zip(ct_sl, _CUT_KEYS))
 
@@ -1309,24 +1319,25 @@ def _scan_bits_lowered(bg: BoardGraph, spec: Spec, params: StepParams,
         state = _commit_transition(
             state, params, bitboard.flip_bit(state.board, pflat, accept),
             dist_pop, flat, d_to, dcut, accept, any_valid, rej=rej)
-        return (state, ct_sl), (out if collect else {}, log)
+        return (state, ct_sl, acc), (out if collect else {}, log)
 
     npw = h * bitboard.canvas_words(w)
     slices = max(chunk.bit_length(), 1)
     loop_state = loop_state.replace(
         board=bitboard.pack_canvas(loop_state.board == 1, h, w))
     ct0 = tuple(bitboard.counter_init(c, npw, slices) for _ in _CUT_KEYS)
-    (loop_state, ct_sl), (outs, logs) = jax.lax.scan(
-        body, (loop_state, ct0), None, length=chunk)
+    (loop_state, ct_sl, acc), (outs, logs) = jax.lax.scan(
+        body, (loop_state, ct0, acc), None, length=chunk)
     board = bitboard.unpack_canvas(loop_state.board, h, w)
     loop_state = loop_state.replace(
         board=jnp.where(bg.node_mask[None], board, jnp.int8(-1)))
     cts = tuple(bitboard.counter_fold_canvas(sl, h, w) for sl in ct_sl)
-    return loop_state, outs, logs, cts
+    return loop_state, outs, logs, cts, acc
 
 
 def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
-               loop_state: BoardState, chunk: int, collect: bool):
+               loop_state: BoardState, chunk: int, collect: bool,
+               acc=None):
     """The chunk scan on the bit-board backend (kernel/bitboard.py): the
     board and every derived plane live as packed uint32 words inside the
     loop, cut_times accumulates in bit-sliced ripple-carry counters, and
@@ -1338,7 +1349,7 @@ def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
     count = loop_state.reject_count is not None
 
     def body(carry, _):
-        state, ct_e_sl, ct_s_sl = carry
+        state, ct_e_sl, ct_s_sl, acc = carry
         key, kprop, kacc, kwait = _split4(state.key)
         state = state.replace(key=key)
         planes = bitboard.planes_bits(bg, spec, params, state.board,
@@ -1348,6 +1359,8 @@ def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
         # record (grid_chain_sec11.py:366-402)
         state, out, log = _record_common(state, planes["b_count"],
                                          cur_wait)
+        if acc is not None:
+            acc = _sacc.fold_out(acc, out)
         ct_e_sl = bitboard.counter_add(ct_e_sl, planes["cut_e"])
         ct_s_sl = bitboard.counter_add(ct_s_sl, planes["cut_s"])
 
@@ -1372,7 +1385,8 @@ def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
         state = _commit_transition(
             state, params, bitboard.flip_bit(state.board, flat, accept),
             dist_pop, flat, d_to, dcut, accept, any_valid, rej=rej)
-        return (state, ct_e_sl, ct_s_sl), (out if collect else {}, log)
+        return (state, ct_e_sl, ct_s_sl, acc), (out if collect else {},
+                                                log)
 
     nw = bitboard.n_words(n)
     slices = max(chunk.bit_length(), 1)
@@ -1380,17 +1394,18 @@ def _scan_bits(bg: BoardGraph, spec: Spec, params: StepParams,
         board=bitboard.pack_bits(loop_state.board))
     ct0 = (bitboard.counter_init(c, nw, slices),
            bitboard.counter_init(c, nw, slices))
-    (loop_state, ct_e_sl, ct_s_sl), (outs, logs) = jax.lax.scan(
-        body, (loop_state, *ct0), None, length=chunk)
+    (loop_state, ct_e_sl, ct_s_sl, acc), (outs, logs) = jax.lax.scan(
+        body, (loop_state, *ct0, acc), None, length=chunk)
     loop_state = loop_state.replace(
         board=bitboard.unpack_bits(loop_state.board, n))
     return (loop_state, outs, logs,
             bitboard.counter_fold(ct_e_sl, n),
-            bitboard.counter_fold(ct_s_sl, n))
+            bitboard.counter_fold(ct_s_sl, n), acc)
 
 
 def _scan_bits_pair(bg: BoardGraph, spec: Spec, params: StepParams,
-                    loop_state: BoardState, chunk: int, collect: bool):
+                    loop_state: BoardState, chunk: int, collect: bool,
+                    acc=None):
     """The k-district pair chunk scan on bit-sliced district planes
     (kernel/bitboard.py): same trajectory as the int8 pair body,
     bit-for-bit (tests/test_bitboard.py)."""
@@ -1401,7 +1416,7 @@ def _scan_bits_pair(bg: BoardGraph, spec: Spec, params: StepParams,
     count = loop_state.reject_count is not None
 
     def body(carry, _):
-        state, ct_e_sl, ct_s_sl = carry
+        state, ct_e_sl, ct_s_sl, acc = carry
         key, kprop, kacc, kwait = _split4(state.key)
         state = state.replace(key=key)
         planes = bitboard.planes_bits_pair(bg, spec, params, state.board,
@@ -1409,6 +1424,8 @@ def _scan_bits_pair(bg: BoardGraph, spec: Spec, params: StepParams,
         cur_wait = _complete_wait(spec, state, planes["b_count"], kwait, n)
         state, out, log = _record_common(state, planes["b_count"],
                                          cur_wait)
+        if acc is not None:
+            acc = _sacc.fold_out(acc, out)
         ct_e_sl = bitboard.counter_add(ct_e_sl, planes["cut_e"])
         ct_s_sl = bitboard.counter_add(ct_s_sl, planes["cut_s"])
 
@@ -1449,7 +1466,8 @@ def _scan_bits_pair(bg: BoardGraph, spec: Spec, params: StepParams,
         state = _commit_transition(state, params, new_planes, dist_pop,
                                    flat, d_to, dcut, accept, any_valid,
                                    rej=rej)
-        return (state, ct_e_sl, ct_s_sl), (out if collect else {}, log)
+        return (state, ct_e_sl, ct_s_sl, acc), (out if collect else {},
+                                                log)
 
     nw = bitboard.n_words(n)
     slices = max(chunk.bit_length(), 1)
@@ -1457,20 +1475,20 @@ def _scan_bits_pair(bg: BoardGraph, spec: Spec, params: StepParams,
         board=bitboard.pack_board_planes(loop_state.board, k))
     ct0 = (bitboard.counter_init(c, nw, slices),
            bitboard.counter_init(c, nw, slices))
-    (loop_state, ct_e_sl, ct_s_sl), (outs, logs) = jax.lax.scan(
-        body, (loop_state, *ct0), None, length=chunk)
+    (loop_state, ct_e_sl, ct_s_sl, acc), (outs, logs) = jax.lax.scan(
+        body, (loop_state, *ct0, acc), None, length=chunk)
     loop_state = loop_state.replace(
         board=bitboard.unpack_board_planes(loop_state.board, n))
     return (loop_state, outs, logs,
             bitboard.counter_fold(ct_e_sl, n),
-            bitboard.counter_fold(ct_s_sl, n))
+            bitboard.counter_fold(ct_s_sl, n), acc)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("spec", "chunk", "collect", "bits"))
 def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
                     state: BoardState, chunk: int, collect: bool = True,
-                    bits: bool = None):
+                    bits: bool = None, acc=None):
     """``chunk`` iterations of [complete-wait, record, transition]; records
     yields t .. t+chunk-1 and advances ``chunk`` transitions. The heavy
     accumulators stay OUT of the scan carry: cut_times in int16 planes
@@ -1479,7 +1497,16 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
     ``bitboard.supported`` / ``supported_pair`` /
     ``supported_lowered``; False forces the int8 body of the active
     family — packed and int8 bodies are bit-identical, so the choice is
-    purely a performance matter)."""
+    purely a performance matter).
+
+    ``acc`` (optional ``stats.accumulators.SummaryAcc``): the
+    device-resident analytics accumulator — it rides the scan carry,
+    folding every yield's ``out`` on-chip, and comes back as a third
+    return value: ``(state, outs, acc)``. With ``acc=None`` (the
+    default, a distinct jit specialization) the return stays
+    ``(state, outs)`` and the traced graph is the pre-analytics one —
+    the hot path is untouched. ``collect=False, acc=...`` is the
+    summary-readback mode: no history block materializes at all."""
     if chunk > 32767:
         raise ValueError("chunk must be <= 32767 (int16 cut_times planes)")
     n = bg.n
@@ -1500,8 +1527,8 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
                              "bitboard.supported_lowered); bits=False "
                              "selects the int8 'lowered' body")
         scan = _scan_bits_lowered if use_lbits else _scan_stencil
-        loop_state, outs, logs, cts16 = scan(
-            bg, spec, params, loop_state, chunk, collect)
+        loop_state, outs, logs, cts16, acc = scan(
+            bg, spec, params, loop_state, chunk, collect, acc)
         for k, ct in zip(("cut_times_e", "cut_times_se", "cut_times_s",
                           "cut_times_sw"), cts16):
             big[k] = big[k] + ct
@@ -1517,8 +1544,8 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
                              "supported_pair)")
         scan_bits = (_scan_bits_pair if spec.proposal == "pair"
                      else _scan_bits)
-        (loop_state, outs, logs, cte, cts) = scan_bits(
-            bg, spec, params, loop_state, chunk, collect)
+        (loop_state, outs, logs, cte, cts, acc) = scan_bits(
+            bg, spec, params, loop_state, chunk, collect, acc)
         big["cut_times_e"] = big["cut_times_e"] + cte
         big["cut_times_s"] = big["cut_times_s"] + cts
     else:
@@ -1530,7 +1557,7 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
         count = state.reject_count is not None
 
         def body(carry, _):
-            state, ct_e16, ct_s16 = carry
+            state, ct_e16, ct_s16, acc = carry
             key, kprop, kacc, kwait = _split4(state.key)
             state = state.replace(key=key)
             planes = make_planes(bg, spec, params, state, count=count)
@@ -1538,13 +1565,16 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
                                       kwait, n)
             state, ct_e16, ct_s16, out, log = _record(
                 bg, spec, params, state, ct_e16, ct_s16, planes, cur_wait)
+            if acc is not None:
+                acc = _sacc.fold_out(acc, out)
             state = make_transition(bg, spec, params, state, planes, kprop,
                                     kacc)
-            return (state, ct_e16, ct_s16), (out if collect else {}, log)
+            return (state, ct_e16, ct_s16, acc), (out if collect else {},
+                                                  log)
 
         ct16 = (jnp.zeros((c, n), jnp.int16), jnp.zeros((c, n), jnp.int16))
-        (loop_state, ct_e16, ct_s16), (outs, logs) = jax.lax.scan(
-            body, (loop_state, *ct16), None, length=chunk)
+        (loop_state, ct_e16, ct_s16, acc), (outs, logs) = jax.lax.scan(
+            body, (loop_state, *ct16, acc), None, length=chunk)
         big["cut_times_e"] = big["cut_times_e"] + ct_e16
         big["cut_times_s"] = big["cut_times_s"] + ct_s16
 
@@ -1553,6 +1583,8 @@ def run_board_chunk(bg: BoardGraph, spec: Spec, params: StepParams,
             apply_flip_log(big["part_sum"], big["last_flipped"],
                            big["num_flips"], logs["f"], logs["s"], t0)
     state = loop_state.replace(**big)
+    if acc is not None:
+        return state, outs, acc
     return state, outs
 
 

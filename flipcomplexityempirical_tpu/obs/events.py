@@ -23,14 +23,25 @@ Core field semantics:
   fraction; ``transfer_bytes`` the history bytes copied device->host for
   this chunk; ``hbm_history_bytes`` the cumulative device-resident
   history footprint (``history_device=True`` runs); ``done``/``total``
-  give progress.
+  give progress. Runners additionally attach the optional
+  ``readback_bytes`` field: the honest total device->host traffic the
+  chunk caused (history transfer + counter/waits sync + the analytics
+  summary pytree when device-resident analytics are enabled) — the
+  number ``tools/obs_report.py``'s Readback section and the
+  devstats gate fold. Optional fields ride the forward-compatible
+  extras channel, so no SCHEMA_VERSION bump.
 - ``compile``: the runner's jitted chunk kernel traced a new
   specialization (cache miss) during the preceding call — the
   ``pick_chunk`` recompile story as data.
 - ``transfer``: a one-off device->host copy outside the per-chunk
   stream (initial/final record blocks).
 - ``run_end``: totals for the run; ``flips_per_s`` is the aggregate
-  throughput over ``wall_s``.
+  throughput over ``wall_s``. Optional extras: ``readback_bytes`` (the
+  run's total device->host traffic, the sum of the per-chunk values
+  plus any one-off drains) and ``readback_mode`` (``"summary"`` when a
+  ``stats.accumulators.DeviceAnalytics`` carried the telemetry on
+  device, ``"history"`` for the flagged oracle path that reads back
+  full per-step histories).
 - ``sweep_config``: driver progress, ``status`` in SWEEP_STATUSES with
   per-config artifact counts.
 - ``error``: a failure the emitter survived or is about to re-raise.

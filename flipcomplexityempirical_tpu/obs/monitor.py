@@ -254,6 +254,75 @@ class ChainMonitor:
                 pass
         return diag
 
+    # ---- summary-mode entry point (ISSUE 20) ------------------------
+
+    def observe_summary(self, summary, rhat=None, ess=None, wall_s=None,
+                        flips_per_s=None, accept_rate=None, reject=None,
+                        done=None, ts=None):
+        """Summary-mode twin of ``observe_chunk``: consumes the
+        device-resident analytics' per-chunk summary pytree (host dict
+        from ``stats.accumulators.summary_host``) instead of a history
+        block. The device accumulator is authoritative for the Welford
+        moments; R-hat/ESS arrive precomputed from the on-device
+        thinning buffer (None = not refreshed this chunk — the last
+        refreshed values are reported by the caller). Emits the same
+        ``diag`` event shape, drives the same anomaly thresholds,
+        ``diag_hook`` and ``anomaly_hook``."""
+        with _span(self._rec, "diag", observable=self.observable):
+            return self._observe_summary(summary, rhat, ess, wall_s,
+                                         flips_per_s, accept_rate,
+                                         reject, done, ts)
+
+    def _observe_summary(self, summary, rhat, ess, wall_s, flips_per_s,
+                         accept_rate, reject, done, ts):
+        self._chunks += 1
+        if wall_s:
+            self._wall += float(wall_s)
+        self._n = int(summary["n"])
+        self._mean = np.asarray(summary["mean"], np.float64)
+        self._m2 = np.asarray(summary["m2"], np.float64)
+
+        accepts_delta = None
+        accs = summary.get("accepts")
+        if accs is not None:
+            last = np.asarray(accs, np.float64)
+            if self._last_accepts is not None:
+                accepts_delta = last - self._last_accepts
+            self._last_accepts = last
+
+        if accept_rate is None and reject is not None:
+            prop = reject.get("proposals") or 0
+            if prop:
+                accept_rate = reject.get("accepted", 0) / prop
+        self._acc_ewma = self._ewma(self._acc_ewma, accept_rate)
+
+        rhat = _finite(rhat)
+        ess = _finite(ess)
+        ess_per_s = (ess / self._wall
+                     if ess is not None and self._wall > 0 else None)
+
+        diag = self._rec.emit(
+            "diag", ts=ts, observable=self.observable,
+            samples=self._n, rhat=rhat, ess=ess,
+            ess_per_s=_finite(ess_per_s),
+            accept_ewma=_finite(self._acc_ewma),
+            throughput_ewma=_finite(self._thr_ewma),
+            mean=_finite(self._mean.mean()) if self._mean is not None
+            else None,
+            chunks=self._chunks, runner=self.runner, path=self.path,
+            done=done, total=self.total)
+
+        self._check_anomalies(accepts_delta, flips_per_s, reject)
+        self._thr_ewma = self._ewma(self._thr_ewma, flips_per_s)
+
+        hook = getattr(self._rec, "diag_hook", None)
+        if hook is not None and diag is not None:
+            try:
+                hook(diag)
+            except Exception:
+                pass
+        return diag
+
     # ---- anomaly thresholds -----------------------------------------
 
     def _check_anomalies(self, accepts_delta, flips_per_s, reject):

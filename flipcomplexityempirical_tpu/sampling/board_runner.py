@@ -23,6 +23,7 @@ from ..kernel.step import Spec, StepParams
 from ..resilience import degrade as rdegrade
 from ..resilience import faults as rfaults
 from ..resilience.errors import KernelPathError
+from ..stats import accumulators as _sacc
 from .runner import (RunResult, assemble_history, default_label_values,
                      maybe_host, pick_chunk, pop_bounds, snap_chunk_to,
                      thin_outs)
@@ -67,7 +68,7 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
                        pending_waits, record_history, n_steps,
                        record_every: int = 1,
                        history_device: bool = False,
-                       recorder=None) -> RunResult:
+                       recorder=None, analytics=None) -> RunResult:
     """Shared run epilogue for the board-path runners: record the final
     yield (no trailing transition), drain waits, assemble the RunResult.
     Under thinning the final yield joins the history only when it lands
@@ -80,6 +81,9 @@ def finalize_board_run(bg, spec, params, state, hist_parts, waits_total,
         fsp = obs.span(rec, "finalize", annotate=True,
                        kernel_path="board").begin()
     state, out_last = kboard.record_final(bg, spec, params, state)
+    if analytics is not None:
+        # the final yield joins the fold exactly as it joins the history
+        analytics.update(_sacc.fold_out(analytics.acc, out_last), 1)
     if record_history and (n_steps - 1) % record_every == 0:
         out_last = maybe_host(out_last, history_device)
         if rec and not history_device:
@@ -108,24 +112,34 @@ def _reject_dict(delta, proposals):
 
 def _emit_board_chunks(rec, chunk_meta, acc0, rej0, n_chains,
                        n_transitions, transfer_total, hbm_bytes,
-                       path="board"):
+                       path="board", mon=None, analytics=None):
     """Flush the deferred per-chunk telemetry of a board run. The board
-    loop never syncs mid-run (waits, accept and reject counts are
-    stashed as device refs so dispatch pipelines); those readbacks
-    happen HERE, at the run-end sync that already exists, and each chunk
-    event is back-stamped with its dispatch-time ``ts``. Per-chunk
-    ``wall_s`` is therefore a dispatch interval — the run_end wall is
-    the authoritative end-to-end time (obs.events docstring). Chunks
-    whose loop iteration already synced (host history copies) carry a
-    precomputed ``reject`` dict instead of a device ref."""
+    loop never syncs mid-run (waits, accept and reject counts — and in
+    summary mode the per-chunk analytics summaries — are stashed as
+    device refs so dispatch pipelines); those readbacks happen HERE, at
+    the run-end sync that already exists, and each chunk event is
+    back-stamped with its dispatch-time ``ts``. Per-chunk ``wall_s`` is
+    therefore a dispatch interval — the run_end wall is the
+    authoritative end-to-end time (obs.events docstring). Chunks whose
+    loop iteration already synced (host history copies) carry a
+    precomputed ``reject`` dict instead of a device ref.
+
+    ``mon``/``analytics``: in summary mode each stashed summary feeds
+    ``mon.observe_summary`` with the back-stamped ``ts`` (deferred
+    ``diag`` events); the on-device R-hat/ESS refresh runs once, at the
+    final chunk. Returns ``(accept_rate, readback_total)``."""
     last_acc = int(np.asarray(acc0, np.int64).sum())
     acc_start = last_acc
     last_rej = (np.asarray(rej0, np.int64).sum(axis=0)
                 if rej0 is not None else None)
     done = 0
-    for steps, wall, tb, hbm, acc_ref, rej_ref, reject, ts in chunk_meta:
+    rb_total = 0
+    n_meta = len(chunk_meta)
+    for i, (steps, wall, tb, hbm, acc_ref, rej_ref, reject, ts, summ_ref,
+            rb) in enumerate(chunk_meta):
         acc = int(np.asarray(acc_ref, np.int64).sum())
         done += steps
+        rb_total += rb
         if reject is None and rej_ref is not None:
             rej = np.asarray(rej_ref, np.int64).sum(axis=0)
             reject = _reject_dict(rej - last_rej, n_chains * steps)
@@ -135,6 +149,7 @@ def _emit_board_chunks(rec, chunk_meta, acc0, rej0, n_chains,
                  flips_per_s=n_chains * steps / max(wall, 1e-12),
                  accept_rate=(acc - last_acc) / (n_chains * steps),
                  transfer_bytes=tb, hbm_history_bytes=hbm,
+                 readback_bytes=rb,
                  done=done, total=n_transitions, reject=reject)
         # deferred chunk span, back-stamped over the dispatch interval
         # [ts - wall, ts]. The run span is still open at flush time, so
@@ -143,8 +158,20 @@ def _emit_board_chunks(rec, chunk_meta, acc0, rej0, n_chains,
         obs.emit_span_at(rec, "chunk", ts - wall, wall,
                          kernel_path=path, steps=steps, done=done,
                          end_args={"wall_s": wall, "reject": reject})
+        if mon is not None and summ_ref is not None:
+            rhat = ess = None
+            if analytics is not None and i == n_meta - 1:
+                pre = analytics.readback_bytes
+                rhat, ess = analytics.maybe_diagnostics(force=True)
+                rb_total += analytics.readback_bytes - pre
+            mon.observe_summary(_sacc.summary_host(summ_ref), rhat=rhat,
+                                ess=ess, wall_s=wall,
+                                flips_per_s=n_chains * steps
+                                / max(wall, 1e-12),
+                                reject=reject, done=done, ts=ts)
         last_acc = acc
-    return (last_acc - acc_start) / max(n_chains * n_transitions, 1)
+    accept_rate = (last_acc - acc_start) / max(n_chains * n_transitions, 1)
+    return accept_rate, rb_total
 
 
 def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
@@ -155,7 +182,7 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                       bits: Optional[bool] = None,
                       record_every: int = 1,
                       history_device: bool = False,
-                      recorder=None) -> RunResult:
+                      recorder=None, analytics=None) -> RunResult:
     """Advance ``n_transitions`` transitions, recording the same number of
     yields (each BEFORE its transition) — and NO trailing record, so
     segments compose without duplicate boundary yields: a full run is
@@ -169,7 +196,16 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
     run_end events. Telemetry preserves this runner's no-mid-run-sync
     contract: accept counts are stashed as (C,) device refs per chunk
     (like the pending waits) and read back only at run end, so enabling
-    events does not serialize the pipelined dispatch."""
+    events does not serialize the pipelined dispatch.
+
+    ``analytics``: optional ``stats.accumulators.DeviceAnalytics`` —
+    its SummaryAcc rides the scan carry and folds every yield on
+    device. Per-chunk summary device refs are stashed beside the accept
+    refs (the no-mid-run-sync contract holds) and flushed as
+    back-stamped ``diag`` events at run end; pass
+    ``record_history=False`` for the full summary-readback mode where
+    the history block never materializes. Chunk events carry honest
+    ``readback_bytes`` in every mode."""
     rec = obs.resolve_recorder(recorder)
     if record_every < 1:
         raise ValueError(f"record_every must be >= 1, got {record_every}")
@@ -216,10 +252,14 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
         this = min(chunk, n_transitions - done)
         try:
             rfaults.fault_point("compile", path=path, done=done)
-            state, outs = kboard.run_board_chunk(bg, spec, params, state,
-                                                 this,
-                                                 collect=record_history,
-                                                 bits=bits)
+            if analytics is not None:
+                state, outs, new_acc = kboard.run_board_chunk(
+                    bg, spec, params, state, this,
+                    collect=record_history, bits=bits, acc=analytics.acc)
+            else:
+                state, outs = kboard.run_board_chunk(
+                    bg, spec, params, state, this,
+                    collect=record_history, bits=bits)
         except Exception as e:
             if not rdegrade.is_kernel_error(e):
                 raise
@@ -246,6 +286,13 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                            kboard.run_board_chunk, bg, spec, params,
                            state, this, collect=record_history,
                            bits=bits))
+        summ_ref = None
+        if analytics is not None:
+            # adopt the folded accumulator and stash this chunk's small
+            # summary refs — device handles only, no sync (the board
+            # contract); they are read back at the run-end flush
+            analytics.update(new_acc, this)
+            summ_ref = analytics.summary_refs()
         transfer_bytes = 0
         host_outs = None
         if record_history:
@@ -279,21 +326,31 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                 rej = np.asarray(state.reject_count, np.int64).sum(axis=0)
                 reject = _reject_dict(rej - last_rej, n_chains * this)
                 last_rej = rej
+            # honest per-chunk host readback: the history block when it
+            # copies, plus the (C,) waits stash and the stashed summary
+            # (both sized now from shapes, read at the run-end sync)
+            rb = (transfer_bytes + state.waits_sum.shape[0] * 4
+                  + (_sacc.summary_nbytes(summ_ref) if summ_ref is not None
+                     else 0))
             chunk_meta.append((this, wall, transfer_bytes, hbm_bytes,
                                state.accept_count, state.reject_count,
-                               reject, time.time()))
+                               reject, time.time(), summ_ref, rb))
             # wall is a dispatch interval when the loop pipelines; with
             # host history copies (the common telemetry config) the copy
-            # synced above and it is real chunk wall time
-            mon.observe_chunk(outs=host_outs, wall_s=wall,
-                              flips_per_s=n_chains * this
-                              / max(wall, 1e-12),
-                              reject=reject, done=done)
+            # synced above and it is real chunk wall time. In summary
+            # mode the monitor is fed at the run-end flush instead
+            # (back-stamped diag events — no mid-run sync).
+            if analytics is None:
+                mon.observe_chunk(outs=host_outs, wall_s=wall,
+                                  flips_per_s=n_chains * this
+                                  / max(wall, 1e-12),
+                                  reject=reject, done=done)
             met.observe("chunk_wall_s", wall)
             met.observe("flips_per_s", n_chains * this / max(wall, 1e-12))
             met.inc("chunks")
             met.inc("flips", n_chains * this)
             met.inc("transfer_bytes", transfer_bytes)
+            met.inc("readback_bytes", rb)
             met.set("done", done)
             met.notify(rec)
 
@@ -302,9 +359,10 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
     if rec:
         wall = time.perf_counter() - t_run0
         flips = n_chains * n_transitions
-        accept_rate = _emit_board_chunks(
+        accept_rate, rb_total = _emit_board_chunks(
             rec, chunk_meta, acc0, rej0, n_chains, n_transitions,
-            transfer_total, hbm_bytes, path=path)
+            transfer_total, hbm_bytes, path=path, mon=mon,
+            analytics=analytics)
         met.set("hbm_history_bytes", hbm_bytes)
         snap = met.snapshot()
         rec.emit("metrics_snapshot", counters=snap["counters"],
@@ -315,7 +373,10 @@ def run_board_segment(bg: kboard.BoardGraph, spec: Spec,
                  chains=n_chains, flips=flips, wall_s=wall,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=accept_rate, transfer_bytes=transfer_total,
-                 hbm_history_bytes=hbm_bytes, metrics=snap)
+                 hbm_history_bytes=hbm_bytes, metrics=snap,
+                 readback_bytes=rb_total,
+                 readback_mode=("summary" if analytics is not None
+                                else "history"))
         run_span.end(flips=flips, wall_s=wall)
         if not had_rej:
             state = state.replace(reject_count=None)
@@ -330,7 +391,7 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
               bits: Optional[bool] = None,
               record_every: int = 1,
               history_device: bool = False,
-              recorder=None) -> RunResult:
+              recorder=None, analytics=None) -> RunResult:
     """Run the batched board chain for ``n_steps`` yields (yield 0 is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
     ``bits`` overrides the bit-board body dispatch (perf toggle; the
@@ -343,10 +404,10 @@ def run_board(bg: kboard.BoardGraph, spec: Spec, params: StepParams,
                             record_history=record_history, chunk=chunk,
                             bits=bits, record_every=record_every,
                             history_device=history_device,
-                            recorder=recorder)
+                            recorder=recorder, analytics=analytics)
     hist_parts = {k: [v] for k, v in seg.history.items()}
     return finalize_board_run(bg, spec, params, seg.state, hist_parts,
                               seg.waits_total, [], record_history,
                               n_steps, record_every,
                               history_device=history_device,
-                              recorder=recorder)
+                              recorder=recorder, analytics=analytics)

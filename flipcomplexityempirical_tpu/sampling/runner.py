@@ -28,6 +28,7 @@ from ..kernel import step as kstep
 from ..kernel.step import Spec, StepParams
 from ..resilience import degrade as rdegrade
 from ..resilience import faults as rfaults
+from ..stats import accumulators as _sacc
 
 
 @dataclasses.dataclass
@@ -100,7 +101,8 @@ def init_batch(graph: LatticeGraph, assignment: np.ndarray, n_chains: int,
 
 @functools.partial(jax.jit, static_argnames=("spec", "chunk", "collect"))
 def _run_chunk(dg: DeviceGraph, spec: Spec, params: StepParams,
-               states: ChainState, chunk: int, collect: bool = True):
+               states: ChainState, chunk: int, collect: bool = True,
+               acc=None):
     paxes = StepParams.vmap_axes()
     # general-family body dispatch is a trace-time treedef decision: a
     # state carrying the packed conn plane runs the rejection-free dense
@@ -110,16 +112,25 @@ def _run_chunk(dg: DeviceGraph, spec: Spec, params: StepParams,
     trans = (kdense.transition if states.conn_bits is not None
              else kstep.transition)
 
-    def body(states, _):
+    def body(carry, _):
+        states, acc = carry
         states = jax.vmap(
             lambda p, s: trans(dg, spec, p, s),
             in_axes=(paxes, 0))(params, states)
         states, out = jax.vmap(
             lambda p, s: kstep.record(dg, spec, p, s),
             in_axes=(paxes, 0))(params, states)
-        return states, out if collect else {}
+        if acc is not None:
+            acc = _sacc.fold_out(acc, out)
+        return (states, acc), out if collect else {}
 
-    states, outs = jax.lax.scan(body, states, None, length=chunk)
+    # acc (stats.accumulators.SummaryAcc | None) rides the carry: the
+    # device-resident analytics fold. None is an empty pytree node —
+    # that specialization traces to the pre-analytics graph.
+    (states, acc), outs = jax.lax.scan(body, (states, acc), None,
+                                       length=chunk)
+    if acc is not None:
+        return states, outs, acc
     return states, outs
 
 
@@ -176,7 +187,8 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                record_every: int = 1,
                history_device: bool = False,
                recorder=None,
-               kernel_path: Optional[str] = None) -> RunResult:
+               kernel_path: Optional[str] = None,
+               analytics=None) -> RunResult:
     """Run the batched chain for ``n_steps`` yields (the first yield is the
     initial state, as the reference's ``for part in exp_chain`` sees it).
 
@@ -223,6 +235,15 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
     compile failure on the dense body degrades in-segment to 'general'
     (conn_bits stripped, same chunk replayed) with a
     ``kernel_path_degraded`` event + DEGRADATIONS entry.
+
+    ``analytics``: an optional ``stats.accumulators.DeviceAnalytics``.
+    When attached, its SummaryAcc rides the scan carry (every yield
+    folds on device) and the per-chunk telemetry readback is the small
+    summary pytree instead of the history block — pass
+    ``record_history=False`` for the full summary-readback mode where
+    histories never leave the device. History readback stays available
+    (``record_history=True``) as the flagged oracle path. Chunk events
+    carry honest ``readback_bytes`` accounting in every mode.
     """
     rec = obs.resolve_recorder(recorder)
     n_chains = states.assignment.shape[0]
@@ -267,6 +288,7 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
         t_run0 = time.perf_counter()
         last_acc = int(np.asarray(states.accept_count, np.int64).sum())
         acc_start, hbm_bytes, transfer_total = last_acc, 0, 0
+        rb_total = 0
         last_tries = int(np.asarray(states.tries_sum, np.int64).sum())
         last_rej = (np.asarray(states.reject_count, np.int64).sum(axis=0)
                     if states.reject_count is not None else None)
@@ -279,6 +301,10 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
 
     if record_initial:
         states, out0 = _record_initial(dg, spec, params, states)
+        if analytics is not None:
+            # the initial yield is part of the recorded grid; fold it so
+            # the summary matches the history block sample-for-sample
+            analytics.update(_sacc.fold_out(analytics.acc, out0), 1)
         if record_history:
             out0 = maybe_host(out0, history_device)
             hist_parts = {k: [v[:, None]] for k, v in out0.items()}
@@ -318,8 +344,13 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                 # compile fault (chaos: compile:always) must still let
                 # the run complete there
                 rfaults.fault_point("compile", path=path, done=done)
-            states, outs = _run_chunk(dg, spec, params, states, this,
-                                      collect=record_history)
+            if analytics is not None:
+                states, outs, new_acc = _run_chunk(
+                    dg, spec, params, states, this,
+                    collect=record_history, acc=analytics.acc)
+            else:
+                states, outs = _run_chunk(dg, spec, params, states, this,
+                                          collect=record_history)
         except Exception as e:  # noqa: BLE001 — classified just below
             if path != "general_dense" or not rdegrade.is_kernel_error(e):
                 raise
@@ -339,7 +370,12 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                        cost=lambda: obs.aot_cost(
                            _run_chunk, dg, spec, params, states, this,
                            collect=record_history))
+        if analytics is not None:
+            # adopt the folded accumulator (device refs — no sync) and
+            # advance the host mirror by the chunk's yield count
+            analytics.update(new_acc, this)
         transfer_bytes = 0
+        readback_bytes = 0
         host_outs = None
         if record_history:
             outs = maybe_host(thin_outs(outs, record_every), history_device)
@@ -352,16 +388,22 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                 else:
                     transfer_bytes = nb
                     transfer_total += nb
-            for k, v in outs.items():
-                hist_parts.setdefault(k, []).append(v.T)  # (chunk, C)->(C,)
+                    readback_bytes += nb
+        # this drain is the runner's one per-chunk sync; it reads a (C,)
+        # f32 back regardless of mode, and the accounting says so
+        readback_bytes += int(np.asarray(states.waits_sum).nbytes)
         waits_total += np.asarray(states.waits_sum, np.float64)
         states = states.replace(waits_sum=jnp.zeros_like(states.waits_sum))
+        if record_history:
+            for k, v in outs.items():
+                hist_parts.setdefault(k, []).append(v.T)  # (chunk, C)->(C,)
         done += this
         if rec:
             # the waits drain above already synchronized on this chunk,
             # so the accept/reject readbacks and the wall stamp cost no
             # new sync
             acc = int(np.asarray(states.accept_count, np.int64).sum())
+            readback_bytes += int(np.asarray(states.accept_count).nbytes)
             now = time.perf_counter()
             wall = now - t_prev
             t_prev = now
@@ -369,6 +411,9 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
             if last_rej is not None:
                 rej = np.asarray(states.reject_count, np.int64).sum(axis=0)
                 tries = int(np.asarray(states.tries_sum, np.int64).sum())
+                readback_bytes += (
+                    int(np.asarray(states.reject_count).nbytes)
+                    + int(np.asarray(states.tries_sum).nbytes))
                 d = rej - last_rej
                 reject = {"nonboundary": int(d[0]), "pop": int(d[1]),
                           "disconnect": int(d[2]), "metropolis": int(d[3]),
@@ -377,6 +422,13 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                 last_rej, last_tries = rej, tries
             accept_rate = (acc - last_acc) / (n_chains * this)
             flips_per_s = n_chains * this / max(wall, 1e-12)
+            summ = None
+            if analytics is not None:
+                pre_rb = analytics.readback_bytes
+                summ = analytics.summary_to_host()
+                analytics.maybe_diagnostics()
+                readback_bytes += analytics.readback_bytes - pre_rb
+            rb_total += readback_bytes
             rec.emit("chunk", runner="general", path=path,
                      steps=this,
                      chains=n_chains, flips=n_chains * this,
@@ -385,18 +437,27 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                      accept_rate=accept_rate,
                      transfer_bytes=transfer_bytes,
                      hbm_history_bytes=hbm_bytes,
+                     readback_bytes=readback_bytes,
                      done=done, total=n_steps, reject=reject)
             last_acc = acc
-            mon.observe_chunk(outs=host_outs, wall_s=wall,
-                              flips_per_s=flips_per_s,
-                              accept_rate=accept_rate, reject=reject,
-                              done=done)
+            if summ is not None:
+                mon.observe_summary(summ, rhat=analytics.rhat,
+                                    ess=analytics.ess, wall_s=wall,
+                                    flips_per_s=flips_per_s,
+                                    accept_rate=accept_rate,
+                                    reject=reject, done=done)
+            else:
+                mon.observe_chunk(outs=host_outs, wall_s=wall,
+                                  flips_per_s=flips_per_s,
+                                  accept_rate=accept_rate, reject=reject,
+                                  done=done)
             csp.end(wall_s=wall, reject=reject)
             met.observe("chunk_wall_s", wall)
             met.observe("flips_per_s", flips_per_s)
             met.inc("chunks")
             met.inc("flips", n_chains * this)
             met.inc("transfer_bytes", transfer_bytes)
+            met.inc("readback_bytes", readback_bytes)
             met.set("done", done)
             met.notify(rec)
 
@@ -415,7 +476,10 @@ def run_chains(dg: DeviceGraph, spec: Spec, params: StepParams,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=(last_acc - acc_start) / max(flips, 1),
                  transfer_bytes=transfer_total,
-                 hbm_history_bytes=hbm_bytes, metrics=snap)
+                 hbm_history_bytes=hbm_bytes, metrics=snap,
+                 readback_bytes=rb_total,
+                 readback_mode=("summary" if analytics is not None
+                                else "history"))
         run_span.end(flips=flips, wall_s=wall)
     if rec and not had_rej:
         # the counters were telemetry-enabled here; hand back the

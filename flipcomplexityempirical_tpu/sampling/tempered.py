@@ -168,6 +168,7 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
     pending: list = []
 
     def collect(outs, offset):
+        # graftlint: disable=G014(ladder history is host-assembled by design; bytes flow into rb_total via the returned dict_nbytes)
         outs = jax.tree.map(np.asarray,
                             thin_outs(outs, record_every, offset=offset))
         for k, v in outs.items():
@@ -195,6 +196,7 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
         t_run0 = t_prev = time.perf_counter()
         last_acc = int(np.asarray(states.accept_count, np.int64).sum())
         acc_start, transfer_total = last_acc, 0
+        rb_total = 0
         last_rej = np.asarray(states.reject_count, np.int64).sum(axis=0)
         last_tries = int(np.asarray(states.tries_sum, np.int64).sum())
         # one monitor across the whole ladder: R-hat/ESS here mix rungs
@@ -260,12 +262,24 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
             last_rej, last_tries = rej, tries
             accept_rate = (acc - last_acc) / (c * this)
             flips_per_s = c * this / max(wall, 1e-12)
+            # honest device->host traffic for this round: the history
+            # block plus every counter sync the swap round piggybacks
+            # (accepts, reject breakdown, tries, waits drain, beta rungs)
+            readback_bytes = (
+                transfer_bytes
+                + int(np.asarray(states.accept_count).nbytes)
+                + int(np.asarray(states.reject_count).nbytes)
+                + int(np.asarray(states.tries_sum).nbytes)
+                + int(np.asarray(states.waits_sum).nbytes)
+                + int(np.asarray(params.beta).nbytes))
+            rb_total += readback_bytes
             rec.emit("chunk", runner="tempered", path=path, steps=this,
                      chains=c,
                      flips=c * this, wall_s=wall,
                      flips_per_s=flips_per_s,
                      accept_rate=accept_rate,
                      transfer_bytes=transfer_bytes, hbm_history_bytes=0,
+                     readback_bytes=readback_bytes,
                      done=done, total=transitions,
                      round=len(beta_rows) - 1, parity=parity,
                      reject=reject)
@@ -330,6 +344,7 @@ def run_tempered(graph_handle, spec: Spec, params: StepParams, states,
                  flips_per_s=flips / max(wall, 1e-12),
                  accept_rate=(last_acc - acc_start) / max(flips, 1),
                  transfer_bytes=transfer_total, hbm_history_bytes=0,
+                 readback_bytes=rb_total, readback_mode="history",
                  n_rounds=len(beta_rows),
                  swap_attempts=int(attempts.sum()),
                  swap_accepts=int(accepts.sum()), metrics=snap)

@@ -8,6 +8,13 @@ that consume batched ``(n_chains, T)`` histories exactly as ``run_chains``
 returns them, plus the partisan metrics the reference imports but never
 calls (mean_median / efficiency_gap, grid_chain_sec11.py:20-30) and
 district compactness scores for real-geometry dual graphs.
+
+``accumulators`` promotes the device oracles to *in-scan folds*: a
+``SummaryAcc`` pytree carried through the chunk scans streams Welford
+moments, lazy-uniform weighted moments, and a stride-doubling thinning
+buffer entirely on device, so a run's telemetry readback shrinks to one
+small summary pytree per chunk (``DeviceAnalytics`` is the host-side
+wrapper the runners take via ``analytics=``).
 """
 
 from .diagnostics import (
@@ -22,8 +29,16 @@ from .compactness import polsby_popper, cut_edge_count, perimeter_area
 from .device import (bottleneck_ratio_device,
                      conductance_profile_device, ess_device,
                      gelman_rubin_device, integer_thresholds)
+from .accumulators import (
+    SummaryAcc, init_summary, fold_out, fold_block, summary,
+    summary_nbytes, summary_host, summary_diagnostics, summary_allreduce,
+    BufferMirror, DeviceAnalytics,
+)
 
 __all__ = [
+    "SummaryAcc", "init_summary", "fold_out", "fold_block", "summary",
+    "summary_nbytes", "summary_host", "summary_diagnostics",
+    "summary_allreduce", "BufferMirror", "DeviceAnalytics",
     "autocorrelation", "integrated_autocorr_time", "ess", "ess_device", "bottleneck_ratio_device",
     "conductance_profile_device", "gelman_rubin_device",
     "integer_thresholds", "gelman_rubin",

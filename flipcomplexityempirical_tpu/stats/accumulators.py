@@ -1,0 +1,324 @@
+"""Device-resident analytics: in-scan summary accumulators (ISSUE 20).
+
+The runners historically exfiltrated a full ``(C, T_chunk)`` history
+block to the host every chunk to feed ChainMonitor, the control loop,
+and the paper's artifact stats. Every one of those consumers only needs
+summary statistics — moments, a bounded thinning buffer for split
+R-hat/ESS, cumulative accept/wait counters, and (for the artifact
+renderers) the chain-0 interface series. This module folds all of them
+into the scan itself: a :class:`SummaryAcc` pytree rides the scan carry
+of every kernel body, and per-chunk readback becomes one small summary
+dict (a few hundred bytes) instead of the history block.
+
+Contracts, all parity-tested against the post-hoc oracles:
+
+- **Welford moments** (``n``/``mean``/``m2``): single-pass per-chain
+  updates. ChainMonitor's host fold is f64 block-merge; this fold is f32
+  per-step (f64 is not an accelerator-native dtype) — agreement is
+  pinned to fp tolerance, exact in the integer-valued regimes the paper
+  runs (cut counts well under 2^24).
+- **Lazy-uniform weighted moments** (``wsum``/``wmean``/``wm2``): the
+  lazy-chain reweighting (weight ``1 + wait``) computed where the
+  geometric draws already live, so lazy-uniform expectations never need
+  the trajectory.
+- **Thinning buffer** (``buf``/``kept``/``stride``): byte-for-byte the
+  stride-doubling thinning of ``ChainMonitor._fold_buffer`` fed one
+  sample at a time — keep when ``n % stride == 0``, decimate ``[::2]``
+  and double the stride at ``cap``. ``BufferMirror`` replays the same
+  recurrence on the host from step counts alone, so the runner always
+  knows ``kept``/``stride``/``filled`` without reading anything back.
+- **Diagnostics** (:func:`summary_diagnostics`): split R-hat and Sokal
+  ESS over ``buf[:, :filled]`` via the existing ``stats.device``
+  oracles — when the buffer is unthinned these are exactly the post-hoc
+  numbers; with stride ``s`` the ESS is scaled back up by ``s`` exactly
+  as ChainMonitor does.
+- **Heatmap tensors**: the per-edge cut-frequency and per-node
+  flip-count tensors already live in the chain state (the board path's
+  ``cut_times_*``/``num_flips`` bookkeeping, the general path's
+  ``cut_times``). They are device-resident by construction and read
+  back once at run end — the accumulator deliberately does not duplicate
+  them; parity is pinned by the summary-vs-history state bit-match
+  tests.
+- **Artifact series** (``series``): optional full-length chain-0 series
+  (interface ``slope``/``angle``) written by global step index, read
+  back once at run end so the artifact renderers bit-match the
+  history-mode PNGs. This is the only O(T) tensor in the pytree and it
+  never moves during the run.
+
+``fold_out(acc, out)`` is the single hook every scan body calls on the
+per-yield ``out`` dict it already computes; a body whose carry holds
+``acc=None`` traces to exactly the graph it traced before this module
+existed (None is an empty pytree — the hot path is untouched).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax import lax
+
+from .device import ess_device, gelman_rubin_device
+
+# host mirror of the summary() leaf set, in emission order
+SUMMARY_FIELDS = ("n", "mean", "m2", "wsum", "wmean", "wm2", "accepts",
+                  "waits", "kept", "stride")
+
+
+@struct.dataclass
+class SummaryAcc:
+    """In-scan summary accumulator (one per run, carried chunk to chunk).
+
+    All leaves are device arrays; ``observable`` (the ``out`` key folded
+    into the moments/buffer) is a static aux field so two accs over
+    different observables are distinct treedefs.
+    """
+    n: jnp.ndarray        # () int32   samples folded
+    mean: jnp.ndarray     # (C,) f32   per-chain running mean
+    m2: jnp.ndarray       # (C,) f32   per-chain sum of squared deviations
+    wsum: jnp.ndarray     # (C,) f32   lazy-uniform total weight (n + waits)
+    wmean: jnp.ndarray    # (C,) f32   lazy-uniform weighted mean
+    wm2: jnp.ndarray      # (C,) f32   lazy-uniform weighted M2
+    accepts: jnp.ndarray  # (C,) i32   cumulative accepts at last fold
+    waits: jnp.ndarray    # (C,) f32   cumulative completed waits folded
+    buf: jnp.ndarray      # (C, L) f32 stride-doubling thinning buffer
+    kept: jnp.ndarray     # () int32   live columns of ``buf``
+    stride: jnp.ndarray   # () int32   current keep-stride
+    series: dict          # name -> (T_cap,) f32 chain-0 series (may be {})
+    observable: str = struct.field(pytree_node=False, default="cut_count")
+
+
+def init_summary(n_chains: int, *, cap: int = 4096,
+                 observable: str = "cut_count",
+                 series_keys=(), series_cap: int = 0) -> SummaryAcc:
+    """Fresh accumulator for ``n_chains`` chains.
+
+    ``cap`` (even, >= 8) bounds the thinning buffer; ``series_keys``
+    requests full-length chain-0 series (each ``(series_cap,)``) for the
+    artifact renderers — pass the run's total recorded steps.
+    """
+    cap = int(cap)
+    if cap < 8 or cap % 2:
+        raise ValueError("summary buffer cap must be even and >= 8")
+    if series_keys and series_cap <= 0:
+        raise ValueError("series_keys needs a positive series_cap")
+    zc = jnp.zeros((n_chains,), jnp.float32)
+    return SummaryAcc(
+        n=jnp.zeros((), jnp.int32), mean=zc, m2=zc, wsum=zc, wmean=zc,
+        wm2=zc, accepts=jnp.zeros((n_chains,), jnp.int32), waits=zc,
+        buf=jnp.zeros((n_chains, cap), jnp.float32),
+        kept=jnp.zeros((), jnp.int32), stride=jnp.ones((), jnp.int32),
+        series={k: jnp.zeros((int(series_cap),), jnp.float32)
+                for k in series_keys},
+        observable=observable)
+
+
+def fold_out(acc: SummaryAcc, out: dict) -> SummaryAcc:
+    """Fold one yield's ``out`` dict (the per-step record every kernel
+    body already computes) into the accumulator. Trace-safe inside
+    ``lax.scan`` bodies; O(C + cap) per step."""
+    x = out[acc.observable].astype(jnp.float32)           # (C,)
+    n1 = (acc.n + 1).astype(jnp.float32)
+    delta = x - acc.mean
+    mean = acc.mean + delta / n1
+    m2 = acc.m2 + delta * (x - mean)
+
+    wait = out.get("wait")
+    w = (jnp.ones_like(x) if wait is None
+         else 1.0 + wait.astype(jnp.float32))
+    wsum = acc.wsum + w
+    wd = x - acc.wmean
+    wmean = acc.wmean + wd * (w / wsum)
+    wm2 = acc.wm2 + w * wd * (x - wmean)
+    waits = acc.waits + (0.0 if wait is None
+                         else wait.astype(jnp.float32))
+
+    accepts = acc.accepts
+    if "accepts" in out:
+        accepts = out["accepts"].astype(jnp.int32)
+
+    # --- thinning buffer: ChainMonitor._fold_buffer fed (C, 1) blocks.
+    # Decimate-then-append is identical to the host's append-then-
+    # decimate because cap is even: [0..L][::2] keeps the appended
+    # column at position L and the even old columns, exactly the
+    # decimated-prefix + append below.
+    cap = acc.buf.shape[1]
+    keep = (acc.n % acc.stride) == 0
+    full = keep & (acc.kept >= cap)
+    dec = jnp.concatenate(
+        [acc.buf[:, ::2], jnp.zeros_like(acc.buf[:, : cap - cap // 2])],
+        axis=1)
+    buf0 = jnp.where(full, dec, acc.buf)
+    kept0 = jnp.where(full, cap // 2, acc.kept)
+    stride = jnp.where(full, acc.stride * 2, acc.stride)
+    appended = lax.dynamic_update_slice(buf0, x[:, None], (0, kept0))
+    buf = jnp.where(keep, appended, buf0)
+    kept = jnp.where(keep, kept0 + 1, kept0)
+
+    series = {k: lax.dynamic_update_slice(
+        buf_k, out[k][0].astype(jnp.float32)[None], (acc.n,))
+        for k, buf_k in acc.series.items()}
+
+    return acc.replace(n=acc.n + 1, mean=mean, m2=m2, wsum=wsum,
+                       wmean=wmean, wm2=wm2, accepts=accepts, waits=waits,
+                       buf=buf, kept=kept, stride=stride, series=series)
+
+
+def fold_block(acc: SummaryAcc, block: dict) -> SummaryAcc:
+    """Fold a stacked ``(T, C)`` history block one step at a time —
+    the promotion of the post-hoc oracles to a streaming fold. Used by
+    the parity tests and by consumers holding a device history."""
+    def body(a, row):
+        return fold_out(a, row), None
+    acc, _ = lax.scan(body, acc, block)
+    return acc
+
+
+def summary(acc: SummaryAcc) -> dict:
+    """The per-chunk readback pytree: every leaf O(C) or scalar — the
+    buffer and series stay on device. Order matches SUMMARY_FIELDS."""
+    return {"n": acc.n, "mean": acc.mean, "m2": acc.m2, "wsum": acc.wsum,
+            "wmean": acc.wmean, "wm2": acc.wm2, "accepts": acc.accepts,
+            "waits": acc.waits, "kept": acc.kept, "stride": acc.stride}
+
+
+def summary_nbytes(acc_or_summary) -> int:
+    """Honest readback accounting for one summary pytree."""
+    s = (summary(acc_or_summary) if isinstance(acc_or_summary, SummaryAcc)
+         else acc_or_summary)
+    return int(sum(int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+                   for v in s.values()))
+
+
+def summary_host(acc_or_summary) -> dict:
+    """Host copy of a summary dict (numpy leaves)."""
+    s = (summary(acc_or_summary) if isinstance(acc_or_summary, SummaryAcc)
+         else acc_or_summary)
+    return {k: np.asarray(v) for k, v in s.items()}
+
+
+def summary_diagnostics(acc: SummaryAcc, filled: int):
+    """Split R-hat + Sokal ESS over the live buffer prefix, on device.
+
+    ``filled`` is static (the host BufferMirror knows it without a
+    readback); needs ``filled >= 4`` (gelman_rubin splits chains in
+    half). Returns ``(rhat (), ess_total ())`` device scalars — the
+    caller scales ESS by the mirrored stride (each kept sample stands
+    for ``stride`` raw samples), matching ChainMonitor._diagnostics.
+    """
+    if filled < 4:
+        raise ValueError("summary_diagnostics needs >= 4 kept samples")
+    window = lax.slice_in_dim(acc.buf, 0, int(filled), axis=1)
+    rhat = gelman_rubin_device(window)
+    _, ess_total = ess_device(window)
+    return rhat, ess_total
+
+
+def summary_allreduce(s: dict, axis_name: str) -> dict:
+    """Mesh form of a summary dict, for use inside pmap/shard_map with
+    chains sharded over ``axis_name``: per-chain leaves are gathered to
+    the global chain axis (R-hat needs every chain's moments — they are
+    per-chain independent, so a gather IS the merge), pooled counters
+    are ``psum``'d. Histories are not psum-able; summaries are."""
+    out = {}
+    for k, v in s.items():
+        if v.ndim == 1:                       # per-chain: (C_local,)
+            g = lax.all_gather(v, axis_name)  # (shards, C_local)
+            out[k] = g.reshape((-1,))
+        else:
+            out[k] = v
+    out["pooled_accepts"] = lax.psum(s["accepts"].sum(), axis_name)
+    out["pooled_wsum"] = lax.psum(s["wsum"].sum(), axis_name)
+    return out
+
+
+class BufferMirror:
+    """Host replay of the buffer recurrence: ``kept``/``stride``/``n``
+    are deterministic functions of samples-seen and cap, so the host
+    never reads the counters back. Parity with the device fold is
+    pinned by tests."""
+
+    def __init__(self, cap: int = 4096):
+        self.cap = int(cap)
+        self.n = 0
+        self.kept = 0
+        self.stride = 1
+
+    def advance(self, steps: int) -> None:
+        for _ in range(int(steps)):
+            if self.n % self.stride == 0:
+                if self.kept >= self.cap:
+                    self.kept = self.cap // 2
+                    self.stride *= 2
+                self.kept += 1
+            self.n += 1
+
+
+class DeviceAnalytics:
+    """Host coordinator for one run's device-resident analytics.
+
+    Owns the :class:`SummaryAcc` (handed to the kernel each chunk and
+    replaced with the fold result), the host :class:`BufferMirror`, and
+    the diagnostics refresh policy: R-hat/ESS recompile per distinct
+    buffer length, so they refresh when the kept count doubles (<=
+    log2(cap) specializations) and once at run end, not every chunk.
+
+    Nothing here syncs implicitly: ``summary_refs`` returns device
+    refs (stash-safe on the board path's no-mid-run-sync contract);
+    ``summary_host``/``maybe_diagnostics``/``series_host`` are the
+    explicit, byte-accounted readbacks.
+    """
+
+    def __init__(self, n_chains: int, *, cap: int = 4096,
+                 observable: str = "cut_count", series_keys=(),
+                 series_cap: int = 0):
+        self.acc = init_summary(n_chains, cap=cap, observable=observable,
+                                series_keys=series_keys,
+                                series_cap=series_cap)
+        self.mirror = BufferMirror(cap)
+        self.rhat = None          # latest device-diag values (host floats)
+        self.ess = None
+        self._diag_at = 0         # kept count at last refresh
+        self.readback_bytes = 0   # cumulative explicit readback
+
+    def update(self, acc: SummaryAcc, steps: int) -> None:
+        """Adopt the post-chunk accumulator; advance the host mirror."""
+        self.acc = acc
+        self.mirror.advance(steps)
+
+    def summary_refs(self) -> dict:
+        """Device refs of the small summary pytree — no sync."""
+        return summary(self.acc)
+
+    def chunk_readback_bytes(self) -> int:
+        return summary_nbytes(self.acc)
+
+    def summary_to_host(self) -> dict:
+        s = summary_host(self.acc)
+        self.readback_bytes += summary_nbytes(self.acc)
+        return s
+
+    def maybe_diagnostics(self, force: bool = False):
+        """Refresh (rhat, ess) from the device buffer when the kept
+        count has doubled since the last refresh (or ``force``, for run
+        end). Returns the current (possibly stale) values."""
+        filled = self.mirror.kept
+        if filled >= 4 and (force or filled >= 2 * max(self._diag_at, 2)):
+            rhat_d, ess_d = summary_diagnostics(self.acc, filled)
+            rhat = float(np.asarray(rhat_d))
+            ess = float(np.asarray(ess_d)) * self.mirror.stride
+            self.rhat = rhat if np.isfinite(rhat) else None
+            self.ess = ess if np.isfinite(ess) else None
+            self._diag_at = filled
+            self.readback_bytes += 8
+        return self.rhat, self.ess
+
+    def series_host(self) -> dict:
+        """Run-end readback of the chain-0 artifact series, trimmed to
+        the folded length."""
+        t = self.mirror.n
+        out = {}
+        for k, v in self.acc.series.items():
+            out[k] = np.asarray(v)[:t]
+            self.readback_bytes += out[k].nbytes
+        return out

@@ -1,0 +1,18 @@
+"""G014 positive fixture: history tensors pulled to host off the books."""
+import jax
+import numpy as np
+
+
+def run_chunks(chunk_fn, states, n_steps):
+    hist_parts = []
+    for _ in range(n_steps // 64):
+        states, outs = chunk_fn(states, 64)
+        hist_parts.append(np.asarray(outs))          # direct copy
+    history = jax.tree.map(np.asarray, hist_parts)   # tree-map copy
+    return states, history
+
+
+def finalize(states, out_last, history):
+    tail = np.array(out_last)                        # np.array spelling
+    full = jax.device_get(history)                   # device_get spelling
+    return tail, full

@@ -1,0 +1,28 @@
+"""G014 negative fixture: counters, the oracle helper, and a declared
+host-assembly site."""
+import jax
+import numpy as np
+
+
+def maybe_host(outs, history_device):
+    # the flagged oracle path: the one helper allowed to move history
+    if history_device:
+        return outs
+    return jax.tree.map(np.asarray, outs)
+
+
+def run_chunks(chunk_fn, states, n_steps, history_device):
+    hist_parts = []
+    for _ in range(n_steps // 64):
+        states, outs = chunk_fn(states, 64)
+        hist_parts.append(maybe_host(outs, history_device))
+    # scalar counter readbacks are not per-step history tensors
+    accepted = int(np.asarray(states.accept_count, np.int64).sum())
+    waits = np.asarray(states.waits_sum, np.float64)
+    return states, hist_parts, accepted, waits
+
+
+def legacy_collect(outs):
+    # declared exception: host assembly accounted for by the caller
+    host = jax.tree.map(np.asarray, outs)  # graftlint: disable=G014(ladder history is host-assembled by design; bytes counted in rb_total)
+    return host

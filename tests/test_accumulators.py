@@ -1,0 +1,365 @@
+"""Device-resident analytics parity (ISSUE 20): the in-scan SummaryAcc
+fold must reproduce the post-hoc host oracles it replaced — ChainMonitor's
+Welford/thinning-buffer fold, the stats R-hat/ESS oracles, and the
+history-mode moments — on every kernel path, including tiny runs and
+partial final chunks. The summary path must also leave the trajectory
+itself untouched: same seed, analytics on or off, bit-identical states."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import obs, stats
+from flipcomplexityempirical_tpu.stats import accumulators as sacc
+
+
+def synthetic_block(rng, c=6, t=50, integer=True):
+    """(T, C) observable + waits, integer-valued by default (the f32
+    device fold is exact there — cut counts live far below 2^24)."""
+    if integer:
+        x = rng.integers(5, 60, size=(t, c)).astype(np.float32)
+    else:
+        x = rng.normal(20.0, 3.0, size=(t, c)).astype(np.float32)
+    w = rng.integers(0, 4, size=(t, c)).astype(np.float32)
+    return x, w
+
+
+def fold_all(x, w=None, cap=4096, accepts=None):
+    acc = sacc.init_summary(x.shape[1], cap=cap)
+    block = {"cut_count": jnp.asarray(x)}
+    if w is not None:
+        block["wait"] = jnp.asarray(w)
+    if accepts is not None:
+        block["accepts"] = jnp.asarray(accepts)
+    return sacc.fold_block(acc, block)
+
+
+# ---------------------------------------------------------------------------
+# fold vs ChainMonitor host oracles (synthetic data)
+# ---------------------------------------------------------------------------
+
+def test_fold_matches_monitor_welford_and_buffer(rng):
+    x, w = synthetic_block(rng, c=6, t=120)
+    acc = fold_all(x, w, cap=32)
+
+    mon = obs.ChainMonitor(obs.NULL, buffer_cap=32)
+    for t in range(x.shape[0]):                 # fed one step at a time,
+        col = x[t][:, None].astype(np.float64)  # exactly like the scan
+        mon._fold_welford(col)
+        mon._fold_buffer(col)
+
+    assert int(acc.n) == mon._n
+    np.testing.assert_allclose(np.asarray(acc.mean), mon._mean, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(acc.m2), mon._m2, rtol=1e-5)
+    # buffer: same kept columns, same stride, bit-equal contents
+    kept, stride = int(acc.kept), int(acc.stride)
+    assert stride == mon._stride
+    assert kept == mon._buf.shape[1]
+    np.testing.assert_array_equal(np.asarray(acc.buf)[:, :kept], mon._buf)
+
+
+def test_fold_welford_matches_numpy_float_data(rng):
+    x, _ = synthetic_block(rng, c=4, t=200, integer=False)
+    acc = fold_all(x)
+    np.testing.assert_allclose(np.asarray(acc.mean), x.mean(axis=0),
+                               rtol=1e-5)
+    var = np.asarray(acc.m2) / (x.shape[0] - 1)
+    np.testing.assert_allclose(var, x.var(axis=0, ddof=1), rtol=1e-4)
+
+
+def test_weighted_moments_match_numpy(rng):
+    """Lazy-uniform reweighting: weight 1 + wait, computed on device where
+    the geometric draws live."""
+    x, w = synthetic_block(rng, c=5, t=80)
+    acc = fold_all(x, w)
+    wt = 1.0 + w
+    np.testing.assert_allclose(np.asarray(acc.wsum), wt.sum(axis=0),
+                               rtol=1e-6)
+    wmean = (wt * x).sum(axis=0) / wt.sum(axis=0)
+    np.testing.assert_allclose(np.asarray(acc.wmean), wmean, rtol=1e-5)
+    wm2 = (wt * (x - wmean) ** 2).sum(axis=0)
+    np.testing.assert_allclose(np.asarray(acc.wm2), wm2, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(acc.waits), w.sum(axis=0),
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("t", [1, 2, 3, 8, 9, 24, 25, 100])
+def test_buffer_mirror_replays_device_counters(rng, t):
+    """kept/stride are deterministic in (samples, cap): the host mirror
+    must always agree with the device fold without any readback."""
+    x, _ = synthetic_block(rng, c=3, t=t)
+    acc = fold_all(x, cap=8)
+    mirror = sacc.BufferMirror(cap=8)
+    mirror.advance(t)
+    assert mirror.n == int(acc.n) == t
+    assert mirror.kept == int(acc.kept)
+    assert mirror.stride == int(acc.stride)
+
+
+def test_diagnostics_match_host_oracles(rng):
+    """Unthinned regime: the buffer IS the trajectory, so the device
+    split R-hat / Sokal ESS equal the host oracles on the raw block."""
+    x, _ = synthetic_block(rng, c=6, t=64, integer=False)
+    acc = fold_all(x, cap=128)
+    assert int(acc.stride) == 1 and int(acc.kept) == 64
+    rhat_d, ess_d = sacc.summary_diagnostics(acc, 64)
+    assert float(rhat_d) == pytest.approx(stats.gelman_rubin(x.T),
+                                          rel=1e-5)
+    _, ess_h = stats.ess(x.T.astype(np.float64))
+    assert float(ess_d) == pytest.approx(float(ess_h), rel=1e-4)
+
+
+def test_diagnostics_thinned_matches_monitor(rng):
+    """Once the buffer decimates, diagnostics run on the kept grid and
+    ESS scales by the stride — exactly ChainMonitor._diagnostics."""
+    x, _ = synthetic_block(rng, c=6, t=300, integer=False)
+    cap = 64
+    acc = fold_all(x, cap=cap)
+    mon = obs.ChainMonitor(obs.NULL, buffer_cap=cap)
+    for t in range(x.shape[0]):
+        mon._fold_buffer(x[t][:, None].astype(np.float64))
+    assert int(acc.stride) == mon._stride > 1
+    kept = int(acc.kept)
+    rhat_d, ess_d = sacc.summary_diagnostics(acc, kept)
+    rhat_m, ess_m = mon._diagnostics()
+    assert float(rhat_d) == pytest.approx(rhat_m, rel=1e-5)
+    assert float(ess_d) * int(acc.stride) == pytest.approx(ess_m, rel=1e-4)
+
+
+def test_init_summary_validates():
+    with pytest.raises(ValueError):
+        sacc.init_summary(4, cap=7)
+    with pytest.raises(ValueError):
+        sacc.init_summary(4, cap=4)
+    with pytest.raises(ValueError):
+        sacc.init_summary(4, series_keys=("slope",), series_cap=0)
+
+
+def test_summary_nbytes_counts_readback_leaves():
+    acc = sacc.init_summary(8, cap=16)
+    s = sacc.summary(acc)
+    want = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+               for v in s.values())
+    assert sacc.summary_nbytes(acc) == want
+    # the buffer and series never ride the per-chunk readback
+    assert sacc.summary_nbytes(acc) < acc.buf.nbytes
+
+
+# ---------------------------------------------------------------------------
+# runner parity: summary mode vs the flagged history oracle path
+# ---------------------------------------------------------------------------
+
+def _reconstruct(history, n_chains, keys=("cut_count", "wait", "accepts")):
+    """Fold the history-mode (C, T) rows through fold_block — the summary
+    run must land on the identical accumulator state."""
+    block = {k: jnp.asarray(history[k]).T for k in keys if k in history}
+    return sacc.fold_block(sacc.init_summary(n_chains), block)
+
+
+def _assert_acc_matches(analytics, ref):
+    got = sacc.summary_host(analytics.acc)
+    want = sacc.summary_host(ref)
+    for k in sacc.SUMMARY_FIELDS:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+    np.testing.assert_array_equal(
+        np.asarray(analytics.acc.buf), np.asarray(ref.buf))
+
+
+def general_batch(chains=6, kernel_path=None):
+    if kernel_path == "general_dense":
+        g = fce.graphs.hex_lattice(4, 4)
+        spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                        geom_waits=True, parity_metrics=False)
+    else:
+        g = fce.graphs.square_grid(6)
+        spec = fce.Spec(contiguity="patch")
+    plan = fce.graphs.stripes_plan(g, 2)
+    dg, st, params = fce.init_batch(g, plan, n_chains=chains, seed=3,
+                                    spec=spec, base=1.4, pop_tol=0.35)
+    return dg, spec, params, st
+
+
+@pytest.mark.parametrize("kernel_path", ["general", "general_dense"])
+def test_general_runner_summary_parity(kernel_path):
+    """Same seed, history mode vs summary mode, partial final chunk
+    (41 yields / chunk 16): bit-identical trajectory, and the in-scan
+    fold lands exactly where folding the history block lands."""
+    dg, spec, params, st = general_batch(kernel_path=kernel_path)
+    res_h = fce.run_chains(dg, spec, params, st, n_steps=41, chunk=16,
+                           kernel_path=kernel_path)
+
+    ana = sacc.DeviceAnalytics(6)
+    res_s = fce.run_chains(dg, spec, params, st, n_steps=41, chunk=16,
+                           record_history=False, kernel_path=kernel_path,
+                           analytics=ana)
+    np.testing.assert_array_equal(
+        np.asarray(res_h.state.assignment), np.asarray(res_s.state.assignment))
+    np.testing.assert_array_equal(
+        np.asarray(res_h.state.accept_count),
+        np.asarray(res_s.state.accept_count))
+
+    assert int(ana.acc.n) == 41
+    _assert_acc_matches(ana, _reconstruct(res_h.history, 6))
+    # accepts leaf is the cumulative counter at the final fold
+    np.testing.assert_array_equal(
+        np.asarray(ana.acc.accepts), np.asarray(res_s.state.accept_count))
+
+
+@pytest.mark.parametrize("t", [1, 2, 3])
+def test_general_runner_tiny_runs(t):
+    """T=1,2,3: the fold is total-order exact and diagnostics stay None
+    (gelman_rubin needs >= 4 kept samples)."""
+    dg, spec, params, st = general_batch()
+    res_h = fce.run_chains(dg, spec, params, st, n_steps=t)
+    ana = sacc.DeviceAnalytics(6)
+    fce.run_chains(dg, spec, params, st, n_steps=t,
+                   record_history=False, analytics=ana)
+    assert int(ana.acc.n) == t
+    np.testing.assert_allclose(
+        np.asarray(ana.acc.mean),
+        np.asarray(res_h.history["cut_count"]).mean(axis=1), rtol=1e-6)
+    assert ana.maybe_diagnostics(force=True) == (None, None)
+
+
+def board_batch(chains=4, interface=False):
+    if interface:
+        g = fce.graphs.grid_sec11()
+        plan = fce.graphs.sec11_plan(g, alignment=0)
+        spec = fce.Spec(n_districts=2, proposal="bi", contiguity="patch",
+                        invalid="repropose", accept="cut",
+                        parity_metrics=True, geom_waits=True,
+                        record_interface=True)
+    else:
+        g = fce.graphs.square_grid(8)
+        plan = fce.graphs.stripes_plan(g, 2)
+        spec = fce.Spec(contiguity="patch")
+    return fce.sampling.init_board(g, plan, n_chains=chains, seed=11,
+                                   spec=spec, base=1.4, pop_tol=0.3), spec
+
+
+def test_board_runner_summary_parity():
+    """Board fast path, partial final chunk (29 yields / chunk 8): the
+    stashed-refs summary flow (no mid-run sync) matches the history fold
+    and leaves the trajectory bit-identical."""
+    (bg, st, params), spec = board_batch()
+    res_h = fce.sampling.run_board(bg, spec, params, st, n_steps=29,
+                                   chunk=8)
+    ana = sacc.DeviceAnalytics(4)
+    res_s = fce.sampling.run_board(bg, spec, params, st, n_steps=29,
+                                   chunk=8, record_history=False,
+                                   analytics=ana)
+    np.testing.assert_array_equal(
+        np.asarray(res_h.state.board), np.asarray(res_s.state.board))
+    assert int(ana.acc.n) == 29
+    _assert_acc_matches(ana, _reconstruct(res_h.history, 4))
+
+
+@pytest.mark.slow
+def test_lowered_bits_series_parity():
+    """sec11 corner-surgery grid on the lowered_bits body: the chain-0
+    interface series read back at run end bit-match the history rows
+    (NaN-for-NaN — no-interface yields record NaN in both modes)."""
+    (bg, st, params), spec = board_batch(interface=True)
+    from flipcomplexityempirical_tpu.kernel import board as kboard
+    assert kboard.body_for(bg, spec) == "lowered_bits"
+    res_h = fce.sampling.run_board(bg, spec, params, st, n_steps=24,
+                                   chunk=8)
+    ana = sacc.DeviceAnalytics(4, series_keys=("slope", "angle"),
+                               series_cap=24)
+    fce.sampling.run_board(bg, spec, params, st, n_steps=24, chunk=8,
+                           record_history=False, analytics=ana)
+    series = ana.series_host()
+    for k in ("slope", "angle"):
+        np.testing.assert_array_equal(
+            series[k], np.asarray(res_h.history[k][0], np.float32))
+    _assert_acc_matches(ana, _reconstruct(res_h.history, 4))
+
+
+def test_summary_readback_accounting_and_events(tmp_path):
+    """Summary mode's chunk events carry readback_bytes orders of
+    magnitude below history mode's, and run_end declares the mode."""
+    import json
+
+    def run(analytics, path):
+        (bg, st, params), spec = board_batch()
+        with obs.Recorder(path=str(path)) as rec:
+            fce.sampling.run_board(bg, spec, params, st, n_steps=65,
+                                   chunk=16, recorder=rec,
+                                   record_history=analytics is None,
+                                   analytics=analytics)
+        events = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        chunks = [e for e in events if e["event"] == "chunk"]
+        end = [e for e in events if e["event"] == "run_end"][0]
+        return chunks, end
+
+    chunks_h, end_h = run(None, tmp_path / "h.jsonl")
+    ana = sacc.DeviceAnalytics(4)
+    chunks_s, end_s = run(ana, tmp_path / "s.jsonl")
+    assert end_h["readback_mode"] == "history"
+    assert end_s["readback_mode"] == "summary"
+    rb_h = sum(e["readback_bytes"] for e in chunks_h)
+    rb_s = sum(e["readback_bytes"] for e in chunks_s)
+    assert 0 < rb_s < rb_h
+    # run_end totals ALL device->host traffic (summaries + counter
+    # syncs + waits drain); the analytics object meters only its own
+    # explicit reads, so it can never exceed the event's total
+    assert ana.readback_bytes <= end_s["readback_bytes"]
+    assert end_s["readback_bytes"] < end_h["readback_bytes"]
+
+
+def test_sharded_allreduce_parity(mesh8):
+    """16 chains over 8 devices, general kernel: the mesh-wide summary
+    (all_gathered per-chain moments + psum'd pooled counters) equals the
+    fold of the identical unsharded run's history."""
+    from flipcomplexityempirical_tpu import distribute
+
+    g = fce.graphs.square_grid(6)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    dg, st, params = fce.init_batch(g, plan, n_chains=16, seed=5,
+                                    spec=spec, base=1.4, pop_tol=0.35)
+    # oracle: unsharded, record AFTER transition — 40 transition yields
+    res = fce.run_chains(dg, spec, params, st, n_steps=40,
+                         record_initial=False)
+    ref = _reconstruct(res.history, 16)
+
+    st2 = distribute.shard_chain_batch(mesh8, st)
+    params2 = distribute.shard_chain_batch(mesh8, params)
+    step = distribute.make_train_step(dg, spec, mesh8, inner_steps=8,
+                                      exchange=False)
+    ana = sacc.DeviceAnalytics(16)
+    _, _, info = distribute.run_sharded(
+        step, params2, st2, rounds=5, inner_steps=8,
+        key=jax.random.PRNGKey(0), analytics=ana)
+
+    summ, want = info["summary"], sacc.summary_host(ref)
+    assert int(summ["n"]) == 40
+    for k in ("mean", "m2", "wsum", "wmean", "wm2", "waits"):
+        np.testing.assert_allclose(summ[k], want[k], rtol=1e-5,
+                                   err_msg=k)
+    np.testing.assert_array_equal(summ["accepts"], want["accepts"])
+    assert int(summ["pooled_accepts"]) == int(want["accepts"].sum())
+    assert float(summ["pooled_wsum"]) == pytest.approx(
+        float(want["wsum"].sum()), rel=1e-6)
+    assert info["readback_bytes"] == ana.readback_bytes
+
+
+def test_sharded_analytics_rejects_series(mesh8):
+    from flipcomplexityempirical_tpu import distribute
+
+    g = fce.graphs.square_grid(6)
+    plan = fce.graphs.stripes_plan(g, 2)
+    spec = fce.Spec(contiguity="patch")
+    dg, st, params = fce.init_batch(g, plan, n_chains=16, seed=5,
+                                    spec=spec, base=1.4, pop_tol=0.35)
+    st = distribute.shard_chain_batch(mesh8, st)
+    params = distribute.shard_chain_batch(mesh8, params)
+    step = distribute.make_train_step(dg, spec, mesh8, inner_steps=4,
+                                      exchange=False)
+    ana = sacc.DeviceAnalytics(16, series_keys=("slope",), series_cap=8)
+    with pytest.raises(ValueError, match="series"):
+        distribute.run_sharded(step, params, st, rounds=1, inner_steps=4,
+                               key=jax.random.PRNGKey(0), analytics=ana)

@@ -83,6 +83,15 @@ def extract_metrics(doc, out: dict | None = None) -> dict:
                 # coalescing shapes — qualify so they never gate
                 # against each other
                 name += f"[tenants={doc['tenants']}]"
+            elif name.startswith("readback_"):
+                # devstats records (bench --devstats): per-step readback
+                # is a property of one runner path AND the kernel body
+                # it dispatched to (the summary pytree is fixed-size but
+                # the history keys differ per body) — qualify on both so
+                # a board/lowered_bits record never gates against a
+                # general/general_dense one
+                name += (f"[path={doc.get('path', '-')},"
+                         f"kernel_path={doc.get('kernel_path', '-')}]")
             elif "workload" in doc:
                 # workload-matrix records (bench --workload-matrix):
                 # every catalog workload is its own family (flip vs

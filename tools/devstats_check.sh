@@ -1,0 +1,175 @@
+#!/usr/bin/env bash
+# Device-resident analytics CI gate (`make devstats-check`, ISSUE 20):
+# moving the telemetry plane on-chip must change NOTHING downstream and
+# pay for itself in readback bytes.
+#
+# - lint:      graftlint over sampling/ + stats/ — G014 enforces that
+#              per-step history tensors only reach the host through the
+#              flagged maybe_host oracle path (or a reasoned pragma),
+#              so summary mode cannot silently regress into O(C*T)
+#              per-chunk exfiltration.
+# - artifacts: the paper's sec11 config rendered twice from the same
+#              seed — analytics='history' (oracle) vs 'summary'
+#              (device-resident) — every artifact in the manifest must
+#              be byte-identical, and the two runs' fingerprints must
+#              differ (summary mode is a distinct compiled kernel).
+# - hotpath:   NullRecorder contract: recorder absent, NULL, or
+#              recorder+analytics attached — the trajectory itself is
+#              bit-identical in all three (telemetry never perturbs the
+#              chain).
+# - ratio:     the acceptance number: on the board fast path
+#              (chunk >= 256) the per-chunk readback drops >= 100x
+#              summary vs history, measured from the runs' own honest
+#              readback_bytes event fields.
+#
+#   tools/devstats_check.sh                  # all legs
+#   DEVSTATS_LEGS="lint hotpath" tools/devstats_check.sh
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+TD="$(mktemp -d)"
+trap 'rm -rf "$TD"' EXIT
+
+# shared XLA cache so repeat gate runs (and the history/summary pairs,
+# which differ by treedef anyway) skip whatever compiles they can
+export JAX_COMPILATION_CACHE_DIR="${GRAFT_GATE_JAX_CACHE:-${TMPDIR:-/tmp}/graft-gate-jax-cache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+
+LEGS="${DEVSTATS_LEGS:-lint artifacts hotpath ratio}"
+
+for LEG in $LEGS; do
+case "$LEG" in
+
+lint)
+  "$PY" -m tools.graftlint flipcomplexityempirical_tpu/sampling \
+      flipcomplexityempirical_tpu/stats
+  echo "devstats-check[lint]: sampling/ + stats/ are G014-clean"
+  ;;
+
+artifacts)
+  JAX_PLATFORMS=cpu "$PY" - "$TD" <<'PYEOF'
+import filecmp
+import os
+import sys
+
+from flipcomplexityempirical_tpu import experiments as ex
+from flipcomplexityempirical_tpu.experiments.artifacts import artifact_kinds
+
+td = sys.argv[1]
+kw = dict(family="sec11", alignment=0, base=1.4, pop_tol=0.3,
+          total_steps=240, n_chains=2, backend="jax")
+cfg_h = ex.ExperimentConfig(**kw)
+cfg_s = ex.ExperimentConfig(analytics="summary", **kw)
+assert cfg_h.tag == cfg_s.tag
+assert cfg_h.fingerprint() != cfg_s.fingerprint(), \
+    "summary mode must fingerprint as a distinct compiled kernel"
+
+out_h, out_s = os.path.join(td, "hist"), os.path.join(td, "summ")
+data_h = ex.run_config(cfg_h, out_h)
+data_s = ex.run_config(cfg_s, out_s)
+
+kinds = artifact_kinds(cfg_h.family)
+diff = [k for k in kinds
+        if not filecmp.cmp(os.path.join(out_h, cfg_h.tag + k),
+                           os.path.join(out_s, cfg_s.tag + k),
+                           shallow=False)]
+assert not diff, f"artifacts diverged between analytics modes: {diff}"
+assert int(data_s["summary"]["n"]) == kw["total_steps"]
+assert data_s["readback_bytes"] > 0
+print(f"devstats-check[artifacts]: {len(kinds)} sec11 artifacts "
+      "byte-identical, history vs device-resident summary")
+PYEOF
+  ;;
+
+hotpath)
+  JAX_PLATFORMS=cpu "$PY" - <<'PYEOF'
+import numpy as np
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import obs, stats
+
+g = fce.graphs.square_grid(8)
+plan = fce.graphs.stripes_plan(g, 2)
+spec = fce.Spec(contiguity="patch")
+bg, st, params = fce.sampling.init_board(g, plan, n_chains=8, seed=2,
+                                         spec=spec, base=1.4, pop_tol=0.3)
+
+def run(**kw):
+    return fce.sampling.run_board(bg, spec, params, st, n_steps=129,
+                                  chunk=32, **kw)
+
+bare = run(record_history=False)
+null = run(record_history=False, recorder=obs.NULL)
+summ = run(record_history=False, recorder=obs.NULL,
+           analytics=stats.DeviceAnalytics(8))
+for other, label in ((null, "NullRecorder"), (summ, "analytics")):
+    np.testing.assert_array_equal(
+        np.asarray(bare.state.board), np.asarray(other.state.board),
+        err_msg=label)
+    np.testing.assert_array_equal(
+        np.asarray(bare.state.accept_count),
+        np.asarray(other.state.accept_count), err_msg=label)
+print("devstats-check[hotpath]: bare / NullRecorder / analytics "
+      "trajectories bit-identical over 129 yields")
+PYEOF
+  ;;
+
+ratio)
+  JAX_PLATFORMS=cpu "$PY" - "$TD" <<'PYEOF'
+import json
+import os
+import sys
+
+import flipcomplexityempirical_tpu as fce
+from flipcomplexityempirical_tpu import obs, stats
+
+td = sys.argv[1]
+g = fce.graphs.square_grid(16)
+plan = fce.graphs.stripes_plan(g, 2)
+spec = fce.Spec(contiguity="patch")
+bg, st, params = fce.sampling.init_board(g, plan, n_chains=64, seed=0,
+                                         spec=spec, base=1.4, pop_tol=0.3)
+
+def leg(analytics, path):
+    with obs.Recorder(path=path) as rec:
+        fce.sampling.run_board(bg, spec, params, st, n_steps=2049,
+                               chunk=256, recorder=rec,
+                               record_history=analytics is None,
+                               analytics=analytics)
+    ev = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+    chunks = [e for e in ev if e["event"] == "chunk"]
+    steps = sum(e["steps"] for e in chunks)
+    rb = sum(e["readback_bytes"] for e in chunks)
+    mode = [e for e in ev if e["event"] == "run_end"][0]["readback_mode"]
+    return rb / steps, mode
+
+hist, mode_h = leg(None, os.path.join(td, "ratio.hist.jsonl"))
+summ, mode_s = leg(stats.DeviceAnalytics(64),
+                   os.path.join(td, "ratio.summ.jsonl"))
+assert (mode_h, mode_s) == ("history", "summary")
+ratio = hist / summ
+assert ratio >= 100, (
+    f"summary readback only {ratio:.1f}x below history "
+    f"({summ:.1f} vs {hist:.1f} B/step) — acceptance needs >= 100x")
+print(f"devstats-check[ratio]: {ratio:.1f}x per-chunk readback "
+      f"reduction on the board path ({hist:.1f} -> {summ:.2f} B/step)")
+PYEOF
+  for EV in "$TD"/ratio.*.jsonl; do
+    "$PY" tools/obs_report.py "$EV" --check
+    "$PY" tools/obs_report.py "$EV" \
+        | grep -q "^## Readback" \
+        || { echo "devstats-check: report on $EV is missing its" \
+                  "Readback section"; exit 1; }
+  done
+  ;;
+
+*)
+  echo "devstats-check: unknown leg '$LEG'"
+  exit 1
+  ;;
+esac
+done
+
+echo "devstats-check: OK"

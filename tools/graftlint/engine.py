@@ -3,7 +3,7 @@ whole-program stage, and the content-hash result cache.
 
 Two kinds of rules live in the registry:
 
-* **per-file rules** (G001–G010): ``check(module, config)`` over one
+* **per-file rules** (G001–G010, G014): ``check(module, config)`` over one
   ``ParsedModule`` — embarrassingly parallel, cacheable per file.
 * **program rules** (G011–G013, ``PROGRAM = True``): ``check_program(
   program, config)`` over the cross-module :class:`~.program.Program`

@@ -15,11 +15,11 @@ cross-module index instead:
 from . import (g001_host_sync, g002_prng, g003_treedef, g004_events,
                g005_recorder, g006_pytest, g007_retry, g008_control,
                g009_server, g010_tracectx, g011_locks, g012_durability,
-               g013_faultsites)
+               g013_faultsites, g014_history_readback)
 
 RULES = (g001_host_sync, g002_prng, g003_treedef, g004_events,
          g005_recorder, g006_pytest, g007_retry, g008_control,
          g009_server, g010_tracectx, g011_locks, g012_durability,
-         g013_faultsites)
+         g013_faultsites, g014_history_readback)
 
 RULE_IDS = tuple(r.RULE_ID for r in RULES)

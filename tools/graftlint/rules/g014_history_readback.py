@@ -1,0 +1,115 @@
+"""G014: per-step history tensors materialized on host in ``sampling/``.
+
+Since the device-resident analytics layer (stats/accumulators.py), the
+full per-step history is an *oracle path*: runners keep it behind the
+``record_history`` / ``analytics='history'`` flags and funnel every
+device->host copy of it through the ``maybe_host`` helper, which gates
+on ``history_device``. Any other host materialization of a history
+tensor in ``sampling/`` silently reintroduces the O(C*T) per-chunk
+readback that summary mode exists to eliminate — and it does so off the
+books, since it bypasses the honest ``readback_bytes`` accounting.
+
+Statically: in non-test ``sampling/`` modules, flag
+
+- ``np.asarray(h)`` / ``np.array(h)`` / ``jax.device_get(h)``
+- ``jax.tree.map(np.asarray, h)`` (and ``jax.tree_map`` /
+  ``jax.tree_util.tree_map`` spellings)
+
+whenever the materialized expression mentions a history-shaped name
+(``out``/``outs``/``out0``/``out_last``/``hist``/``history``/
+``host_outs``/``ys``...). Scalar counter readbacks
+(``np.asarray(states.accept_count)`` and friends) are not history
+tensors and stay unflagged. Call sites *inside* the ``maybe_host``
+helper itself are exempt — that is the flagged oracle path. A runner
+that legitimately assembles history on host (e.g. the tempered ladder's
+``collect``) declares it with ``# graftlint: disable=G014(reason)``,
+which keeps the exception visible at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import dotted_name, parents, terminal_name, \
+    walk_with_parents
+
+RULE_ID = "G014"
+
+_NP_ROOTS = frozenset({"np", "numpy", "onp"})
+_NP_COPIES = frozenset({"asarray", "array"})
+_TREE_MAPS = frozenset({"jax.tree.map", "jax.tree_map",
+                        "jax.tree_util.tree_map", "tree.map", "tree_map"})
+# Functions that ARE the flagged oracle path: the one helper allowed to
+# move history to host (it gates on history_device).
+_ORACLE_FUNCS = frozenset({"maybe_host"})
+
+_HISTORY_NAME = re.compile(r"^(out\w*|hist\w*|host_out\w*|ys)$")
+
+
+def applies(module) -> bool:
+    return "sampling/" in module.path and not module.is_test
+
+
+def _is_np_copy(func) -> bool:
+    dn = dotted_name(func) or ""
+    root = dn.split(".")[0] if dn else None
+    name = terminal_name(func)
+    if name in _NP_COPIES and root in _NP_ROOTS:
+        return True
+    return dn == "jax.device_get" or name == "device_get"
+
+
+def _history_names(expr) -> list:
+    """History-shaped identifiers mentioned anywhere in ``expr``."""
+    found = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and _HISTORY_NAME.match(node.id):
+            found.append(node.id)
+        # states.accept_count etc.: the attribute chain's base name is
+        # what we walk into; attribute *names* are deliberately ignored
+        # so counter fields never match.
+    return found
+
+
+def _materialized_args(call):
+    """Args a call copies to host, or None if it is not a materializer."""
+    if _is_np_copy(call.func):
+        return call.args
+    dn = dotted_name(call.func) or ""
+    if dn in _TREE_MAPS and call.args and _is_np_copy(call.args[0]):
+        return call.args[1:]
+    return None
+
+
+def _in_oracle_helper(node) -> bool:
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and p.name in _ORACLE_FUNCS:
+            return True
+    return False
+
+
+def check(module, config):
+    walk_with_parents(module.tree)
+    findings = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        args = _materialized_args(node)
+        if args is None:
+            continue
+        names = []
+        for a in args:
+            names.extend(_history_names(a))
+        if not names:
+            continue
+        if _in_oracle_helper(node):
+            continue
+        findings.append(module.finding(
+            RULE_ID, node,
+            f"per-step history tensor ({', '.join(sorted(set(names)))}) "
+            "materialized on host outside the maybe_host oracle path — "
+            "route it through maybe_host/history_device (or account for "
+            "it and disable=G014 with a reason)"))
+    return findings

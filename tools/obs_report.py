@@ -300,6 +300,46 @@ def report_paths(runs, out):
               f"| {a['wall']:.3f} | {rate / 1e6:.3f} |", file=out)
 
 
+def report_readback(runs, out):
+    """Device->host traffic per kernel path, from the optional
+    ``readback_bytes`` chunk/run_end fields (runners that predate the
+    accounting render nothing — the section appears only when at least
+    one run carries it). Splits by ``readback_mode``: the summary plane
+    (device-resident analytics, one small pytree per chunk) vs the
+    flagged history oracle path — the per-step readback ratio between
+    them is the devstats gate's acceptance number."""
+    by_key: dict = {}
+    for r in runs:
+        e = r["end"] or {}
+        chunks = [c for c in r["chunks"] if "readback_bytes" in c]
+        if "readback_bytes" not in e and not chunks:
+            continue
+        path = r["start"].get("path", e.get("path", "-"))
+        mode = e.get("readback_mode",
+                     "summary" if r["start"].get("analytics") else
+                     "history")
+        agg = by_key.setdefault((path, mode), {
+            "runs": 0, "bytes": 0, "chunks": 0, "steps": 0})
+        agg["runs"] += 1
+        agg["chunks"] += len(chunks)
+        agg["steps"] += sum(c.get("steps", 0) for c in chunks)
+        agg["bytes"] += e.get("readback_bytes",
+                              sum(c["readback_bytes"] for c in chunks))
+    if not by_key:
+        return
+    print("\n## Readback (device->host bytes)", file=out)
+    print("| path | mode | runs | chunks | bytes | B/chunk | B/step |",
+          file=out)
+    print("|---|---|---|---|---|---|---|", file=out)
+    for path, mode in sorted(by_key):
+        a = by_key[(path, mode)]
+        per_chunk = a["bytes"] / a["chunks"] if a["chunks"] else 0.0
+        per_step = a["bytes"] / a["steps"] if a["steps"] else 0.0
+        print(f"| {path} | {mode} | {a['runs']} | {a['chunks']} "
+              f"| {a['bytes']} | {per_chunk:.1f} | {per_step:.2f} |",
+              file=out)
+
+
 def _fmt_rhat(x):
     return "-" if x is None else f"{x:.3f}"
 
@@ -919,6 +959,7 @@ def main(argv=None):
     runs = fold_runs(events)
     if runs:
         report_runs(runs, out)
+        report_readback(runs, out)
     report_health(events, runs, out)
     report_timing(events, runs, out)
     report_resilience(events, out)

@@ -316,6 +316,18 @@ def file_trace_events(events, pid: int) -> list[dict]:
                     "pid": pid,
                     "args": {"flips_per_s": rate},
                 })
+            # a second counter track for the device->host traffic the
+            # chunk caused (optional field; summary-mode runs sit ~100x
+            # under history-mode ones on the same timeline)
+            rb = e.get("readback_bytes")
+            if isinstance(rb, (int, float)):
+                out.append({
+                    "name": f"readback bytes [{e.get('path', '?')}]",
+                    "ph": "C",
+                    "ts": e["ts"] * 1e6,
+                    "pid": pid,
+                    "args": {"readback_bytes": rb},
+                })
         elif kind in _INSTANTS:
             label = {"anomaly": e.get("kind"),
                      "error": e.get("message"),
